@@ -1,0 +1,33 @@
+"""raft_tpu — a TPU-native vector-search and ML-primitives framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of RAFT (Reusable
+Accelerated Functions and Tools, the CUDA library; see SURVEY.md): exact
+brute-force kNN, IVF-Flat, IVF-PQ and CAGRA index build/search, balanced
+k-means, pairwise distances, batched top-k selection, statistics, random data
+generation, sparse primitives, and a distributed comms layer over XLA
+collectives (ICI/DCN) for multi-chip sharded indexes.
+
+Subpackages mirror the reference's domain split (SURVEY.md §1 layer map):
+
+- ``core``      runtime context/resources, bitset, serialization
+- ``distance``  pairwise distances, fused L2+argmin, kernel gram
+- ``matrix``    select_k (batched top-k) and matrix ops
+- ``linalg``    dense linear algebra conveniences
+- ``neighbors`` brute_force / ivf_flat / ivf_pq / cagra / refine / hnsw ...
+- ``cluster``   kmeans, balanced hierarchical kmeans, single-linkage
+- ``sparse``    COO/CSR ops, sparse distances/kNN, MST, Lanczos
+- ``random``    RNG distributions and dataset generators
+- ``stats``     summary stats + clustering/ANN quality metrics
+- ``solver``    linear assignment problem
+- ``spectral``  spectral partitioning
+- ``label``     label utilities
+- ``comms``     distributed communicator over jax collectives
+- ``parallel``  multi-chip (MNMG-analog) sharded algorithms
+- ``ops``       Pallas TPU kernels backing the hot paths
+"""
+
+__version__ = "0.1.0"
+
+from . import core  # noqa: F401
+
+__all__ = ["core", "__version__"]

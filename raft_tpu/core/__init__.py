@@ -1,0 +1,30 @@
+"""Core runtime: context, errors, logging, tracing, bitset, serialization.
+
+TPU-native analog of the reference's ``raft/core/`` layer (SURVEY.md §2.1).
+mdspan/mdarray deliberately have no analog — a ``jax.Array`` already carries
+shape/dtype/layout, and XLA owns memory placement; the helpers here are what
+remains genuinely runtime-shaped.
+"""
+from .bitset import Bitset
+from .errors import RaftError, expects, fail
+from .interruptible import InterruptedException, synchronize
+from .kvp import KeyValuePair
+from .resources import DeviceResources, Resources, device_resources_manager
+from . import logging, operators, serialize, tracing
+
+__all__ = [
+    "Bitset",
+    "RaftError",
+    "expects",
+    "fail",
+    "InterruptedException",
+    "synchronize",
+    "KeyValuePair",
+    "DeviceResources",
+    "Resources",
+    "device_resources_manager",
+    "logging",
+    "operators",
+    "serialize",
+    "tracing",
+]

@@ -1,0 +1,112 @@
+"""Device bitset over uint32 words: analog of ``raft::core::bitset``.
+
+Reference: raft/core/bitset.cuh:38-91 (view) and :263-380 (owning type with
+``test/set/flip/count/any/all``). Backs ANN sample filtering (bitset_filter).
+Implemented as pure jnp bit arithmetic so it fuses into surrounding XLA
+programs; all ops are jit-safe and shapes are static.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import cdiv
+
+__all__ = ["Bitset"]
+
+_BITS = 32
+
+
+@jax.tree_util.register_pytree_node_class
+class Bitset:
+    """Fixed-length bitset stored as packed uint32 words (a pytree leaf
+    wrapper, so it can pass through jit boundaries)."""
+
+    def __init__(self, words: jax.Array, n_bits: int):
+        self.words = words
+        self.n_bits = n_bits
+
+    # -- pytree protocol --------------------------------------------------
+    def tree_flatten(self):
+        return (self.words,), self.n_bits
+
+    @classmethod
+    def tree_unflatten(cls, n_bits, children):
+        return cls(children[0], n_bits)
+
+    # -- constructors -----------------------------------------------------
+    @classmethod
+    def create(cls, n_bits: int, default: bool = True) -> "Bitset":
+        """All-set (default, matching the reference's default_value=true used
+        for 'nothing filtered') or all-clear bitset."""
+        n_words = cdiv(n_bits, _BITS)
+        fill = jnp.uint32(0xFFFFFFFF) if default else jnp.uint32(0)
+        words = jnp.full((n_words,), fill, dtype=jnp.uint32)
+        bs = cls(words, n_bits)
+        if default:
+            bs = cls(bs._masked_words(), n_bits)  # clear tail padding bits
+        return bs
+
+    @classmethod
+    def from_mask(cls, mask: jax.Array) -> "Bitset":
+        """Pack a boolean vector (n_bits,) into a bitset."""
+        n_bits = mask.shape[0]
+        n_words = cdiv(n_bits, _BITS)
+        pad = n_words * _BITS - n_bits
+        m = jnp.pad(mask.astype(jnp.uint32), (0, pad)).reshape(n_words, _BITS)
+        shifts = jnp.arange(_BITS, dtype=jnp.uint32)
+        words = jnp.sum(m << shifts, axis=1, dtype=jnp.uint32)
+        return cls(words, n_bits)
+
+    # -- ops --------------------------------------------------------------
+    def _masked_words(self) -> jax.Array:
+        """Words with bits past n_bits forced to zero."""
+        tail = self.n_bits % _BITS
+        if tail == 0:
+            return self.words
+        last_mask = jnp.uint32((1 << tail) - 1)
+        return self.words.at[-1].set(self.words[-1] & last_mask)
+
+    def test(self, idx: jax.Array) -> jax.Array:
+        """Read bit(s) at ``idx`` (any integer array shape)."""
+        idx = jnp.asarray(idx)
+        word = self.words[idx // _BITS]
+        return ((word >> (idx % _BITS).astype(jnp.uint32)) & 1).astype(bool)
+
+    def set(self, idx: jax.Array, value: bool | jax.Array = True) -> "Bitset":
+        """Functional bit set/clear; returns a new bitset (idx: scalar or 1-D).
+
+        Goes through the unpacked boolean form so duplicate indices scatter
+        correctly; repack cost is O(n_bits) which is fine for filter-building
+        (the read path ``test``/``to_mask`` stays packed).
+        """
+        idx = jnp.atleast_1d(jnp.asarray(idx))
+        val = jnp.broadcast_to(jnp.asarray(value, dtype=bool), idx.shape)
+        mask = self.to_mask().at[idx].set(val)
+        return Bitset.from_mask(mask)
+
+    def flip(self) -> "Bitset":
+        return Bitset((~self._masked_words()).astype(jnp.uint32), self.n_bits)
+
+    def to_mask(self) -> jax.Array:
+        """Unpack to a boolean vector of shape (n_bits,)."""
+        shifts = jnp.arange(_BITS, dtype=jnp.uint32)
+        bits = (self.words[:, None] >> shifts[None, :]) & 1
+        return bits.reshape(-1)[: self.n_bits].astype(bool)
+
+    def count(self) -> jax.Array:
+        w = self._masked_words()
+        # popcount via bit tricks (uint32)
+        w = w - ((w >> 1) & jnp.uint32(0x55555555))
+        w = (w & jnp.uint32(0x33333333)) + ((w >> 2) & jnp.uint32(0x33333333))
+        w = (w + (w >> 4)) & jnp.uint32(0x0F0F0F0F)
+        return jnp.sum((w * jnp.uint32(0x01010101)) >> 24).astype(jnp.int32)
+
+    def any(self) -> jax.Array:
+        return jnp.any(self._masked_words() != 0)
+
+    def all(self) -> jax.Array:
+        return self.count() == self.n_bits
+
+    def none(self) -> jax.Array:
+        return ~self.any()

@@ -1,0 +1,29 @@
+"""Error handling: the TPU-native analog of the reference's contract macros.
+
+Reference: raft/core/error.hpp (``raft::exception``, ``RAFT_EXPECTS``,
+``RAFT_FAIL``). CUDA status macros have no TPU analog — XLA raises Python
+exceptions directly — so only the contract-checking surface is kept.
+"""
+from __future__ import annotations
+
+__all__ = ["RaftError", "expects", "fail"]
+
+
+class RaftError(RuntimeError):
+    """Base exception for raft_tpu (analog of ``raft::exception``)."""
+
+
+def expects(cond: bool, msg: str, *args) -> None:
+    """Contract check (analog of ``RAFT_EXPECTS``).
+
+    Raises :class:`RaftError` with the formatted message when ``cond`` is
+    falsy. Only for host-side (trace-time) checks; inside jitted code use
+    ``checkify`` or masking instead.
+    """
+    if not cond:
+        raise RaftError(msg % args if args else msg)
+
+
+def fail(msg: str, *args) -> None:
+    """Unconditional failure (analog of ``RAFT_FAIL``)."""
+    raise RaftError(msg % args if args else msg)

@@ -1,0 +1,91 @@
+"""Cooperative cross-thread cancellation: analog of ``raft::interruptible``.
+
+Reference: raft/core/interruptible.hpp:71-94 — a per-thread token whose
+``cancel()`` makes the target thread's next ``synchronize()`` raise. The TPU
+analog hooks the same token protocol into host-side checkpoints between
+dispatched XLA computations (device work itself is not preemptible, exactly
+as a single CUDA kernel is not).
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Optional
+
+__all__ = ["InterruptedException", "Token", "get_token", "cancel", "check", "synchronize"]
+
+
+class InterruptedException(RuntimeError):
+    """Raised at the next cancellation point after ``cancel()``."""
+
+
+class Token:
+    """Shared cancellation flag for one logical thread of work."""
+
+    def __init__(self):
+        self._flag = threading.Event()
+
+    def cancel(self) -> None:
+        self._flag.set()
+
+    def cancelled(self) -> bool:
+        return self._flag.is_set()
+
+    def check(self) -> None:
+        """Cancellation point: raise (and reset) if cancelled."""
+        if self._flag.is_set():
+            self._flag.clear()
+            raise InterruptedException("raft_tpu: work interrupted")
+
+
+# Token storage mirrors the reference's weak-pointer TLS design
+# (interruptible.hpp:226-233): the thread-local holds the only strong
+# reference, so a token dies with its thread and recycled thread idents
+# can't inherit a stale cancellation.
+_local = threading.local()
+_registry: "weakref.WeakValueDictionary[int, Token]" = weakref.WeakValueDictionary()
+_lock = threading.Lock()
+
+
+def get_token(thread_id: Optional[int] = None) -> Token:
+    """Get (creating if needed) the token for a thread (default: current).
+
+    A token for another thread can only be *retrieved* while that thread is
+    alive and has created one; otherwise a fresh detached token is returned
+    (cancel on it is a no-op for everyone else).
+    """
+    if thread_id is None or thread_id == threading.get_ident():
+        tok = getattr(_local, "token", None)
+        if tok is None:
+            tok = Token()
+            _local.token = tok
+            with _lock:
+                _registry[threading.get_ident()] = tok
+        return tok
+    with _lock:
+        tok = _registry.get(thread_id)
+    return tok if tok is not None else Token()
+
+
+def cancel(thread_id: Optional[int] = None) -> None:
+    get_token(thread_id).cancel()
+
+
+def check() -> None:
+    """Cancellation point for the current thread."""
+    get_token().check()
+
+
+def synchronize(value=None):
+    """Block on device work, honoring cancellation (analog of
+    ``interruptible::synchronize(stream)``). If ``value`` is a jax array (or
+    pytree), waits for it; otherwise waits for all dispatched work."""
+    check()
+    import jax
+
+    if value is None:
+        jax.effects_barrier()
+    else:
+        jax.block_until_ready(value)
+    check()
+    return value

@@ -1,0 +1,19 @@
+"""Key-value pair used by fused argmin reductions (analog of raft/core/kvp.hpp).
+
+In JAX a KVP is just a (key, value) tuple of arrays; this module gives it a
+named constructor and the reduction helpers used by fused_l2_nn.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+
+__all__ = ["KeyValuePair"]
+
+
+class KeyValuePair(NamedTuple):
+    """Index/distance pair; `key` is the argmin index, `value` its distance."""
+
+    key: jax.Array
+    value: jax.Array

@@ -1,0 +1,112 @@
+"""Composable scalar/elementwise functors: analog of ``raft/core/operators.hpp``.
+
+The reference passes small functor structs (sq_op, add_op, ...) into its
+kernel templates; in JAX the same role is played by plain functions composed
+into jitted programs. Provided for API parity and for the distance/linalg
+layers that take ``main_op``/``final_op`` hooks.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = [
+    "identity_op", "cast_op", "key_op", "value_op", "sq_op", "abs_op",
+    "sqrt_op", "nz_op", "add_op", "sub_op", "mul_op", "div_op",
+    "div_checkzero_op", "pow_op", "min_op", "max_op", "argmin_op",
+    "argmax_op", "const_op", "compose_op",
+]
+
+
+def identity_op(x, *_):
+    return x
+
+
+def cast_op(dtype):
+    return lambda x, *_: x.astype(dtype)
+
+
+def key_op(kvp, *_):
+    return kvp[0]
+
+
+def value_op(kvp, *_):
+    return kvp[1]
+
+
+def sq_op(x, *_):
+    return x * x
+
+
+def abs_op(x, *_):
+    return jnp.abs(x)
+
+
+def sqrt_op(x, *_):
+    return jnp.sqrt(x)
+
+
+def nz_op(x, *_):
+    return (x != 0).astype(x.dtype)
+
+
+def add_op(a, b):
+    return a + b
+
+
+def sub_op(a, b):
+    return a - b
+
+
+def mul_op(a, b):
+    return a * b
+
+
+def div_op(a, b):
+    return a / b
+
+
+def div_checkzero_op(a, b):
+    return jnp.where(b == 0, 0, a / jnp.where(b == 0, 1, b))
+
+
+def pow_op(a, b):
+    return jnp.power(a, b)
+
+
+def min_op(a, b):
+    return jnp.minimum(a, b)
+
+
+def max_op(a, b):
+    return jnp.maximum(a, b)
+
+
+def argmin_op(kvp_a, kvp_b):
+    """Reduce two (key, value) pairs to the one with smaller value (ties →
+    smaller key), matching the reference's KVP argmin semantics."""
+    ka, va = kvp_a
+    kb, vb = kvp_b
+    take_b = (vb < va) | ((vb == va) & (kb < ka))
+    return (jnp.where(take_b, kb, ka), jnp.where(take_b, vb, va))
+
+
+def argmax_op(kvp_a, kvp_b):
+    ka, va = kvp_a
+    kb, vb = kvp_b
+    take_b = (vb > va) | ((vb == va) & (kb < ka))
+    return (jnp.where(take_b, kb, ka), jnp.where(take_b, vb, va))
+
+
+def const_op(c):
+    return lambda *_: c
+
+
+def compose_op(*fns):
+    """compose_op(f, g, h)(x) == f(g(h(x)))."""
+
+    def composed(x, *args):
+        for fn in reversed(fns):
+            x = fn(x, *args)
+        return x
+
+    return composed

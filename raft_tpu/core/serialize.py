@@ -1,0 +1,147 @@
+"""Array + index serialization in NumPy ``.npy`` framing.
+
+Reference: raft/core/serialize.hpp:36-65 and
+core/detail/mdspan_numpy_serializer.hpp — the reference serializes every
+mdspan in numpy format so Python can read index files directly. We keep the
+same wire idea: a stream of scalars (struct-packed) and arrays (``.npy``
+frames), plus a small versioned header per index type. Index save/load for
+each ANN type builds on these primitives (the analog of
+neighbors/*_serialize.cuh).
+"""
+from __future__ import annotations
+
+import io
+import os
+import struct
+from typing import Any, BinaryIO, Dict, List, Tuple
+
+import jax
+import numpy as np
+
+__all__ = [
+    "serialize_scalar",
+    "deserialize_scalar",
+    "serialize_array",
+    "deserialize_array",
+    "serialize_header",
+    "deserialize_header",
+    "save_arrays",
+    "load_arrays",
+]
+
+_MAGIC = b"RAFT_TPU"
+
+
+def serialize_scalar(f: BinaryIO, value, fmt: str) -> None:
+    """Write one struct-packed scalar (fmt is a struct format char, e.g. '<q')."""
+    f.write(struct.pack(fmt, value))
+
+
+def deserialize_scalar(f: BinaryIO, fmt: str):
+    size = struct.calcsize(fmt)
+    (v,) = struct.unpack(fmt, f.read(size))
+    return v
+
+
+def serialize_array(f: BinaryIO, arr) -> None:
+    """Write an array as a standard ``.npy`` frame (device arrays are pulled
+    to host first)."""
+    np.save(f, np.asarray(jax.device_get(arr)), allow_pickle=False)
+
+
+def deserialize_array(f: BinaryIO) -> np.ndarray:
+    return np.load(f, allow_pickle=False)
+
+
+def serialize_header(f: BinaryIO, kind: str, version: int, meta: Dict[str, Any]) -> None:
+    """Versioned header: magic, index kind, serialization version and a
+    metadata dict of plain ints/floats/strings/bools (analog of the version
+    constants in detail/ivf_pq_serialize.cuh)."""
+    f.write(_MAGIC)
+    kind_b = kind.encode()
+    f.write(struct.pack("<HI", len(kind_b), version))
+    f.write(kind_b)
+    items: List[Tuple[str, Any]] = sorted(meta.items())
+    f.write(struct.pack("<I", len(items)))
+    for k, v in items:
+        kb = k.encode()
+        if isinstance(v, bool):
+            tag, payload = b"b", struct.pack("<?", v)
+        elif isinstance(v, int):
+            tag, payload = b"i", struct.pack("<q", v)
+        elif isinstance(v, float):
+            tag, payload = b"f", struct.pack("<d", v)
+        elif isinstance(v, str):
+            vb = v.encode()
+            tag, payload = b"s", struct.pack("<I", len(vb)) + vb
+        else:
+            raise TypeError(f"unsupported meta value for {k!r}: {type(v)}")
+        f.write(struct.pack("<H", len(kb)) + kb + tag + payload)
+
+
+def deserialize_header(f: BinaryIO, expect_kind: str | None = None):
+    magic = f.read(len(_MAGIC))
+    if magic != _MAGIC:
+        raise ValueError("not a raft_tpu serialized file (bad magic)")
+    kind_len, version = struct.unpack("<HI", f.read(6))
+    kind = f.read(kind_len).decode()
+    if expect_kind is not None and kind != expect_kind:
+        raise ValueError(f"expected index kind {expect_kind!r}, found {kind!r}")
+    (n_items,) = struct.unpack("<I", f.read(4))
+    meta: Dict[str, Any] = {}
+    for _ in range(n_items):
+        (klen,) = struct.unpack("<H", f.read(2))
+        k = f.read(klen).decode()
+        tag = f.read(1)
+        if tag == b"b":
+            (v,) = struct.unpack("<?", f.read(1))
+        elif tag == b"i":
+            (v,) = struct.unpack("<q", f.read(8))
+        elif tag == b"f":
+            (v,) = struct.unpack("<d", f.read(8))
+        elif tag == b"s":
+            (slen,) = struct.unpack("<I", f.read(4))
+            v = f.read(slen).decode()
+        else:
+            raise ValueError(f"bad meta tag {tag!r}")
+        meta[k] = v
+    return kind, version, meta
+
+
+def save_arrays(path_or_file, kind: str, version: int, meta: Dict[str, Any],
+                arrays: Dict[str, Any]) -> None:
+    """Save a header plus named arrays (sorted order, name-prefixed frames)."""
+
+    def _write(f: BinaryIO):
+        serialize_header(f, kind, version, meta)
+        items = sorted(arrays.items())
+        f.write(struct.pack("<I", len(items)))
+        for name, arr in items:
+            nb = name.encode()
+            f.write(struct.pack("<H", len(nb)) + nb)
+            serialize_array(f, arr)
+
+    if isinstance(path_or_file, (str, bytes, os.PathLike)):
+        with open(path_or_file, "wb") as f:
+            _write(f)
+    else:
+        _write(path_or_file)
+
+
+def load_arrays(path_or_file, expect_kind: str | None = None):
+    """Inverse of :func:`save_arrays` → (kind, version, meta, {name: ndarray})."""
+
+    def _read(f: BinaryIO):
+        kind, version, meta = deserialize_header(f, expect_kind)
+        (n,) = struct.unpack("<I", f.read(4))
+        arrays: Dict[str, np.ndarray] = {}
+        for _ in range(n):
+            (nlen,) = struct.unpack("<H", f.read(2))
+            name = f.read(nlen).decode()
+            arrays[name] = deserialize_array(f)
+        return kind, version, meta, arrays
+
+    if isinstance(path_or_file, (str, bytes, os.PathLike)):
+        with open(path_or_file, "rb") as f:
+            return _read(f)
+    return _read(path_or_file)

@@ -1,0 +1,61 @@
+"""Tracing/profiling ranges: TPU-native analog of the reference's NVTX layer.
+
+Reference: raft/core/nvtx.hpp:84 (RAII ``nvtx::range`` pushed at every public
+entry point, compiled out unless RAFT_NVTX). Here ranges map onto
+``jax.profiler.TraceAnnotation`` so they show up in TPU profiler/Perfetto
+traces; a module-level switch keeps them zero-cost when disabled.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+from typing import Iterator
+
+import jax
+
+__all__ = ["enabled", "enable", "disable", "range", "annotate"]
+
+_enabled = os.environ.get("RAFT_TPU_TRACE", "0") not in ("0", "", "false")
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+@contextlib.contextmanager
+def range(name: str) -> Iterator[None]:  # noqa: A001 - mirrors nvtx::range
+    """Context-managed trace range (analog of ``raft::common::nvtx::range``)."""
+    if _enabled:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    else:
+        yield
+
+
+def annotate(name: str | None = None):
+    """Decorator form: wrap a public API function in a trace range."""
+
+    def deco(fn):
+        label = name or f"raft_tpu::{fn.__qualname__}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not _enabled:
+                return fn(*args, **kwargs)
+            with jax.profiler.TraceAnnotation(label):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco

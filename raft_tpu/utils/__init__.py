@@ -1,0 +1,63 @@
+"""Small integer/shape utilities shared across raft_tpu.
+
+TPU analog of the reference's ``raft/util/`` helpers (pow2_utils.cuh,
+integer_utils.hpp): alignment and tiling arithmetic used to size Pallas
+blocks and padded layouts.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = [
+    "cdiv",
+    "round_up_to",
+    "round_down_to",
+    "next_pow2",
+    "is_pow2",
+    "pad_to",
+    "LANES",
+    "SUBLANES_F32",
+    "SUBLANES_BF16",
+]
+
+# TPU register tiling: last dim is always 128 lanes; sublane count depends on
+# dtype (8 for f32, 16 for bf16, 32 for int8).
+LANES = 128
+SUBLANES_F32 = 8
+SUBLANES_BF16 = 16
+
+
+def cdiv(a: int, b: int) -> int:
+    """Ceiling division."""
+    return -(-a // b)
+
+
+def round_up_to(x: int, m: int) -> int:
+    """Round ``x`` up to the nearest multiple of ``m``."""
+    return cdiv(x, m) * m
+
+
+def round_down_to(x: int, m: int) -> int:
+    """Round ``x`` down to the nearest multiple of ``m``."""
+    return (x // m) * m
+
+
+def next_pow2(x: int) -> int:
+    """Smallest power of two >= x (x >= 1)."""
+    if x <= 1:
+        return 1
+    return 1 << (x - 1).bit_length()
+
+
+def is_pow2(x: int) -> bool:
+    return x > 0 and (x & (x - 1)) == 0
+
+
+def pad_to(x: int, m: int) -> int:
+    """Amount of padding needed to reach the next multiple of ``m``."""
+    return round_up_to(x, m) - x
+
+
+def log2i(x: int) -> int:
+    """Integer log2 of a power of two."""
+    return int(math.log2(x))

@@ -1,0 +1,37 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; per the project contract all
+sharding/collective code is exercised on `--xla_force_host_platform_device_count=8`
+CPU devices (the driver separately dry-run-compiles the multi-chip path).
+Env vars must be set before jax is imported anywhere.
+"""
+import os
+
+# NOTE: under the axon TPU tunnel the JAX_PLATFORMS *env var* is ignored;
+# only the in-process config switch reliably selects CPU. XLA_FLAGS must
+# still be set before jax initializes its backends.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+assert jax.device_count() == 8, "tests expect the 8-device virtual CPU mesh"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def res():
+    from raft_tpu.core import Resources
+
+    return Resources(seed=0)

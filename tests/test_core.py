@@ -1,0 +1,186 @@
+"""Core layer tests (analog of the reference's CORE_TEST suite)."""
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import (
+    Bitset,
+    KeyValuePair,
+    RaftError,
+    Resources,
+    device_resources_manager,
+    expects,
+    fail,
+    operators,
+    serialize,
+)
+from raft_tpu.core import interruptible
+from raft_tpu.utils import cdiv, next_pow2, round_up_to
+
+
+class TestUtils:
+    def test_cdiv(self):
+        assert cdiv(10, 3) == 4
+        assert cdiv(9, 3) == 3
+        assert cdiv(1, 128) == 1
+
+    def test_round_up(self):
+        assert round_up_to(100, 128) == 128
+        assert round_up_to(128, 128) == 128
+
+    def test_next_pow2(self):
+        assert next_pow2(1) == 1
+        assert next_pow2(3) == 4
+        assert next_pow2(64) == 64
+        assert next_pow2(65) == 128
+
+
+class TestErrors:
+    def test_expects_pass(self):
+        expects(True, "fine")
+
+    def test_expects_fail(self):
+        with pytest.raises(RaftError, match="bad value 3"):
+            expects(False, "bad value %d", 3)
+
+    def test_fail(self):
+        with pytest.raises(RaftError):
+            fail("boom")
+
+
+class TestResources:
+    def test_lazy_registry(self):
+        r = Resources()
+        calls = []
+        r.register("thing", lambda: calls.append(1) or "made")
+        assert r.has("thing")
+        assert not calls
+        assert r.get("thing") == "made"
+        assert r.get("thing") == "made"
+        assert len(calls) == 1
+
+    def test_unknown_resource(self):
+        with pytest.raises(RaftError):
+            Resources().get("nope")
+
+    def test_keys_differ(self):
+        r = Resources(seed=7)
+        k1, k2 = r.next_key(), r.next_key()
+        assert not np.array_equal(jax.random.key_data(k1), jax.random.key_data(k2))
+
+    def test_manager_pools(self):
+        a = device_resources_manager.get_device_resources(0)
+        b = device_resources_manager.get_device_resources(0)
+        assert a is b
+
+    def test_comms_injection(self):
+        r = Resources()
+        assert not r.has_comms()
+        r.set_comms("fake")
+        assert r.comms == "fake"
+
+
+class TestBitset:
+    def test_create_default_all_set(self):
+        bs = Bitset.create(70, default=True)
+        assert int(bs.count()) == 70
+        assert bool(bs.all())
+
+    def test_from_mask_roundtrip(self, rng):
+        mask = rng.random(1000) < 0.3
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        np.testing.assert_array_equal(np.asarray(bs.to_mask()), mask)
+        assert int(bs.count()) == int(mask.sum())
+
+    def test_test_and_set(self):
+        bs = Bitset.create(100, default=False)
+        bs = bs.set(jnp.array([3, 64, 99]))
+        assert bool(bs.test(3)) and bool(bs.test(64)) and bool(bs.test(99))
+        assert not bool(bs.test(4))
+        bs = bs.set(jnp.array([64]), False)
+        assert not bool(bs.test(64))
+        assert int(bs.count()) == 2
+
+    def test_flip(self):
+        bs = Bitset.create(33, default=False).flip()
+        assert int(bs.count()) == 33
+
+    def test_jit_through(self):
+        bs = Bitset.from_mask(jnp.arange(64) % 2 == 0)
+
+        @jax.jit
+        def f(b: Bitset):
+            return b.count()
+
+        assert int(f(bs)) == 32
+
+
+class TestOperators:
+    def test_argmin_op_tie_break(self):
+        k, v = operators.argmin_op(
+            (jnp.array(5), jnp.array(1.0)), (jnp.array(2), jnp.array(1.0))
+        )
+        assert int(k) == 2
+
+    def test_compose(self):
+        f = operators.compose_op(operators.sqrt_op, operators.sq_op)
+        assert float(f(jnp.float32(3.0))) == pytest.approx(3.0)
+
+
+class TestSerialize:
+    def test_scalar_roundtrip(self):
+        buf = io.BytesIO()
+        serialize.serialize_scalar(buf, 42, "<q")
+        serialize.serialize_scalar(buf, 2.5, "<d")
+        buf.seek(0)
+        assert serialize.deserialize_scalar(buf, "<q") == 42
+        assert serialize.deserialize_scalar(buf, "<d") == 2.5
+
+    def test_array_roundtrip(self, rng):
+        x = rng.standard_normal((17, 5)).astype(np.float32)
+        buf = io.BytesIO()
+        serialize.serialize_array(buf, jnp.asarray(x))
+        buf.seek(0)
+        np.testing.assert_array_equal(serialize.deserialize_array(buf), x)
+
+    def test_save_load_arrays(self, tmp_path, rng):
+        path = str(tmp_path / "index.raft")
+        arrays = {
+            "data": rng.standard_normal((8, 4)).astype(np.float32),
+            "ids": np.arange(8, dtype=np.int64),
+        }
+        meta = {"metric": "l2", "n": 8, "frac": 0.5, "trained": True}
+        serialize.save_arrays(path, "test_index", 3, meta, arrays)
+        kind, version, meta2, arrays2 = serialize.load_arrays(path, "test_index")
+        assert kind == "test_index" and version == 3
+        assert meta2 == meta
+        np.testing.assert_array_equal(arrays2["data"], arrays["data"])
+        np.testing.assert_array_equal(arrays2["ids"], arrays["ids"])
+
+    def test_kind_mismatch(self, tmp_path):
+        path = str(tmp_path / "x.raft")
+        serialize.save_arrays(path, "a", 1, {}, {})
+        with pytest.raises(ValueError):
+            serialize.load_arrays(path, "b")
+
+
+class TestInterruptible:
+    def test_cancel_then_check(self):
+        interruptible.cancel()
+        with pytest.raises(interruptible.InterruptedException):
+            interruptible.check()
+        interruptible.check()  # token reset after raise
+
+    def test_synchronize_value(self):
+        x = jnp.ones((4,))
+        out = interruptible.synchronize(x * 2)
+        np.testing.assert_array_equal(np.asarray(out), 2.0)
+
+
+class TestKvp:
+    def test_named_tuple(self):
+        p = KeyValuePair(jnp.array(1), jnp.array(0.5))
+        assert int(p.key) == 1
