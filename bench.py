@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Headline benchmark: prints ONE JSON line for the driver.
+
+Metric: brute-force kNN QPS on a SIFT-like synthetic workload (L2, k=10),
+the first BASELINE.md config. Will widen to IVF/CAGRA QPS@recall as those
+land. vs_baseline compares against a fixed reference throughput target.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from raft_tpu.neighbors import brute_force
+
+    n, d, nq, k = 100_000, 128, 10_000, 10
+    rng = np.random.default_rng(0)
+    dataset = jnp.asarray(rng.standard_normal((n, d), dtype=np.float32))
+    queries = jnp.asarray(rng.standard_normal((nq, d), dtype=np.float32))
+
+    index = brute_force.build(dataset, metric="sqeuclidean")
+    # warmup/compile
+    dist, idx = brute_force.search(index, queries[:256], k)
+    jax.block_until_ready((dist, idx))
+
+    t0 = time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        dist, idx = brute_force.search(index, queries, k)
+        jax.block_until_ready((dist, idx))
+    dt = (time.perf_counter() - t0) / reps
+    qps = nq / dt
+
+    # Reference point: RAFT brute-force on A100 is ~O(10k) QPS at this shape;
+    # use 10k QPS as the provisional baseline until the harness regenerates it.
+    baseline_qps = 10_000.0
+    print(json.dumps({
+        "metric": "brute_force_knn_qps_100k_d128_k10",
+        "value": round(qps, 2),
+        "unit": "queries/s",
+        "vs_baseline": round(qps / baseline_qps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

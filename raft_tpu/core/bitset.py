@@ -68,10 +68,13 @@ class Bitset:
         return self.words.at[-1].set(self.words[-1] & last_mask)
 
     def test(self, idx: jax.Array) -> jax.Array:
-        """Read bit(s) at ``idx`` (any integer array shape)."""
+        """Read bit(s) at ``idx`` (any integer array shape). Out-of-range
+        indices read as False rather than aliasing another bit (JAX clamps
+        OOB gathers, which would otherwise return garbage)."""
         idx = jnp.asarray(idx)
         word = self.words[idx // _BITS]
-        return ((word >> (idx % _BITS).astype(jnp.uint32)) & 1).astype(bool)
+        bit = ((word >> (idx % _BITS).astype(jnp.uint32)) & 1).astype(bool)
+        return bit & (idx >= 0) & (idx < self.n_bits)
 
     def set(self, idx: jax.Array, value: bool | jax.Array = True) -> "Bitset":
         """Functional bit set/clear; returns a new bitset (idx: scalar or 1-D).

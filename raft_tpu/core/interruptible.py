@@ -84,7 +84,10 @@ def synchronize(value=None):
     import jax
 
     if value is None:
+        # effect tokens don't cover plain computations; piggyback on PJRT's
+        # in-order execution by blocking on a freshly dispatched trivial op
         jax.effects_barrier()
+        jax.device_put(0, jax.devices()[0]).block_until_ready()
     else:
         jax.block_until_ready(value)
     check()

@@ -52,6 +52,7 @@ class _CallbackHandler(logging.Handler):
 
 
 _callback_handler: Optional[_CallbackHandler] = None
+_current_pattern = _DEFAULT_PATTERN
 
 
 def set_level(level: int) -> None:
@@ -65,18 +66,22 @@ def get_level() -> int:
 
 def set_pattern(pattern: str) -> None:
     """Set the log format (analog of ``logger::set_pattern``)."""
+    global _current_pattern
+    _current_pattern = pattern
     for h in logger.handlers:
         h.setFormatter(logging.Formatter(pattern))
 
 
 def set_callback(fn: Optional[Callable[[int, str], None]]) -> None:
-    """Install/remove a callback sink (analog of the spdlog callback sink)."""
+    """Install/remove a callback sink (analog of the spdlog callback sink).
+    The sink formats with the current pattern, like every other handler."""
     global _callback_handler
     if _callback_handler is not None:
         logger.removeHandler(_callback_handler)
         _callback_handler = None
     if fn is not None:
         _callback_handler = _CallbackHandler(fn)
+        _callback_handler.setFormatter(logging.Formatter(_current_pattern))
         logger.addHandler(_callback_handler)
 
 

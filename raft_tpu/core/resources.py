@@ -46,9 +46,14 @@ class Resources:
     ):
         self._factories: Dict[str, Callable[[], Any]] = {}
         self._store: Dict[str, Any] = {}
-        self._store["device"] = device
-        self._store["mesh"] = mesh
+        if device is not None:
+            self._store["device"] = device
+        if mesh is not None:
+            self._store["mesh"] = mesh
         self._store["workspace_bytes"] = workspace_bytes
+        # generic registry access resolves the device the same lazy way the
+        # .device property does, so both paths agree
+        self._factories.setdefault("device", lambda: jax.devices()[0])
         self._key = jax.random.key(seed)
         # device_resources_manager shares one instance across server threads;
         # key splitting is a read-modify-write and must be serialized.
@@ -74,11 +79,7 @@ class Resources:
     # -- convenience accessors -------------------------------------------
     @property
     def device(self) -> jax.Device:
-        d = self._store.get("device")
-        if d is None:
-            d = jax.devices()[0]
-            self._store["device"] = d
-        return d
+        return self.get("device")
 
     @property
     def mesh(self) -> Optional[jax.sharding.Mesh]:
@@ -107,10 +108,20 @@ class Resources:
     def has_comms(self) -> bool:
         return "comms" in self._store
 
-    def sync(self) -> None:
-        """Block until all queued device work is done (analog of
-        ``sync_stream``); useful around benchmarks."""
-        jax.effects_barrier()
+    def sync(self, value=None) -> None:
+        """Block until queued device work is done (analog of ``sync_stream``).
+
+        Prefer passing the array/pytree to wait on. With no value, a trivial
+        op is dispatched to this context's device and blocked on — PJRT
+        executes computations on a device in dispatch order, so its
+        completion implies everything queued earlier finished. (Effect tokens
+        alone don't cover ordinary computations.)
+        """
+        if value is not None:
+            jax.block_until_ready(value)
+        else:
+            jax.effects_barrier()
+            jax.device_put(0, self.device).block_until_ready()
 
 
 class DeviceResources(Resources):

@@ -1,0 +1,21 @@
+"""Pairwise distances, fused nearest-neighbor reductions, kernel gram.
+
+TPU-native analog of the reference's ``raft/distance/`` layer (SURVEY.md §2.4).
+"""
+from .distance_types import DistanceType, canonical_metric, is_min_close
+from .fused_l2_nn import fused_l2_nn_argmin, masked_l2_nn_argmin
+from .kernels import KernelParams, KernelType, gram_matrix
+from .pairwise import distance, pairwise_distance
+
+__all__ = [
+    "DistanceType",
+    "canonical_metric",
+    "is_min_close",
+    "fused_l2_nn_argmin",
+    "masked_l2_nn_argmin",
+    "KernelParams",
+    "KernelType",
+    "gram_matrix",
+    "distance",
+    "pairwise_distance",
+]

@@ -1,0 +1,54 @@
+"""Kernel gram matrices: analog of ``raft::distance::kernels``.
+
+Reference: raft/distance/kernels.cuh + detail/kernels/ (GramMatrix classes
+with KernelParams{type, degree, gamma, coef0}; dense and CSR inputs). Dense
+path here; the CSR path lives in raft_tpu.sparse once sparse containers land.
+All four kernels ride one MXU GEMM plus a fused epilogue.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import expects
+from .pairwise import pairwise_distance
+
+__all__ = ["KernelType", "KernelParams", "gram_matrix"]
+
+
+class KernelType(enum.Enum):
+    LINEAR = "linear"
+    POLYNOMIAL = "polynomial"
+    RBF = "rbf"
+    TANH = "tanh"
+
+
+@dataclasses.dataclass
+class KernelParams:
+    """Mirror of the reference KernelParams (detail/kernels/gram_matrix.cuh)."""
+
+    kernel: KernelType = KernelType.LINEAR
+    degree: int = 3
+    gamma: float = 1.0
+    coef0: float = 0.0
+
+
+def gram_matrix(x: jax.Array, y: jax.Array, params: KernelParams) -> jax.Array:
+    """Gram matrix K (m, n) between rows of x and y for the given kernel."""
+    expects(x.shape[1] == y.shape[1], "dim mismatch %s %s", x.shape, y.shape)
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    k = params.kernel if isinstance(params.kernel, KernelType) else KernelType(params.kernel)
+    if k is KernelType.LINEAR:
+        return x @ y.T
+    if k is KernelType.POLYNOMIAL:
+        return (params.gamma * (x @ y.T) + params.coef0) ** params.degree
+    if k is KernelType.TANH:
+        return jnp.tanh(params.gamma * (x @ y.T) + params.coef0)
+    if k is KernelType.RBF:
+        sq = pairwise_distance(x, y, "sqeuclidean")
+        return jnp.exp(-params.gamma * sq)
+    raise AssertionError(k)

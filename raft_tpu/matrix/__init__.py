@@ -1,0 +1,16 @@
+"""Matrix ops and batched top-k selection (SURVEY.md §2.5)."""
+from .ops import (
+    argmax, argmin, col_reverse, col_weighted_mean, eye, fill, gather,
+    gather_if, get_diagonal, invert_diagonal, l2_norm, linewise_op,
+    lower_triangular, print_matrix, row_reverse, row_weighted_mean, scatter,
+    set_diagonal, slice_matrix, sort_cols_per_row, upper_triangular,
+)
+from .select_k import SelectAlgo, select_k
+
+__all__ = [
+    "argmax", "argmin", "col_reverse", "col_weighted_mean", "eye", "fill",
+    "gather", "gather_if", "get_diagonal", "invert_diagonal", "l2_norm",
+    "linewise_op", "lower_triangular", "print_matrix", "row_reverse",
+    "row_weighted_mean", "scatter", "set_diagonal", "slice_matrix",
+    "sort_cols_per_row", "upper_triangular", "SelectAlgo", "select_k",
+]

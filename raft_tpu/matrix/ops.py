@@ -1,0 +1,132 @@
+"""Matrix operations: analog of the ``raft/matrix/`` op headers (SURVEY §2.5).
+
+Thin, jit-friendly wrappers: on TPU most of these are single XLA ops; they
+exist so consumers of the reference API find the same surface (argmax,
+col_sort, gather/scatter, linewise_op, slice, reverse, norm, init, diagonal,
+triangular, print).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import expects
+
+__all__ = [
+    "argmax", "argmin", "sort_cols_per_row", "gather", "gather_if", "scatter",
+    "linewise_op", "slice_matrix", "col_reverse", "row_reverse", "l2_norm",
+    "eye", "fill", "get_diagonal", "set_diagonal", "invert_diagonal",
+    "upper_triangular", "lower_triangular", "print_matrix",
+    "row_weighted_mean", "col_weighted_mean",
+]
+
+
+def argmax(m: jax.Array) -> jax.Array:
+    """Per-row argmax (matrix/argmax.cuh)."""
+    return jnp.argmax(m, axis=1).astype(jnp.int32)
+
+
+def argmin(m: jax.Array) -> jax.Array:
+    return jnp.argmin(m, axis=1).astype(jnp.int32)
+
+
+def sort_cols_per_row(m: jax.Array, ascending: bool = True):
+    """Sort each row, returning (sorted, source indices) (matrix/col_wise_sort.cuh)."""
+    idx = jnp.argsort(m if ascending else -m, axis=1)
+    return jnp.take_along_axis(m, idx, axis=1), idx.astype(jnp.int32)
+
+
+def gather(m: jax.Array, row_ids: jax.Array) -> jax.Array:
+    """Row gather (matrix/gather.cuh)."""
+    return jnp.take(m, row_ids, axis=0)
+
+
+def gather_if(m: jax.Array, row_ids: jax.Array, mask: jax.Array, fill_value=0.0):
+    """Row gather with a per-output mask; masked rows become fill_value."""
+    out = jnp.take(m, row_ids, axis=0)
+    return jnp.where(mask[:, None], out, jnp.asarray(fill_value, out.dtype))
+
+
+def scatter(m: jax.Array, row_ids: jax.Array, rows: jax.Array) -> jax.Array:
+    """Functional row scatter (matrix/scatter.cuh)."""
+    return m.at[row_ids].set(rows)
+
+
+def linewise_op(m: jax.Array, vec: jax.Array, along_rows: bool,
+                op: Callable[[jax.Array, jax.Array], jax.Array]) -> jax.Array:
+    """Broadcast a vector op along rows or columns (matrix/linewise_op.cuh)."""
+    if along_rows:  # vec has one entry per column
+        expects(vec.shape[0] == m.shape[1], "vec len %d != ncols %d", vec.shape[0], m.shape[1])
+        return op(m, vec[None, :])
+    expects(vec.shape[0] == m.shape[0], "vec len %d != nrows %d", vec.shape[0], m.shape[0])
+    return op(m, vec[:, None])
+
+
+def slice_matrix(m: jax.Array, row0: int, col0: int, row1: int, col1: int) -> jax.Array:
+    """Submatrix copy [row0:row1, col0:col1] (matrix/slice.cuh)."""
+    return m[row0:row1, col0:col1]
+
+
+def col_reverse(m: jax.Array) -> jax.Array:
+    return m[:, ::-1]
+
+
+def row_reverse(m: jax.Array) -> jax.Array:
+    return m[::-1]
+
+
+def l2_norm(m: jax.Array) -> jax.Array:
+    """Frobenius norm (matrix/norm.cuh)."""
+    return jnp.sqrt(jnp.sum(m.astype(jnp.float32) ** 2))
+
+
+def eye(n: int, m: Optional[int] = None, dtype=jnp.float32) -> jax.Array:
+    return jnp.eye(n, m, dtype=dtype)
+
+
+def fill(shape, value, dtype=jnp.float32) -> jax.Array:
+    return jnp.full(shape, value, dtype=dtype)
+
+
+def get_diagonal(m: jax.Array) -> jax.Array:
+    return jnp.diagonal(m)
+
+
+def set_diagonal(m: jax.Array, d: jax.Array) -> jax.Array:
+    n = min(m.shape[0], m.shape[1])
+    i = jnp.arange(n)
+    return m.at[i, i].set(d[:n])
+
+
+def invert_diagonal(m: jax.Array) -> jax.Array:
+    n = min(m.shape[0], m.shape[1])
+    i = jnp.arange(n)
+    return m.at[i, i].set(1.0 / m[i, i])
+
+
+def upper_triangular(m: jax.Array, k: int = 0) -> jax.Array:
+    return jnp.triu(m, k)
+
+
+def lower_triangular(m: jax.Array, k: int = 0) -> jax.Array:
+    return jnp.tril(m, k)
+
+
+def row_weighted_mean(m: jax.Array, weights: jax.Array) -> jax.Array:
+    """Weighted mean of each row; ``weights`` has one entry per column."""
+    return (m @ weights) / jnp.sum(weights)
+
+
+def col_weighted_mean(m: jax.Array, weights: jax.Array) -> jax.Array:
+    """Weighted mean of each column; ``weights`` has one entry per row."""
+    return (weights @ m) / jnp.sum(weights)
+
+
+def print_matrix(m: jax.Array, name: str = "") -> str:
+    """Host-side pretty print (matrix/print.cuh)."""
+    s = f"{name} {tuple(m.shape)} {m.dtype}\n{np.asarray(m)}"
+    print(s)
+    return s

@@ -1,0 +1,10 @@
+"""ANN vector search: the crown jewels (SURVEY.md §2.3).
+
+Families mirror the reference: ``brute_force`` (exact), ``ivf_flat``,
+``ivf_pq``, ``cagra`` (+ ``nn_descent`` builder), ``refine``, ``hnsw``
+(CPU interop), ``ball_cover``, ``epsilon_neighborhood``; sample filters in
+``filters``.
+"""
+from . import ann_types, brute_force
+
+__all__ = ["ann_types", "brute_force"]

@@ -1,0 +1,27 @@
+"""Base ANN parameter types: analog of ``raft/neighbors/ann_types.hpp``.
+
+The reference's POD param structs (index_params{metric, metric_arg,
+add_data_on_build} / search_params) become frozen dataclasses that every
+index family extends.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from ..distance.distance_types import DistanceType
+
+__all__ = ["IndexParams", "SearchParams"]
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """Common build-time parameters (ann_types.hpp:index_params)."""
+
+    metric: DistanceType | str = DistanceType.L2Expanded
+    metric_arg: float = 2.0
+    add_data_on_build: bool = True
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """Common search-time parameters (ann_types.hpp:search_params)."""

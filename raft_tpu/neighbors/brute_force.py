@@ -1,0 +1,213 @@
+"""Exact brute-force kNN: analog of ``raft::neighbors::brute_force``.
+
+Reference: raft/neighbors/brute_force-inl.cuh with the tiled engine in
+detail/knn_brute_force.cuh:61 (`tiled_brute_force_knn`: row×col tiles of
+pairwise distance GEMM + per-tile select_k + cross-tile merge) and the
+multi-shard merge in detail/knn_merge_parts.cuh:172.
+
+TPU design: one `lax.scan` over dataset tiles. Each step computes a
+(n_queries, tile) distance block — the cross term on the MXU for expanded
+metrics — takes the tile's top-k, and merges it into the running top-k
+(concat + re-select, the `knn_merge_parts` trick applied streamingly).
+XLA double-buffers the HBM tile reads against compute, which is exactly the
+role the reference's stream-pool round-robin plays (knn_brute_force.cuh:476);
+no NxM distance matrix ever exists in HBM.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tracing
+from ..core.bitset import Bitset
+from ..core.errors import expects
+from ..core.serialize import load_arrays, save_arrays
+from ..distance.distance_types import DistanceType, canonical_metric, is_min_close
+from ..distance.pairwise import _ELEMENTWISE, _elementwise_tile, _haversine
+from ..matrix.select_k import select_k
+from ..utils import round_up_to
+
+__all__ = ["Index", "build", "search", "knn", "knn_merge_parts", "save", "load"]
+
+_SERIAL_VERSION = 1
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Index:
+    """Brute-force index: the dataset plus precomputed row norms
+    (brute_force_types.hpp:50 stores exactly these)."""
+
+    dataset: jax.Array          # (n, d) f32
+    norms: Optional[jax.Array]  # (n,) squared L2 norms, for expanded metrics
+    metric: DistanceType
+    metric_arg: float = 2.0
+
+    @property
+    def size(self) -> int:
+        return self.dataset.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shape[1]
+
+    def tree_flatten(self):
+        return (self.dataset, self.norms), (self.metric, self.metric_arg)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1])
+
+
+@tracing.annotate("raft_tpu::brute_force::build")
+def build(dataset: jax.Array, metric="sqeuclidean", metric_arg: float = 2.0) -> Index:
+    """Build = store dataset + precompute norms (no training)."""
+    dataset = jnp.asarray(dataset, jnp.float32)
+    expects(dataset.ndim == 2, "dataset must be (n, d)")
+    mt = canonical_metric(metric)
+    norms = None
+    if mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+              DistanceType.CosineExpanded):
+        norms = jnp.sum(dataset * dataset, axis=1)
+    return Index(dataset, norms, mt, metric_arg)
+
+
+def _tile_distances(q, q_norm, tile, tile_norm, mt, metric_arg):
+    """Distance block (n_queries, tile_rows) for one dataset tile."""
+    if mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
+        d = jnp.maximum(q_norm[:, None] + tile_norm[None, :] - 2.0 * (q @ tile.T), 0.0)
+        return jnp.sqrt(d) if mt is DistanceType.L2SqrtExpanded else d
+    if mt is DistanceType.CosineExpanded:
+        qn = jnp.sqrt(jnp.maximum(q_norm, 1e-30))
+        tn = jnp.sqrt(jnp.maximum(tile_norm, 1e-30))
+        return 1.0 - (q @ tile.T) / (qn[:, None] * tn[None, :])
+    if mt is DistanceType.InnerProduct:
+        return q @ tile.T
+    if mt is DistanceType.Haversine:
+        return _haversine(q, tile)
+    if mt in (DistanceType.CorrelationExpanded, DistanceType.HellingerExpanded,
+              DistanceType.RusselRaoExpanded):
+        from ..distance.pairwise import _EXPANDED
+        return _EXPANDED[mt](q, tile)
+    expects(mt in _ELEMENTWISE, "metric %s unsupported by brute force", mt.name)
+    return _elementwise_tile(q, tile, mt, metric_arg)
+
+
+@tracing.annotate("raft_tpu::brute_force::search")
+def search(
+    index: Index,
+    queries: jax.Array,
+    k: int,
+    tile_size: int = 8192,
+    filter: Optional[Bitset] = None,  # noqa: A002 - mirrors reference name
+    valid_rows: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """k nearest neighbors of each query → (distances (m, k), indices (m, k)).
+
+    ``filter``: optional sample bitset; cleared bits are excluded
+    (the reference's bitset_filter applied to brute force).
+    ``valid_rows``: optional traced scalar; rows at index >= valid_rows are
+    excluded. Used by the sharded path where the per-shard row count is only
+    known inside shard_map (padding shards).
+    """
+    q = jnp.asarray(queries, jnp.float32)
+    expects(q.ndim == 2 and q.shape[1] == index.dim,
+            "queries must be (m, %d), got %s", index.dim, q.shape)
+    n = index.size
+    expects(0 < k <= n, "k=%d out of range for index of size %d", k, n)
+    mt = index.metric
+    select_min = is_min_close(mt)
+
+    tile = min(tile_size, round_up_to(n, 128))
+    n_pad = round_up_to(n, tile)
+    data = jnp.pad(index.dataset, ((0, n_pad - n), (0, 0)))
+    norms = index.norms
+    if norms is None:
+        norms = jnp.zeros((n,), jnp.float32)
+    norms_p = jnp.pad(norms, (0, n_pad - n))
+    n_tiles = n_pad // tile
+    data_t = data.reshape(n_tiles, tile, index.dim)
+    norms_t = norms_p.reshape(n_tiles, tile)
+
+    q_norm = jnp.sum(q * q, axis=1)
+    bad = jnp.inf if select_min else -jnp.inf
+    col = jnp.arange(tile, dtype=jnp.int32)
+    mask_bits = filter.to_mask() if filter is not None else None
+    if mask_bits is not None:
+        mask_t = jnp.pad(mask_bits, (0, n_pad - n)).reshape(n_tiles, tile)
+    kt = min(k, tile)
+
+    def step(carry, inp):
+        best_val, best_idx = carry  # (m, k), (m, k)
+        if mask_bits is not None:
+            tile_data, tile_norm, base, tmask = inp
+        else:
+            tile_data, tile_norm, base = inp
+            tmask = None
+        d = _tile_distances(q, q_norm, tile_data, tile_norm, mt, index.metric_arg)
+        limit = n if valid_rows is None else jnp.minimum(valid_rows, n)
+        valid = (base + col) < limit
+        if tmask is not None:
+            valid = valid & tmask
+        d = jnp.where(valid[None, :], d, bad)
+        t_val, t_loc = select_k(d, kt, select_min=select_min)
+        t_idx = t_loc + base
+        merged_val = jnp.concatenate([best_val, t_val], axis=1)
+        merged_idx = jnp.concatenate([best_idx, t_idx], axis=1)
+        new_val, loc = select_k(merged_val, k, select_min=select_min)
+        new_idx = jnp.take_along_axis(merged_idx, loc, axis=1)
+        return (new_val, new_idx), None
+
+    init = (jnp.full((q.shape[0], k), bad, jnp.float32),
+            jnp.full((q.shape[0], k), -1, jnp.int32))
+    bases = jnp.arange(n_tiles, dtype=jnp.int32) * tile
+    xs = (data_t, norms_t, bases, mask_t) if mask_bits is not None else (data_t, norms_t, bases)
+    (val, idx), _ = jax.lax.scan(step, init, xs)
+    return val, idx
+
+
+def knn(dataset, queries, k, metric="sqeuclidean", metric_arg: float = 2.0,
+        tile_size: int = 8192):
+    """One-shot build+search (the reference's free-function ``knn``)."""
+    return search(build(dataset, metric, metric_arg), queries, k, tile_size)
+
+
+def knn_merge_parts(
+    part_distances: jax.Array,
+    part_indices: jax.Array,
+    select_min: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Merge per-shard top-k results: (p, m, k) → (m, k).
+
+    Analog of detail/knn_merge_parts.cuh:172, used by the sharded (MNMG)
+    search path where each shard holds globally-valid indices.
+    """
+    p, m, k = part_distances.shape
+    d = jnp.transpose(part_distances, (1, 0, 2)).reshape(m, p * k)
+    i = jnp.transpose(part_indices, (1, 0, 2)).reshape(m, p * k)
+    val, loc = select_k(d, k, select_min=select_min)
+    return val, jnp.take_along_axis(i, loc, axis=1)
+
+
+def save(index: Index, path) -> None:
+    """Serialize (analog of brute_force_serialize.cuh)."""
+    arrays = {"dataset": index.dataset}
+    if index.norms is not None:
+        arrays["norms"] = index.norms
+    save_arrays(path, "brute_force", _SERIAL_VERSION,
+                {"metric": index.metric.value, "metric_arg": float(index.metric_arg)},
+                arrays)
+
+
+def load(path) -> Index:
+    _, version, meta, arrays = load_arrays(path, "brute_force")
+    expects(version == _SERIAL_VERSION, "unsupported serialization version %d", version)
+    return Index(
+        jnp.asarray(arrays["dataset"]),
+        jnp.asarray(arrays["norms"]) if "norms" in arrays else None,
+        DistanceType(meta["metric"]),
+        meta["metric_arg"],
+    )

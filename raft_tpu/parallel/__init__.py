@@ -1,0 +1,4 @@
+"""Multi-chip (MNMG-analog) sharded algorithms over jax.sharding meshes."""
+from . import sharded_knn
+
+__all__ = ["sharded_knn"]

@@ -1,0 +1,44 @@
+"""Shared ANN test helpers: NumPy brute-force oracle + recall evaluation.
+
+Analog of the reference's cpp/test/neighbors/ann_utils.cuh
+(calc_recall/eval_neighbours) and the pure-NumPy oracle in
+python/pylibraft/pylibraft/test/ann_utils.py.
+"""
+import numpy as np
+
+
+def naive_knn(dataset: np.ndarray, queries: np.ndarray, k: int,
+              metric: str = "sqeuclidean"):
+    """Exact reference kNN on host; returns (distances, indices)."""
+    if metric in ("sqeuclidean", "euclidean", "l2_expanded"):
+        d = (
+            (queries**2).sum(1)[:, None]
+            + (dataset**2).sum(1)[None, :]
+            - 2.0 * queries @ dataset.T
+        )
+        d = np.maximum(d, 0)
+        if metric == "euclidean":
+            d = np.sqrt(d)
+    elif metric == "inner_product":
+        d = -(queries @ dataset.T)  # negate: sort ascending = best first
+    elif metric == "cosine":
+        qn = np.linalg.norm(queries, axis=1, keepdims=True)
+        dn = np.linalg.norm(dataset, axis=1, keepdims=True)
+        d = 1 - (queries @ dataset.T) / np.maximum(qn * dn.T, 1e-30)
+    else:
+        raise ValueError(metric)
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    dist = np.take_along_axis(d, idx, axis=1)
+    if metric == "inner_product":
+        dist = -dist
+    return dist, idx
+
+
+def calc_recall(found: np.ndarray, expected: np.ndarray) -> float:
+    """Fraction of expected neighbors present in found (per row, averaged) —
+    the eval_recall metric from ann_utils.cuh:129."""
+    assert found.shape == expected.shape
+    hits = sum(
+        len(set(found[i]) & set(expected[i])) for i in range(found.shape[0])
+    )
+    return hits / found.size

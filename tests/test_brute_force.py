@@ -1,0 +1,102 @@
+"""Brute-force kNN tests (analog of NEIGHBORS_ANN_BRUTE_FORCE_TEST)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ann_utils import calc_recall, naive_knn
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import brute_force
+
+
+def _data(rng, n=5000, d=32, m=64):
+    return (
+        rng.standard_normal((n, d)).astype(np.float32),
+        rng.standard_normal((m, d)).astype(np.float32),
+    )
+
+
+class TestBruteForce:
+    @pytest.mark.parametrize("metric", ["sqeuclidean", "euclidean", "inner_product", "cosine"])
+    def test_exact_vs_oracle(self, rng, metric):
+        data, q = _data(rng)
+        dist, idx = brute_force.knn(data, q, k=10, metric=metric, tile_size=1024)
+        want_dist, want_idx = naive_knn(data, q, 10, metric)
+        assert calc_recall(np.asarray(idx), want_idx) > 0.999
+        np.testing.assert_allclose(np.asarray(dist), want_dist, rtol=1e-3, atol=1e-3)
+
+    def test_single_tile(self, rng):
+        data, q = _data(rng, n=500)
+        dist, idx = brute_force.knn(data, q, k=5, tile_size=8192)
+        _, want_idx = naive_knn(data, q, 5)
+        np.testing.assert_array_equal(np.asarray(idx), want_idx)
+
+    def test_n_not_multiple_of_tile(self, rng):
+        data, q = _data(rng, n=1337)
+        dist, idx = brute_force.knn(data, q, k=7, tile_size=512)
+        _, want_idx = naive_knn(data, q, 7)
+        assert calc_recall(np.asarray(idx), want_idx) > 0.999
+
+    def test_k_larger_than_tile(self, rng):
+        data, q = _data(rng, n=1000, m=8)
+        dist, idx = brute_force.knn(data, q, k=200, tile_size=128)
+        _, want_idx = naive_knn(data, q, 200)
+        assert calc_recall(np.asarray(idx), want_idx) > 0.999
+
+    def test_elementwise_metric(self, rng):
+        from scipy.spatial import distance as sp
+        data, q = _data(rng, n=800, m=16, d=8)
+        dist, idx = brute_force.knn(data, q, k=5, metric="l1", tile_size=256)
+        d = sp.cdist(q, data, "cityblock")
+        want = np.argsort(d, 1)[:, :5]
+        assert calc_recall(np.asarray(idx), want) > 0.99
+
+    def test_filter(self, rng):
+        data, q = _data(rng, n=1000, m=16)
+        # exclude the true top-1 of each query, expect the former #2 as new #1
+        _, base_idx = naive_knn(data, q, 2)
+        mask = np.ones(1000, bool)
+        mask[base_idx[:, 0]] = False
+        filt = Bitset.from_mask(jnp.asarray(mask))
+        _, idx = brute_force.search(brute_force.build(data), q, k=1,
+                                    tile_size=256, filter=filt)
+        got = np.asarray(idx)[:, 0]
+        # each query's result must be its oracle #2 unless #2 was also excluded
+        for i in range(16):
+            if mask[base_idx[i, 1]]:
+                assert got[i] == base_idx[i, 1]
+
+    def test_jit_search(self, rng):
+        data, q = _data(rng, n=512, m=8)
+        index = brute_force.build(data)
+        fn = jax.jit(lambda qq: brute_force.search(index, qq, 3, tile_size=256))
+        dist, idx = fn(jnp.asarray(q))
+        _, want_idx = naive_knn(data, q, 3)
+        np.testing.assert_array_equal(np.asarray(idx), want_idx)
+
+    def test_save_load(self, tmp_path, rng):
+        data, q = _data(rng, n=300, m=4)
+        index = brute_force.build(data, metric="cosine")
+        brute_force.save(index, tmp_path / "bf.raft")
+        loaded = brute_force.load(tmp_path / "bf.raft")
+        assert loaded.metric == index.metric
+        d1, i1 = brute_force.search(index, q, 5)
+        d2, i2 = brute_force.search(loaded, q, 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_merge_parts(self, rng):
+        # two shards of one dataset must merge to the global answer
+        data, q = _data(rng, n=1000, m=16)
+        d0, i0 = brute_force.knn(data[:500], q, k=8, tile_size=256)
+        d1, i1 = brute_force.knn(data[500:], q, k=8, tile_size=256)
+        i1 = i1 + 500
+        dist, idx = brute_force.knn_merge_parts(
+            jnp.stack([d0, d1]), jnp.stack([i0, i1]))
+        _, want_idx = naive_knn(data, q, 8)
+        assert calc_recall(np.asarray(idx), want_idx) > 0.999
+
+    def test_bad_query_dim(self, rng):
+        from raft_tpu.core import RaftError
+        data, _ = _data(rng, n=100)
+        with pytest.raises(RaftError):
+            brute_force.search(brute_force.build(data), np.ones((4, 999), np.float32), 3)

@@ -1,0 +1,203 @@
+"""Distance tests vs SciPy oracle (analog of DISTANCE_TEST, which compares
+CUDA kernels against a simple reference kernel — cpp/test/distance/distance_base.cuh)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.spatial import distance as sp
+
+from raft_tpu.distance import (
+    DistanceType,
+    KernelParams,
+    KernelType,
+    canonical_metric,
+    fused_l2_nn_argmin,
+    gram_matrix,
+    is_min_close,
+    masked_l2_nn_argmin,
+    pairwise_distance,
+)
+
+SCIPY_METRICS = [
+    ("sqeuclidean", "sqeuclidean", {}),
+    ("euclidean", "euclidean", {}),
+    ("l2_unexpanded", "sqeuclidean", {}),
+    ("l2_sqrt_unexpanded", "euclidean", {}),
+    ("cosine", "cosine", {}),
+    ("l1", "cityblock", {}),
+    ("linf", "chebyshev", {}),
+    ("canberra", "canberra", {}),
+    ("minkowski", "minkowski", {"p": 3.0}),
+    ("correlation", "correlation", {}),
+    ("braycurtis", "braycurtis", {}),
+    ("jensenshannon", "jensenshannon", {}),
+]
+
+
+def _data(rng, m=33, n=47, d=24, positive=False):
+    x = rng.standard_normal((m, d)).astype(np.float32)
+    y = rng.standard_normal((n, d)).astype(np.float32)
+    if positive:
+        x, y = np.abs(x) + 0.01, np.abs(y) + 0.01
+        x /= x.sum(1, keepdims=True)
+        y /= y.sum(1, keepdims=True)
+    return x, y
+
+
+@pytest.mark.parametrize("ours,scipy_name,kw", SCIPY_METRICS)
+def test_pairwise_vs_scipy(rng, ours, scipy_name, kw):
+    positive = scipy_name in ("jensenshannon",)
+    x, y = _data(rng, positive=positive)
+    arg = kw.get("p", 2.0)
+    got = np.asarray(pairwise_distance(x, y, ours, metric_arg=arg))
+    want = sp.cdist(x, y, scipy_name, **kw)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_inner_product(rng):
+    x, y = _data(rng)
+    got = np.asarray(pairwise_distance(x, y, "inner_product"))
+    np.testing.assert_allclose(got, x @ y.T, rtol=1e-4, atol=1e-4)
+    assert not is_min_close("inner_product")
+    assert is_min_close("euclidean")
+
+
+def test_hamming(rng):
+    x = (rng.random((20, 32)) < 0.5).astype(np.float32)
+    y = (rng.random((15, 32)) < 0.5).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, "hamming"))
+    np.testing.assert_allclose(got, sp.cdist(x, y, "hamming"), rtol=1e-5, atol=1e-5)
+
+
+def test_russelrao(rng):
+    x = (rng.random((20, 32)) < 0.5).astype(np.float32)
+    y = (rng.random((15, 32)) < 0.5).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, "russelrao"))
+    # (d - <x,y>) / d — computed directly (scipy dropped boolean metrics)
+    want = (x.shape[1] - x @ y.T) / x.shape[1]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_kl_divergence(rng):
+    x, y = _data(rng, positive=True)
+    got = np.asarray(pairwise_distance(x, y, "kl_divergence"))
+    want = np.array([[np.sum(xi * np.log(xi / yj)) for yj in y] for xi in x])
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_hellinger(rng):
+    x, y = _data(rng, positive=True)
+    got = np.asarray(pairwise_distance(x, y, "hellinger"))
+    want = np.sqrt(np.maximum(0, 1 - np.sqrt(x) @ np.sqrt(y).T))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_haversine(rng):
+    x = (rng.random((10, 2)) - 0.5) * np.array([np.pi, 2 * np.pi])
+    y = (rng.random((8, 2)) - 0.5) * np.array([np.pi, 2 * np.pi])
+    got = np.asarray(pairwise_distance(x.astype(np.float32), y.astype(np.float32), "haversine"))
+    lat1, lon1 = x[:, None, 0], x[:, None, 1]
+    lat2, lon2 = y[None, :, 0], y[None, :, 1]
+    a = np.sin((lat2 - lat1) / 2) ** 2 + np.cos(lat1) * np.cos(lat2) * np.sin((lon2 - lon1) / 2) ** 2
+    want = 2 * np.arcsin(np.sqrt(a))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_tiled_path_matches_single_tile(rng):
+    # Force the elementwise engine to tile by using a big-ish input
+    from raft_tpu.distance import pairwise as pw
+    x, y = _data(rng, m=257, n=129, d=8)
+    old = pw._TILE_BUDGET_BYTES
+    pw._TILE_BUDGET_BYTES = 64 * 1024  # force multi-tile
+    try:
+        got = np.asarray(pairwise_distance(x, y, "l1"))
+    finally:
+        pw._TILE_BUDGET_BYTES = old
+    np.testing.assert_allclose(got, sp.cdist(x, y, "cityblock"), rtol=1e-3, atol=1e-3)
+
+
+def test_unknown_metric():
+    with pytest.raises(ValueError, match="unknown distance metric"):
+        pairwise_distance(np.ones((2, 2)), np.ones((2, 2)), "nope")
+
+
+def test_canonical_enum_passthrough():
+    assert canonical_metric(DistanceType.L1) is DistanceType.L1
+
+
+class TestFusedL2NN:
+    def test_matches_bruteforce(self, rng):
+        x, y = _data(rng, m=100, n=3000, d=16)
+        idx, val = fused_l2_nn_argmin(x, y, tile_n=256)
+        d = sp.cdist(x, y, "sqeuclidean")
+        np.testing.assert_array_equal(np.asarray(idx), d.argmin(1))
+        np.testing.assert_allclose(np.asarray(val), d.min(1), rtol=1e-3, atol=1e-3)
+
+    def test_sqrt(self, rng):
+        x, y = _data(rng, m=10, n=50, d=4)
+        _, val = fused_l2_nn_argmin(x, y, sqrt=True)
+        d = sp.cdist(x, y, "euclidean")
+        np.testing.assert_allclose(np.asarray(val), d.min(1), rtol=1e-3, atol=1e-3)
+
+    def test_n_not_multiple_of_tile(self, rng):
+        x, y = _data(rng, m=7, n=1001, d=8)
+        idx, _ = fused_l2_nn_argmin(x, y, tile_n=128)
+        d = sp.cdist(x, y, "sqeuclidean")
+        np.testing.assert_array_equal(np.asarray(idx), d.argmin(1))
+
+    def test_duplicate_points_tie_break(self):
+        x = np.zeros((3, 4), np.float32)
+        y = np.zeros((10, 4), np.float32)  # all equidistant
+        idx, val = fused_l2_nn_argmin(x, y)
+        np.testing.assert_array_equal(np.asarray(idx), 0)
+
+
+class TestMaskedNN:
+    def test_pair_mask(self, rng):
+        x, y = _data(rng, m=20, n=30, d=8)
+        adj = rng.random((20, 30)) < 0.3
+        adj[5] = False  # row with no neighbors
+        idx, val = masked_l2_nn_argmin(x, y, jnp.asarray(adj))
+        d = sp.cdist(x, y, "sqeuclidean")
+        d_masked = np.where(adj, d, np.inf)
+        want_idx = np.where(np.isfinite(d_masked.min(1)), d_masked.argmin(1), -1)
+        np.testing.assert_array_equal(np.asarray(idx), want_idx)
+        assert np.asarray(idx)[5] == -1 and np.isinf(np.asarray(val)[5])
+
+    def test_group_mask(self, rng):
+        x, y = _data(rng, m=10, n=30, d=8)
+        group_idxs = np.array([10, 20, 30])  # 3 groups of 10 columns
+        adj = rng.random((10, 3)) < 0.5
+        idx, val = masked_l2_nn_argmin(x, y, jnp.asarray(adj), jnp.asarray(group_idxs))
+        full = np.zeros((10, 30), bool)
+        starts = [0, 10, 20]
+        for g in range(3):
+            full[:, starts[g]:group_idxs[g]] = adj[:, g][:, None]
+        d = np.where(full, sp.cdist(x, y, "sqeuclidean"), np.inf)
+        want_idx = np.where(np.isfinite(d.min(1)), d.argmin(1), -1)
+        np.testing.assert_array_equal(np.asarray(idx), want_idx)
+
+
+class TestGram:
+    def test_linear(self, rng):
+        x, y = _data(rng)
+        got = np.asarray(gram_matrix(x, y, KernelParams(KernelType.LINEAR)))
+        np.testing.assert_allclose(got, x @ y.T, rtol=1e-4, atol=1e-4)
+
+    def test_poly(self, rng):
+        x, y = _data(rng, d=8)
+        p = KernelParams(KernelType.POLYNOMIAL, degree=2, gamma=0.5, coef0=1.0)
+        got = np.asarray(gram_matrix(x, y, p))
+        np.testing.assert_allclose(got, (0.5 * x @ y.T + 1.0) ** 2, rtol=1e-3, atol=1e-3)
+
+    def test_rbf(self, rng):
+        x, y = _data(rng, d=8)
+        p = KernelParams(KernelType.RBF, gamma=0.1)
+        got = np.asarray(gram_matrix(x, y, p))
+        want = np.exp(-0.1 * sp.cdist(x, y, "sqeuclidean"))
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_tanh(self, rng):
+        x, y = _data(rng, d=8)
+        p = KernelParams(KernelType.TANH, gamma=0.01, coef0=0.5)
+        got = np.asarray(gram_matrix(x, y, p))
+        np.testing.assert_allclose(got, np.tanh(0.01 * x @ y.T + 0.5), rtol=1e-3, atol=1e-3)

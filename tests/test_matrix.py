@@ -1,0 +1,109 @@
+"""Matrix + select_k tests (analog of MATRIX_TEST / MATRIX_SELECT_TEST)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import matrix
+from raft_tpu.matrix import SelectAlgo, select_k
+
+
+class TestSelectK:
+    @pytest.mark.parametrize("algo", ["topk", "radix"])
+    @pytest.mark.parametrize("select_min", [True, False])
+    def test_vs_numpy(self, rng, algo, select_min):
+        v = rng.standard_normal((13, 300)).astype(np.float32)
+        k = 7
+        vals, idxs = select_k(jnp.asarray(v), k, select_min=select_min, algo=algo)
+        vals, idxs = np.asarray(vals), np.asarray(idxs)
+        order = np.sort(v, axis=1)
+        want = order[:, :k] if select_min else order[:, ::-1][:, :k]
+        np.testing.assert_allclose(vals, want, rtol=1e-5, atol=1e-6)
+        # indices recover the values
+        np.testing.assert_allclose(np.take_along_axis(v, idxs, axis=1), vals,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_index_passthrough(self, rng):
+        v = rng.standard_normal((4, 50)).astype(np.float32)
+        base = jnp.arange(1000, 1050, dtype=jnp.int32)
+        ids = jnp.broadcast_to(base, (4, 50))
+        _, idxs = select_k(jnp.asarray(v), 3, indices=ids)
+        want = np.argsort(v, axis=1)[:, :3] + 1000
+        np.testing.assert_array_equal(np.asarray(idxs), want)
+
+    def test_k_equals_n(self, rng):
+        v = rng.standard_normal((2, 16)).astype(np.float32)
+        vals, _ = select_k(jnp.asarray(v), 16)
+        np.testing.assert_allclose(np.asarray(vals), np.sort(v, 1), rtol=1e-6)
+
+    def test_k_out_of_range(self):
+        from raft_tpu.core import RaftError
+        with pytest.raises(RaftError):
+            select_k(jnp.ones((2, 4)), 5)
+
+    def test_radix_all_equal_rows(self):
+        v = jnp.ones((3, 100))
+        vals, idxs = select_k(v, 5, algo=SelectAlgo.RADIX)
+        np.testing.assert_allclose(np.asarray(vals), 1.0)
+
+    def test_radix_large_row(self, rng):
+        v = rng.standard_normal((2, 50_000)).astype(np.float32)
+        vals, _ = select_k(jnp.asarray(v), 10, algo="radix")
+        np.testing.assert_allclose(np.asarray(vals), np.sort(v, 1)[:, :10],
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestOps:
+    def test_argmax_argmin(self, rng):
+        m = rng.standard_normal((5, 9)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(matrix.argmax(jnp.asarray(m))), m.argmax(1))
+        np.testing.assert_array_equal(np.asarray(matrix.argmin(jnp.asarray(m))), m.argmin(1))
+
+    def test_sort_cols(self, rng):
+        m = rng.standard_normal((4, 6)).astype(np.float32)
+        s, idx = matrix.sort_cols_per_row(jnp.asarray(m))
+        np.testing.assert_allclose(np.asarray(s), np.sort(m, 1), rtol=1e-6)
+        np.testing.assert_allclose(np.take_along_axis(m, np.asarray(idx), 1), np.sort(m, 1), rtol=1e-6)
+
+    def test_gather_scatter(self, rng):
+        m = rng.standard_normal((6, 3)).astype(np.float32)
+        ids = np.array([4, 0, 2])
+        g = matrix.gather(jnp.asarray(m), jnp.asarray(ids))
+        np.testing.assert_array_equal(np.asarray(g), m[ids])
+        s = matrix.scatter(jnp.asarray(m), jnp.asarray(ids), jnp.zeros((3, 3)))
+        assert np.asarray(s)[ids].sum() == 0
+
+    def test_gather_if(self, rng):
+        m = rng.standard_normal((6, 3)).astype(np.float32)
+        ids = jnp.array([0, 1, 2])
+        mask = jnp.array([True, False, True])
+        g = np.asarray(matrix.gather_if(jnp.asarray(m), ids, mask, fill_value=-1.0))
+        np.testing.assert_array_equal(g[1], -1.0)
+        np.testing.assert_array_equal(g[0], m[0])
+
+    def test_linewise(self, rng):
+        m = jnp.asarray(rng.standard_normal((4, 6)).astype(np.float32))
+        v = jnp.arange(6, dtype=jnp.float32)
+        out = matrix.linewise_op(m, v, along_rows=True, op=lambda a, b: a + b)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(m) + np.arange(6), rtol=1e-6)
+
+    def test_diag_and_triangles(self):
+        m = jnp.asarray(np.arange(16, dtype=np.float32).reshape(4, 4))
+        d = matrix.get_diagonal(m)
+        np.testing.assert_array_equal(np.asarray(d), [0, 5, 10, 15])
+        m2 = matrix.set_diagonal(m, jnp.zeros(4))
+        assert np.trace(np.asarray(m2)) == 0
+        assert np.allclose(np.asarray(matrix.upper_triangular(m)), np.triu(np.asarray(m)))
+
+    def test_reverse_slice_norm(self, rng):
+        m = rng.standard_normal((5, 7)).astype(np.float32)
+        jm = jnp.asarray(m)
+        np.testing.assert_array_equal(np.asarray(matrix.col_reverse(jm)), m[:, ::-1])
+        np.testing.assert_array_equal(np.asarray(matrix.row_reverse(jm)), m[::-1])
+        np.testing.assert_array_equal(np.asarray(matrix.slice_matrix(jm, 1, 2, 4, 6)), m[1:4, 2:6])
+        assert float(matrix.l2_norm(jm)) == pytest.approx(np.linalg.norm(m), rel=1e-5)
+
+    def test_weighted_means(self, rng):
+        m = rng.standard_normal((4, 6)).astype(np.float32)
+        w = rng.random(6).astype(np.float32)
+        got = np.asarray(matrix.row_weighted_mean(jnp.asarray(m), jnp.asarray(w)))
+        np.testing.assert_allclose(got, (m * w).sum(1) / w.sum(), rtol=1e-5)

@@ -1,0 +1,53 @@
+"""Sharded kNN over the 8-device virtual CPU mesh (the reference tests MNMG
+logic on a LocalCUDACluster the same way — SURVEY.md §4)."""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from ann_utils import calc_recall, naive_knn
+from raft_tpu.parallel import sharded_knn
+
+
+@pytest.fixture
+def mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("shard",))
+
+
+class TestShardedKnn:
+    def test_matches_single_chip(self, mesh, rng):
+        data = rng.standard_normal((4000, 32)).astype(np.float32)
+        q = rng.standard_normal((32, 32)).astype(np.float32)
+        index = sharded_knn.build(data, mesh)
+        dist, idx = sharded_knn.search(index, q, k=10, tile_size=256)
+        _, want = naive_knn(data, q, 10)
+        assert calc_recall(np.asarray(idx), want) > 0.999
+
+    def test_n_not_divisible_by_shards(self, mesh, rng):
+        data = rng.standard_normal((1003, 16)).astype(np.float32)
+        q = rng.standard_normal((8, 16)).astype(np.float32)
+        index = sharded_knn.build(data, mesh)
+        _, idx = sharded_knn.search(index, q, k=5, tile_size=128)
+        _, want = naive_knn(data, q, 5)
+        assert calc_recall(np.asarray(idx), want) > 0.999
+        assert (np.asarray(idx) < 1003).all() and (np.asarray(idx) >= 0).all()
+
+    def test_inner_product(self, mesh, rng):
+        data = rng.standard_normal((2048, 16)).astype(np.float32)
+        q = rng.standard_normal((8, 16)).astype(np.float32)
+        index = sharded_knn.build(data, mesh, metric="inner_product")
+        _, idx = sharded_knn.search(index, q, k=5, tile_size=256)
+        _, want = naive_knn(data, q, 5, "inner_product")
+        assert calc_recall(np.asarray(idx), want) > 0.999
+
+    def test_dryrun(self):
+        sharded_knn.dryrun(8)
+
+    def test_jit_compiles_once(self, mesh, rng):
+        data = rng.standard_normal((1024, 16)).astype(np.float32)
+        index = sharded_knn.build(data, mesh)
+        fn = jax.jit(lambda q: sharded_knn.search(index, q, k=3, tile_size=128))
+        q = rng.standard_normal((4, 16)).astype(np.float32)
+        out1 = fn(q)
+        out2 = fn(q + 1)
+        jax.block_until_ready((out1, out2))
