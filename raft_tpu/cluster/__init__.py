@@ -1,0 +1,5 @@
+"""Clustering: Lloyd k-means, balanced hierarchical k-means, single-linkage
+(SURVEY.md §2.7). single_linkage lands with the sparse/MST subsystem."""
+from . import kmeans, kmeans_balanced
+
+__all__ = ["kmeans", "kmeans_balanced"]

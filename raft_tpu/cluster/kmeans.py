@@ -1,0 +1,208 @@
+"""Lloyd's k-means with k-means++ init: analog of ``raft::cluster::kmeans``.
+
+Reference: raft/cluster/kmeans.cuh:88,152,215 and detail/kmeans.cuh (1254
+LoC): kmeans++ init (sampleCentroids), fit/predict/fit_predict/transform,
+mini-batch variant, cluster_cost.
+
+TPU design: the label assignment is the fused L2+argmin scan
+(distance/fused_l2_nn.py) — the same hot loop the reference uses
+(fused_l2_nn inside kmeans predict); centroid update is one
+`segment_sum`, which XLA lowers to an efficient scatter-add; the Lloyd
+iteration is a `lax.while_loop` on (centers, shift), so the whole fit is a
+single compiled program with no host round-trips.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tracing
+from ..core.errors import expects
+from ..distance.fused_l2_nn import fused_l2_nn_argmin
+from ..distance.pairwise import pairwise_distance
+
+__all__ = [
+    "InitMethod", "KMeansParams", "init_plus_plus", "fit", "predict",
+    "fit_predict", "transform", "cluster_cost", "fit_mini_batch",
+]
+
+
+class InitMethod(enum.Enum):
+    """kmeans.cuh InitMethod."""
+
+    KMeansPlusPlus = "kmeans++"
+    Random = "random"
+    Array = "array"
+
+
+@dataclasses.dataclass
+class KMeansParams:
+    """Mirror of raft::cluster::kmeans::params (kmeans_types.hpp)."""
+
+    n_clusters: int = 8
+    init: InitMethod = InitMethod.KMeansPlusPlus
+    max_iter: int = 300
+    tol: float = 1e-4
+    seed: int = 0
+    metric: str = "sqeuclidean"
+    n_init: int = 1
+    oversampling_factor: float = 2.0   # accepted for parity; ++ init is exact
+    batch_samples: int = 1 << 15       # mini-batch size
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _plus_plus(key, x, k):
+    """Exact k-means++ D² sampling, one center per scan step."""
+    n = x.shape[0]
+    key, sub = jax.random.split(key)
+    first = x[jax.random.randint(sub, (), 0, n)]
+
+    def step(carry, key_i):
+        centers, min_d2, i = carry
+        newest = centers[i]
+        d2 = jnp.sum((x - newest[None, :]) ** 2, axis=1)
+        min_d2 = jnp.minimum(min_d2, d2)
+        probs = min_d2 / jnp.maximum(jnp.sum(min_d2), 1e-30)
+        nxt = x[jax.random.categorical(key_i, jnp.log(jnp.maximum(probs, 1e-30)))]
+        centers = centers.at[i + 1].set(nxt)
+        return (centers, min_d2, i + 1), None
+
+    centers0 = jnp.zeros((k, x.shape[1]), x.dtype).at[0].set(first)
+    init = (centers0, jnp.full((n,), jnp.inf, jnp.float32), 0)
+    keys = jax.random.split(key, k - 1)
+    (centers, _, _), _ = jax.lax.scan(step, init, keys)
+    return centers
+
+
+def init_plus_plus(x, n_clusters: int, seed: int = 0) -> jax.Array:
+    """Public k-means++ seeding (analog of kmeans::init_plus_plus)."""
+    x = jnp.asarray(x, jnp.float32)
+    expects(n_clusters <= x.shape[0], "n_clusters %d > n_samples %d",
+            n_clusters, x.shape[0])
+    return _plus_plus(jax.random.key(seed), x, n_clusters)
+
+
+def _update_centers(x, labels, k, old_centers):
+    """Segment-sum centroid update; empty clusters keep their old center
+    (the reference re-seeds them in adjust_centers — balanced kmeans does)."""
+    sums = jax.ops.segment_sum(x, labels, num_segments=k)
+    counts = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), labels,
+                                 num_segments=k)
+    safe = jnp.maximum(counts, 1.0)
+    centers = sums / safe[:, None]
+    return jnp.where((counts > 0)[:, None], centers, old_centers), counts
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _lloyd(x, centers0, max_iter, tol):
+    k = centers0.shape[0]
+
+    def cond(state):
+        _, shift, it = state
+        return (shift > tol) & (it < max_iter)
+
+    def body(state):
+        centers, _, it = state
+        labels, _ = fused_l2_nn_argmin(x, centers)
+        new_centers, _ = _update_centers(x, labels, k, centers)
+        shift = jnp.sum((new_centers - centers) ** 2)
+        return new_centers, shift, it + 1
+
+    centers, _, n_iter = jax.lax.while_loop(
+        cond, body, (centers0, jnp.float32(jnp.inf), 0))
+    labels, d2 = fused_l2_nn_argmin(x, centers)
+    return centers, labels, jnp.sum(d2), n_iter
+
+
+@tracing.annotate("raft_tpu::cluster::kmeans::fit")
+def fit(x, params: KMeansParams, centroids: Optional[jax.Array] = None):
+    """Fit k-means → (centroids (k, d), inertia, n_iter).
+
+    ``centroids`` seeds the fit when params.init == Array
+    (kmeans.cuh:88 takes the same optional seed matrix).
+    """
+    x = jnp.asarray(x, jnp.float32)
+    k = params.n_clusters
+    expects(k > 0 and k <= x.shape[0], "bad n_clusters %d for n=%d", k, x.shape[0])
+
+    best = None
+    for trial in range(max(1, params.n_init)):
+        seed = params.seed + trial
+        if params.init is InitMethod.Array:
+            expects(centroids is not None, "init=Array requires centroids")
+            c0 = jnp.asarray(centroids, jnp.float32)
+        elif params.init is InitMethod.Random:
+            idx = jax.random.choice(jax.random.key(seed), x.shape[0], (k,),
+                                    replace=False)
+            c0 = x[idx]
+        else:
+            c0 = _plus_plus(jax.random.key(seed), x, k)
+        centers, labels, inertia, n_iter = _lloyd(x, c0, params.max_iter,
+                                                  params.tol)
+        if best is None or float(inertia) < float(best[1]):
+            best = (centers, inertia, n_iter)
+    return best
+
+
+def predict(x, centroids) -> Tuple[jax.Array, jax.Array]:
+    """Labels + per-sample squared distance (kmeans::predict)."""
+    return fused_l2_nn_argmin(jnp.asarray(x, jnp.float32),
+                              jnp.asarray(centroids, jnp.float32))
+
+
+def fit_predict(x, params: KMeansParams):
+    centers, inertia, n_iter = fit(x, params)
+    labels, _ = predict(x, centers)
+    return labels, centers, inertia
+
+
+def transform(x, centroids) -> jax.Array:
+    """Distance of each sample to every centroid (kmeans::transform)."""
+    return pairwise_distance(x, centroids, "sqeuclidean")
+
+
+def cluster_cost(x, centroids) -> jax.Array:
+    """Total squared distance to nearest centroid (kmeans::cluster_cost)."""
+    _, d2 = predict(x, centroids)
+    return jnp.sum(d2)
+
+
+@tracing.annotate("raft_tpu::cluster::kmeans::fit_mini_batch")
+def fit_mini_batch(x, params: KMeansParams):
+    """Mini-batch k-means (detail/kmeans.cuh fit_main mini-batch path):
+    per-batch assignment + running per-center counts with incremental
+    center updates."""
+    x = jnp.asarray(x, jnp.float32)
+    k = params.n_clusters
+    n = x.shape[0]
+    b = min(params.batch_samples, n)
+    c0 = _plus_plus(jax.random.key(params.seed), x, k)
+
+    def step(carry, key):
+        centers, counts = carry
+        idx = jax.random.randint(key, (b,), 0, n)
+        xb = x[idx]
+        labels, _ = fused_l2_nn_argmin(xb, centers)
+        bsum = jax.ops.segment_sum(xb, labels, num_segments=k)
+        bcnt = jax.ops.segment_sum(jnp.ones((b,), x.dtype), labels,
+                                   num_segments=k)
+        new_counts = counts + bcnt
+        lr = jnp.where(new_counts > 0, bcnt / jnp.maximum(new_counts, 1.0), 0.0)
+        target = bsum / jnp.maximum(bcnt, 1.0)[:, None]
+        centers = jnp.where(
+            (bcnt > 0)[:, None],
+            centers + lr[:, None] * (target - centers),
+            centers,
+        )
+        return (centers, new_counts), None
+
+    steps = max(1, params.max_iter)
+    keys = jax.random.split(jax.random.key(params.seed + 1), steps)
+    (centers, _), _ = jax.lax.scan(step, (c0, jnp.zeros((k,), jnp.float32)), keys)
+    labels, d2 = fused_l2_nn_argmin(x, centers)
+    return centers, jnp.sum(d2), steps

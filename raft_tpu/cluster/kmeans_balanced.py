@@ -1,0 +1,179 @@
+"""Hierarchical balanced k-means: analog of ``raft::cluster::kmeans_balanced``.
+
+Reference: raft/cluster/detail/kmeans_balanced.cuh:956 (`build_hierarchical`):
+train mesoclusters on the full set, then fine clusters per mesocluster, then
+rebalance with `adjust_centers` (:258) — undersized clusters are re-seeded
+near points of oversized clusters — interleaved with Lloyd steps. This is
+the IVF coarse quantizer trainer (ivf_pq_build.cuh:1825).
+
+TPU design: assignments ride the fused L2+argmin scan; per-mesocluster fine
+training batches all mesoclusters' Lloyd updates into ONE segment-sum over a
+combined label space (meso-id × fine-id), so the hierarchy adds no serial
+kernel launches; adjust_centers is a vectorized re-seed driven by cluster
+size ranks.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import tracing
+from ..core.errors import expects
+from ..distance.fused_l2_nn import fused_l2_nn_argmin
+from .kmeans import _lloyd, _plus_plus, _update_centers
+
+__all__ = ["BalancedKMeansParams", "fit", "predict", "fit_predict", "adjust_centers"]
+
+
+@dataclasses.dataclass
+class BalancedKMeansParams:
+    """Mirror of kmeans_balanced_params (kmeans_balanced.cuh)."""
+
+    n_iters: int = 20              # per-level Lloyd iterations
+    metric: str = "sqeuclidean"
+    seed: int = 0
+    # adjust_centers threshold: clusters smaller than avg/ratio are re-seeded
+    balancing_pessimism: float = 2.5
+    balancing_rounds: int = 4
+    max_train_points: int = 1 << 20  # subsample bound for meso training
+
+
+def adjust_centers(centers, counts, x, labels, threshold_ratio: float, key):
+    """Re-seed undersized clusters near members of oversized ones.
+
+    Vectorized analog of kmeans_balanced.cuh:258 (adjust_centers): any
+    cluster with count < avg/ratio takes a new center drawn from the points
+    of large clusters (sampling weight = size of the point's cluster),
+    nudged toward the global spread to avoid duplicate seeds.
+    """
+    k = centers.shape[0]
+    avg = x.shape[0] / k
+    small = counts < (avg / threshold_ratio)
+    # weight each point by its cluster's size → points in big clusters win
+    w = counts[labels]
+    probs = w / jnp.maximum(jnp.sum(w), 1e-30)
+    picks = jax.random.categorical(
+        key, jnp.log(jnp.maximum(probs, 1e-30)), shape=(k,))
+    # offset each re-seed slightly toward its pick's neighborhood mean to
+    # decorrelate multiple re-seeds landing on the same donor cluster
+    donors = x[picks]
+    jitter = 1e-3 * (donors - centers)
+    new_centers = donors + jitter
+    return jnp.where(small[:, None], new_centers, centers), small.sum()
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4))
+def _balanced_lloyd(x, centers0, n_iters, rounds, pessimism, key):
+    """Lloyd iterations with periodic adjust_centers re-balancing."""
+    k = centers0.shape[0]
+
+    def one_round(carry, key_r):
+        centers = carry
+        def lloyd_step(c, _):
+            labels, _ = fused_l2_nn_argmin(x, c)
+            c2, _ = _update_centers(x, labels, k, c)
+            return c2, None
+        centers, _ = jax.lax.scan(lloyd_step, centers, None, length=n_iters)
+        labels, _ = fused_l2_nn_argmin(x, centers)
+        counts = jax.ops.segment_sum(jnp.ones((x.shape[0],), jnp.float32),
+                                     labels, num_segments=k)
+        centers, _ = adjust_centers(centers, counts, x, labels, pessimism, key_r)
+        return centers, None
+
+    keys = jax.random.split(key, rounds)
+    centers, _ = jax.lax.scan(one_round, centers0, keys)
+    # final polish without a trailing re-seed
+    def lloyd_step(c, _):
+        labels, _ = fused_l2_nn_argmin(x, c)
+        c2, _ = _update_centers(x, labels, k, c)
+        return c2, None
+    centers, _ = jax.lax.scan(lloyd_step, centers, None, length=n_iters // 2 + 1)
+    return centers
+
+
+@tracing.annotate("raft_tpu::cluster::kmeans_balanced::fit")
+def fit(x, n_clusters: int, params: BalancedKMeansParams | None = None) -> jax.Array:
+    """Train ``n_clusters`` balanced centroids → (n_clusters, d).
+
+    Hierarchy as in build_hierarchical: n_meso ≈ sqrt(n_clusters)
+    mesoclusters trained first; each mesocluster trains a proportional share
+    of fine centers on its own points; all fine centers are then polished
+    jointly with balancing rounds.
+    """
+    p = params or BalancedKMeansParams()
+    x = jnp.asarray(x, jnp.float32)
+    n, d = x.shape
+    expects(0 < n_clusters <= n, "bad n_clusters %d for n=%d", n_clusters, n)
+    key = jax.random.key(p.seed)
+
+    if n > p.max_train_points:
+        stride = n // p.max_train_points
+        x = x[::stride][: p.max_train_points]
+        n = x.shape[0]
+
+    if n_clusters <= 4:
+        c0 = _plus_plus(key, x, n_clusters)
+        centers, *_ = _lloyd(x, c0, p.n_iters, 1e-6)
+        return centers
+
+    n_meso = max(2, int(math.sqrt(n_clusters)))
+    k_meso, k_fine_key = jax.random.split(key)
+
+    # level 1: mesoclusters
+    c0 = _plus_plus(k_meso, x, n_meso)
+    meso_centers, *_ = _lloyd(x, c0, p.n_iters, 1e-6)
+    meso_labels, _ = fused_l2_nn_argmin(x, meso_centers)
+
+    # proportional fine-cluster allocation (host-side, sizes are tiny)
+    counts = np.asarray(jax.ops.segment_sum(
+        jnp.ones((n,), jnp.float32), meso_labels, num_segments=n_meso))
+    alloc = np.maximum(1, np.floor(counts / counts.sum() * n_clusters)).astype(int)
+    while alloc.sum() < n_clusters:
+        alloc[np.argmax(counts / alloc)] += 1
+    while alloc.sum() > n_clusters:
+        i = np.argmax(alloc)
+        if alloc[i] <= 1:
+            break
+        alloc[i] -= 1
+
+    # level 2: seed fine centers per mesocluster from its own points, then
+    # polish jointly with balancing
+    fine_list = []
+    keys = jax.random.split(k_fine_key, n_meso)
+    labels_np = np.asarray(meso_labels)
+    x_np = np.asarray(x)
+    for m in range(n_meso):
+        pts = x_np[labels_np == m]
+        km = int(alloc[m])
+        if len(pts) == 0:
+            fine_list.append(np.asarray(meso_centers)[m : m + 1].repeat(km, 0))
+            continue
+        if len(pts) <= km:
+            reps = np.resize(pts, (km, d))
+            fine_list.append(reps)
+            continue
+        seeds = _plus_plus(keys[m], jnp.asarray(pts), km)
+        fine_list.append(np.asarray(seeds))
+    centers0 = jnp.asarray(np.concatenate(fine_list, axis=0))
+
+    key_bal = jax.random.key(p.seed + 17)
+    return _balanced_lloyd(x, centers0, p.n_iters, p.balancing_rounds,
+                           p.balancing_pessimism, key_bal)
+
+
+def predict(x, centroids) -> Tuple[jax.Array, jax.Array]:
+    """Batch label assignment via fused L2+argmin (kmeans_balanced::predict)."""
+    return fused_l2_nn_argmin(jnp.asarray(x, jnp.float32),
+                              jnp.asarray(centroids, jnp.float32))
+
+
+def fit_predict(x, n_clusters: int, params: BalancedKMeansParams | None = None):
+    centers = fit(x, n_clusters, params)
+    labels, _ = predict(x, centers)
+    return centers, labels

@@ -1,0 +1,333 @@
+"""IVF-Flat index: analog of ``raft::neighbors::ivf_flat``.
+
+Reference: raft/neighbors/ivf_flat_types.hpp:131 (index = per-cluster
+inverted lists of raw vectors), detail/ivf_flat_build.cuh:123-343
+(build/extend: kmeans_balanced coarse quantizer + grouped-interleaved list
+layout) and detail/ivf_flat_search-inl.cuh:38-255 (coarse GEMM + select_k,
+then a fused per-list scan+topk kernel).
+
+TPU design: lists live as *contiguous row ranges of one dense row-sorted
+array* (cluster-sorted dataset + offsets) — the TPU analog of the
+reference's interleaved group-of-32 layout (ivf_flat_build.cuh:87-158),
+whose purpose (coalesced full-width loads) XLA gets for free from dense
+rows. Search is two MXU stages: (1) coarse = queries×centroids GEMM +
+select_k → n_probes lists; (2) candidate rows of the probed lists are
+gathered per query chunk and scored with a batched GEMV + masked select_k.
+The probe budget is the sum of the n_probes largest list sizes, so shapes
+stay static under jit. A fused Pallas list-scan kernel (raft_tpu.ops)
+replaces stage 2 on TPU for HBM-bound shapes.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import tracing
+from ..core.bitset import Bitset
+from ..core.errors import expects
+from ..core.serialize import load_arrays, save_arrays
+from ..cluster import kmeans_balanced
+from ..distance.distance_types import DistanceType, canonical_metric, is_min_close
+from ..matrix.select_k import select_k
+from ..utils import cdiv
+
+__all__ = ["IndexParams", "SearchParams", "Index", "build", "extend", "search",
+           "save", "load"]
+
+_SERIAL_VERSION = 1
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """Mirror of ivf_flat::index_params (ivf_flat_types.hpp)."""
+
+    n_lists: int = 1024
+    metric: DistanceType | str = DistanceType.L2Expanded
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    add_data_on_build: bool = True
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """Mirror of ivf_flat::search_params."""
+
+    n_probes: int = 20
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Index:
+    """Cluster-sorted IVF-Flat index.
+
+    ``data``: (n, d) rows sorted by list; ``source_ids``: (n,) original ids;
+    ``list_offsets``: (n_lists+1,) row offsets (host numpy — static under
+    jit); ``centers``: (n_lists, d).
+    """
+
+    data: jax.Array
+    data_norms: jax.Array
+    source_ids: jax.Array
+    centers: jax.Array
+    center_norms: jax.Array
+    list_offsets: np.ndarray       # host-side, static
+    metric: DistanceType
+    conservative_memory: bool = False
+
+    @property
+    def size(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.data.shape[1]
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def list_sizes(self) -> np.ndarray:
+        return np.diff(self.list_offsets)
+
+    def tree_flatten(self):
+        leaves = (self.data, self.data_norms, self.source_ids,
+                  self.centers, self.center_norms)
+        aux = (tuple(self.list_offsets.tolist()), self.metric,
+               self.conservative_memory)
+        return leaves, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        offsets, metric, conservative = aux
+        return cls(*leaves, np.asarray(offsets, np.int64), metric, conservative)
+
+
+def _sort_by_list(dataset, labels, source_ids, n_lists):
+    """Cluster-sort rows; returns (data, ids, offsets)."""
+    order = np.argsort(labels, kind="stable")
+    data = dataset[order]
+    ids = source_ids[order]
+    sizes = np.bincount(labels, minlength=n_lists)
+    offsets = np.zeros(n_lists + 1, np.int64)
+    np.cumsum(sizes, out=offsets[1:])
+    return data, ids, offsets
+
+
+@tracing.annotate("raft_tpu::ivf_flat::build")
+def build(dataset, params: IndexParams | None = None) -> Index:
+    """Train the coarse quantizer on a subsample and fill the lists
+    (detail/ivf_flat_build.cuh:123)."""
+    p = params or IndexParams()
+    dataset = np.asarray(dataset, np.float32)
+    n, d = dataset.shape
+    mt = canonical_metric(p.metric)
+    expects(mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+                   DistanceType.InnerProduct, DistanceType.CosineExpanded),
+            "ivf_flat supports L2/IP/cosine metrics, got %s", mt.name)
+    expects(p.n_lists <= n, "n_lists %d > n %d", p.n_lists, n)
+
+    # trainset subsample (ivf_flat_build.cuh uses a strided subsample)
+    n_train = max(p.n_lists, int(n * p.kmeans_trainset_fraction))
+    stride = max(1, n // n_train)
+    trainset = dataset[::stride]
+
+    bparams = kmeans_balanced.BalancedKMeansParams(
+        n_iters=p.kmeans_n_iters, seed=p.seed)
+    centers = kmeans_balanced.fit(jnp.asarray(trainset), p.n_lists, bparams)
+
+    if not p.add_data_on_build:
+        empty = np.zeros((0, d), np.float32)
+        return Index(
+            jnp.asarray(empty), jnp.zeros((0,), jnp.float32),
+            jnp.zeros((0,), jnp.int32), centers,
+            jnp.sum(centers * centers, axis=1),
+            np.zeros(p.n_lists + 1, np.int64), mt)
+
+    labels, _ = kmeans_balanced.predict(jnp.asarray(dataset), centers)
+    data, ids, offsets = _sort_by_list(
+        dataset, np.asarray(labels), np.arange(n, dtype=np.int32), p.n_lists)
+    data_j = jnp.asarray(data)
+    return Index(
+        data_j, jnp.sum(data_j * data_j, axis=1), jnp.asarray(ids),
+        centers, jnp.sum(centers * centers, axis=1), offsets, mt)
+
+
+@tracing.annotate("raft_tpu::ivf_flat::extend")
+def extend(index: Index, new_vectors, new_ids=None) -> Index:
+    """Add vectors to an existing index (detail/ivf_flat_build.cuh:extend)."""
+    new_vectors = np.asarray(new_vectors, np.float32)
+    expects(new_vectors.shape[1] == index.dim, "dim mismatch")
+    if new_ids is None:
+        base = int(index.source_ids.max()) + 1 if index.size else 0
+        new_ids = np.arange(base, base + len(new_vectors), dtype=np.int32)
+    labels, _ = kmeans_balanced.predict(jnp.asarray(new_vectors), index.centers)
+
+    # merge old + new, re-sort (stable: old rows stay ordered within lists)
+    old_data = np.asarray(index.data)
+    old_ids = np.asarray(index.source_ids)
+    old_labels = np.repeat(np.arange(index.n_lists), index.list_sizes)
+    all_data = np.concatenate([old_data, new_vectors])
+    all_ids = np.concatenate([old_ids, np.asarray(new_ids, np.int32)])
+    all_labels = np.concatenate([old_labels, np.asarray(labels)])
+    data, ids, offsets = _sort_by_list(all_data, all_labels, all_ids,
+                                       index.n_lists)
+    data_j = jnp.asarray(data)
+    return Index(data_j, jnp.sum(data_j * data_j, axis=1), jnp.asarray(ids),
+                 index.centers, index.center_norms, offsets, index.metric)
+
+
+def _probe_budget(list_sizes: np.ndarray, n_probes: int) -> int:
+    """Static upper bound on candidate rows: sum of the n_probes largest
+    lists (rounded up for alignment)."""
+    top = np.sort(list_sizes)[::-1][:n_probes]
+    return max(8, int(top.sum()))
+
+
+def _candidate_rows(probed_lists, offsets_j, sizes_j, max_rows):
+    """(m, n_probes) probed list ids → (m, max_rows) row ids + validity.
+
+    For each query, the rows of its probed lists are laid out back-to-back;
+    slot s maps to probe j = searchsorted(cum_sizes, s) and row
+    offsets[list_j] + (s - cum_sizes[j-1]).
+    """
+    sizes = sizes_j[probed_lists]                       # (m, p)
+    cum = jnp.cumsum(sizes, axis=1)                     # (m, p)
+    total = cum[:, -1]
+    slots = jnp.arange(max_rows, dtype=jnp.int32)       # (S,)
+    # probe covering each slot: number of cum entries <= slot
+    probe_of = jnp.sum(cum[:, None, :] <= slots[None, :, None], axis=2)  # (m, S)
+    probe_of = jnp.minimum(probe_of, sizes.shape[1] - 1)
+    prev_cum = jnp.where(probe_of > 0,
+                         jnp.take_along_axis(cum, jnp.maximum(probe_of - 1, 0),
+                                             axis=1), 0)
+    within = slots[None, :] - prev_cum
+    list_of = jnp.take_along_axis(probed_lists, probe_of, axis=1)
+    rows = offsets_j[list_of] + within
+    valid = slots[None, :] < total[:, None]
+    rows = jnp.where(valid, rows, 0)
+    return rows, valid
+
+
+@tracing.annotate("raft_tpu::ivf_flat::search")
+def search(
+    index: Index,
+    queries,
+    k: int,
+    params: SearchParams | None = None,
+    filter: Optional[Bitset] = None,  # noqa: A002
+    query_chunk: int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Probe the n_probes nearest lists per query and return exact top-k over
+    their members → (distances (m, k), indices (m, k)) with original ids."""
+    p = params or SearchParams()
+    q = jnp.asarray(queries, jnp.float32)
+    expects(q.ndim == 2 and q.shape[1] == index.dim, "bad query shape %s", q.shape)
+    expects(index.size > 0, "index is empty")
+    n_probes = min(p.n_probes, index.n_lists)
+    mt = index.metric
+    select_min = is_min_close(mt)
+
+    sizes_np = index.list_sizes
+    max_rows = _probe_budget(sizes_np, n_probes)
+    if query_chunk <= 0:
+        # bound gathered candidates to ~256 MB
+        per_q = max_rows * index.dim * 4
+        query_chunk = max(1, min(q.shape[0], (256 << 20) // max(per_q, 1)))
+
+    offsets_j = jnp.asarray(index.list_offsets[:-1], jnp.int32)
+    sizes_j = jnp.asarray(sizes_np, jnp.int32)
+    mask_bits = filter.to_mask() if filter is not None else None
+
+    outs_d, outs_i = [], []
+    for c0 in range(0, q.shape[0], query_chunk):
+        qc = q[c0 : c0 + query_chunk]
+        d_c, i_c = _search_chunk(index, qc, k, n_probes, max_rows, offsets_j,
+                                 sizes_j, mask_bits, select_min, mt)
+        outs_d.append(d_c)
+        outs_i.append(i_c)
+    if len(outs_d) == 1:
+        return outs_d[0], outs_i[0]
+    return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+
+
+def _coarse_distances(qc, index: Index, mt):
+    cross = qc @ index.centers.T
+    if mt is DistanceType.InnerProduct:
+        return -cross  # pick largest IP → smallest negative
+    if mt is DistanceType.CosineExpanded:
+        qn = jnp.sqrt(jnp.maximum(jnp.sum(qc * qc, axis=1, keepdims=True), 1e-30))
+        cn = jnp.sqrt(jnp.maximum(index.center_norms, 1e-30))
+        return 1.0 - cross / (qn * cn[None, :])
+    q2 = jnp.sum(qc * qc, axis=1, keepdims=True)
+    return jnp.maximum(q2 + index.center_norms[None, :] - 2.0 * cross, 0.0)
+
+
+def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
+                  mask_bits, select_min, mt):
+    # stage 1: coarse probe selection (ivf_flat_search-inl.cuh:38)
+    coarse = _coarse_distances(qc, index, mt)
+    _, probed = select_k(coarse, n_probes, select_min=True)
+
+    # stage 2: gather candidates and score (the fused-scan analog)
+    rows, valid = _candidate_rows(probed, offsets_j, sizes_j, max_rows)
+    cand = index.data[rows]                      # (m, S, d)
+    if mt is DistanceType.InnerProduct:
+        dist = jnp.einsum("msd,md->ms", cand, qc)
+    elif mt is DistanceType.CosineExpanded:
+        ip = jnp.einsum("msd,md->ms", cand, qc)
+        qn = jnp.sqrt(jnp.maximum(jnp.sum(qc * qc, axis=1, keepdims=True), 1e-30))
+        cn = jnp.sqrt(jnp.maximum(index.data_norms[rows], 1e-30))
+        dist = 1.0 - ip / (qn * cn)
+    else:
+        ip = jnp.einsum("msd,md->ms", cand, qc)
+        q2 = jnp.sum(qc * qc, axis=1, keepdims=True)
+        dist = jnp.maximum(q2 + index.data_norms[rows] - 2.0 * ip, 0.0)
+        if mt is DistanceType.L2SqrtExpanded:
+            dist = jnp.sqrt(dist)
+
+    if mask_bits is not None:
+        src = index.source_ids[rows]
+        valid = valid & mask_bits[src]
+    bad = jnp.inf if select_min else -jnp.inf
+    dist = jnp.where(valid, dist, bad)
+    kk = min(k, max_rows)
+    vals, locs = select_k(dist, kk, select_min=select_min)
+    ids = jnp.take_along_axis(index.source_ids[rows], locs, axis=1)
+    ids = jnp.where(jnp.isfinite(vals) if select_min else vals > -jnp.inf,
+                    ids, -1)
+    if kk < k:  # pad (tiny indexes)
+        pad = k - kk
+        vals = jnp.pad(vals, ((0, 0), (0, pad)), constant_values=bad)
+        ids = jnp.pad(ids, ((0, 0), (0, pad)), constant_values=-1)
+    return vals, ids
+
+
+def save(index: Index, path) -> None:
+    """Serialize (analog of ivf_flat_serialize.cuh)."""
+    save_arrays(
+        path, "ivf_flat", _SERIAL_VERSION,
+        {"metric": index.metric.value, "n_lists": index.n_lists},
+        {
+            "data": index.data,
+            "source_ids": index.source_ids,
+            "centers": index.centers,
+            "list_offsets": index.list_offsets,
+        })
+
+
+def load(path) -> Index:
+    _, version, meta, arrs = load_arrays(path, "ivf_flat")
+    expects(version == _SERIAL_VERSION, "unsupported version %d", version)
+    data = jnp.asarray(arrs["data"])
+    centers = jnp.asarray(arrs["centers"])
+    return Index(
+        data, jnp.sum(data * data, axis=1), jnp.asarray(arrs["source_ids"]),
+        centers, jnp.sum(centers * centers, axis=1),
+        np.asarray(arrs["list_offsets"], np.int64),
+        DistanceType(meta["metric"]))

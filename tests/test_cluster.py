@@ -1,0 +1,106 @@
+"""Clustering tests (analog of CLUSTER_TEST)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.cluster import kmeans, kmeans_balanced
+from raft_tpu.cluster.kmeans import InitMethod, KMeansParams
+
+
+def _blobs(rng, n_per=200, k=5, d=8, spread=0.15):
+    centers = rng.standard_normal((k, d)).astype(np.float32) * 3
+    pts = np.concatenate([
+        c + spread * rng.standard_normal((n_per, d)).astype(np.float32)
+        for c in centers
+    ])
+    labels = np.repeat(np.arange(k), n_per)
+    perm = rng.permutation(len(pts))
+    return pts[perm], labels[perm], centers
+
+
+def _purity(found_labels, true_labels, k):
+    """Fraction of points whose cluster's majority true-label matches."""
+    total = 0
+    for c in range(k):
+        members = true_labels[found_labels == c]
+        if len(members):
+            total += np.bincount(members).max()
+    return total / len(true_labels)
+
+
+class TestKMeans:
+    def test_recovers_blobs(self, rng):
+        x, true, _ = _blobs(rng)
+        params = KMeansParams(n_clusters=5, max_iter=50, seed=1)
+        centers, inertia, n_iter = kmeans.fit(x, params)
+        labels, _ = kmeans.predict(x, centers)
+        assert _purity(np.asarray(labels), true, 5) > 0.99
+        assert int(n_iter) < 50  # converged before cap
+
+    def test_plus_plus_beats_bad_random(self, rng):
+        x, _, _ = _blobs(rng, k=8, spread=0.05)
+        pp = kmeans.fit(x, KMeansParams(n_clusters=8, init=InitMethod.KMeansPlusPlus,
+                                        max_iter=2, seed=0))[1]
+        rnd = kmeans.fit(x, KMeansParams(n_clusters=8, init=InitMethod.Random,
+                                         max_iter=2, seed=0))[1]
+        assert float(pp) <= float(rnd) * 1.5
+
+    def test_init_array(self, rng):
+        x, _, centers = _blobs(rng)
+        c, inertia, _ = kmeans.fit(
+            x, KMeansParams(n_clusters=5, init=InitMethod.Array, max_iter=20),
+            centroids=centers)
+        labels, _ = kmeans.predict(x, c)
+        assert len(np.unique(np.asarray(labels))) == 5
+
+    def test_transform_and_cost(self, rng):
+        x, _, _ = _blobs(rng, k=3)
+        centers, inertia, _ = kmeans.fit(x, KMeansParams(n_clusters=3, seed=0))
+        t = kmeans.transform(x, centers)
+        assert t.shape == (x.shape[0], 3)
+        cost = kmeans.cluster_cost(x, centers)
+        np.testing.assert_allclose(float(cost), float(inertia), rtol=1e-3)
+        np.testing.assert_allclose(float(cost), float(np.asarray(t).min(1).sum()),
+                                   rtol=1e-3)
+
+    def test_mini_batch(self, rng):
+        x, true, _ = _blobs(rng, n_per=400, k=4)
+        params = KMeansParams(n_clusters=4, max_iter=30, seed=0, batch_samples=256)
+        centers, inertia, _ = kmeans.fit_mini_batch(x, params)
+        labels, _ = kmeans.predict(x, centers)
+        assert _purity(np.asarray(labels), true, 4) > 0.95
+
+    def test_n_init_picks_best(self, rng):
+        x, _, _ = _blobs(rng, k=6)
+        one = kmeans.fit(x, KMeansParams(n_clusters=6, max_iter=30, seed=0, n_init=1))[1]
+        three = kmeans.fit(x, KMeansParams(n_clusters=6, max_iter=30, seed=0, n_init=3))[1]
+        assert float(three) <= float(one) + 1e-3
+
+
+class TestBalanced:
+    def test_balance_quality(self, rng):
+        x = rng.standard_normal((6000, 16)).astype(np.float32)
+        k = 64
+        centers = kmeans_balanced.fit(x, k)
+        labels, _ = kmeans_balanced.predict(x, centers)
+        counts = np.bincount(np.asarray(labels), minlength=k)
+        assert counts.min() > 0, "no empty clusters"
+        avg = 6000 / k
+        # balanced trainer should keep sizes within a reasonable envelope
+        assert counts.max() < 4 * avg
+        assert (counts > avg / 4).mean() > 0.9
+
+    def test_small_k(self, rng):
+        x, true, _ = _blobs(rng, k=3)
+        centers = kmeans_balanced.fit(x, 3)
+        labels, _ = kmeans_balanced.predict(x, centers)
+        assert _purity(np.asarray(labels), true, 3) > 0.95
+
+    def test_clustered_data(self, rng):
+        x, true, _ = _blobs(rng, n_per=300, k=10, d=12)
+        centers, labels = kmeans_balanced.fit_predict(x, 32)
+        counts = np.bincount(np.asarray(labels), minlength=32)
+        assert counts.min() > 0
+        # inertia sanity: points should be close to their centers
+        _, d2 = kmeans_balanced.predict(x, centers)
+        assert float(jnp.mean(d2)) < float(jnp.var(jnp.asarray(x)) * x.shape[1])
