@@ -5,6 +5,6 @@ Families mirror the reference: ``brute_force`` (exact), ``ivf_flat``,
 (CPU interop), ``ball_cover``, ``epsilon_neighborhood``; sample filters in
 ``filters``.
 """
-from . import ann_types, brute_force
+from . import ann_types, brute_force, ivf_flat, ivf_pq, refine
 
-__all__ = ["ann_types", "brute_force"]
+__all__ = ["ann_types", "brute_force", "ivf_flat", "ivf_pq", "refine"]

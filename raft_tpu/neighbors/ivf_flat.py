@@ -190,7 +190,8 @@ def _probe_budget(list_sizes: np.ndarray, n_probes: int) -> int:
 
 
 def _candidate_rows(probed_lists, offsets_j, sizes_j, max_rows):
-    """(m, n_probes) probed list ids → (m, max_rows) row ids + validity.
+    """(m, n_probes) probed list ids → (m, max_rows) row ids + validity +
+    the probe rank covering each slot.
 
     For each query, the rows of its probed lists are laid out back-to-back;
     slot s maps to probe j = searchsorted(cum_sizes, s) and row
@@ -211,7 +212,7 @@ def _candidate_rows(probed_lists, offsets_j, sizes_j, max_rows):
     rows = offsets_j[list_of] + within
     valid = slots[None, :] < total[:, None]
     rows = jnp.where(valid, rows, 0)
-    return rows, valid
+    return rows, valid, probe_of
 
 
 @tracing.annotate("raft_tpu::ivf_flat::search")
@@ -275,7 +276,7 @@ def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
     _, probed = select_k(coarse, n_probes, select_min=True)
 
     # stage 2: gather candidates and score (the fused-scan analog)
-    rows, valid = _candidate_rows(probed, offsets_j, sizes_j, max_rows)
+    rows, valid, _ = _candidate_rows(probed, offsets_j, sizes_j, max_rows)
     cand = index.data[rows]                      # (m, S, d)
     if mt is DistanceType.InnerProduct:
         dist = jnp.einsum("msd,md->ms", cand, qc)

@@ -1,0 +1,554 @@
+"""IVF-PQ index: analog of ``raft::neighbors::ivf_pq``.
+
+Reference: raft/neighbors/ivf_pq_types.hpp:43,110-146,264 (params: pq_bits,
+pq_dim, codebook_gen PER_SUBSPACE|PER_CLUSTER, force_random_rotation; index
+holds rotation matrix, coarse centers, codebooks, packed code lists),
+detail/ivf_pq_build.cuh:1729 (build: kmeans_balanced coarse quantizer →
+rotation matrix → train_per_subset/train_per_cluster codebooks → extend
+packs codes) and detail/ivf_pq_search.cuh:731 (search: coarse GEMM +
+select_k → rotate queries → per-(query,probe) LUT + packed-code scan).
+
+TPU design differences from the CUDA reference:
+
+* **Everything lives in rotated space.** The rotation is orthogonal, so L2
+  and inner-product are preserved; we rotate the dataset once at build and
+  the queries once at search, and then coarse selection, residuals, and
+  codebooks never leave rotated coordinates (the reference rotates queries
+  but keeps separate "extended" centers — ivf_pq_search.cuh:69-170 — to
+  fold norms into one GEMM; XLA fuses that for free).
+* **Lists are contiguous row ranges** of one dense cluster-sorted code
+  matrix (codes: (n, pq_dim) uint8) — same layout as our IVF-Flat — instead
+  of the reference's bit-packed interleaved groups (ivf_pq_codepacking.cuh):
+  a byte per sub-quantizer keeps gathers vectorizable; pq_bits < 8 still
+  shrinks the *codebook*, and a packed serialization keeps files small.
+* **The LUT-in-shared-memory kernel** (ivf_pq_compute_similarity-inl.cuh:271)
+  becomes one einsum building all (query, probe) LUTs at once + a flat
+  take_along_axis contraction — both XLA-friendly; VMEM plays the role of
+  the LUT smem automatically.
+* Codebook training vmaps a fixed-iteration Lloyd over subspaces (or over
+  clusters for PER_CLUSTER), replacing the reference's per-subspace stream
+  parallelism (ivf_pq_build.cuh:392,469).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import tracing
+from ..core.bitset import Bitset
+from ..core.errors import expects
+from ..core.serialize import load_arrays, save_arrays
+from ..cluster import kmeans_balanced
+from ..distance.distance_types import DistanceType, canonical_metric
+from ..matrix.select_k import select_k
+from ..utils import cdiv
+from .ivf_flat import _candidate_rows, _probe_budget, _sort_by_list
+
+__all__ = ["CodebookGen", "IndexParams", "SearchParams", "Index", "build",
+           "extend", "search", "save", "load", "pack_codes", "unpack_codes",
+           "reconstruct"]
+
+_SERIAL_VERSION = 1
+
+
+class CodebookGen(enum.Enum):
+    """ivf_pq_types.hpp:43 codebook_gen."""
+
+    PER_SUBSPACE = 0
+    PER_CLUSTER = 1
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """Mirror of ivf_pq::index_params (ivf_pq_types.hpp:110)."""
+
+    n_lists: int = 1024
+    metric: DistanceType | str = DistanceType.L2Expanded
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    pq_bits: int = 8                   # 4..8
+    pq_dim: int = 0                    # 0 → dim/4 rounded to a multiple of 8
+    codebook_kind: CodebookGen = CodebookGen.PER_SUBSPACE
+    force_random_rotation: bool = False
+    add_data_on_build: bool = True
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """Mirror of ivf_pq::search_params (ivf_pq_types.hpp:146).
+
+    The reference's lut_dtype/internal_distance_dtype knobs select smem LUT
+    precision; here `lut_dtype` selects the LUT compute dtype (bf16 halves
+    VMEM traffic on TPU, fp32 is exact)."""
+
+    n_probes: int = 20
+    lut_dtype: jnp.dtype = jnp.float32
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Index:
+    """Rotated-space IVF-PQ index.
+
+    ``codes``: (n, pq_dim) uint8 cluster-sorted; ``centers_rot``:
+    (n_lists, rot_dim); ``codebooks``: (pq_dim, 2^bits, pq_len) for
+    PER_SUBSPACE or (n_lists, 2^bits, pq_len) for PER_CLUSTER;
+    ``rotation``: (rot_dim, dim) with orthonormal columns.
+    """
+
+    codes: jax.Array
+    source_ids: jax.Array
+    centers_rot: jax.Array
+    codebooks: jax.Array
+    rotation: jax.Array
+    list_offsets: np.ndarray        # host-side, static
+    metric: DistanceType
+    pq_bits: int
+    codebook_kind: CodebookGen
+
+    @property
+    def size(self) -> int:
+        return self.codes.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.rotation.shape[1]
+
+    @property
+    def rot_dim(self) -> int:
+        return self.rotation.shape[0]
+
+    @property
+    def pq_dim(self) -> int:
+        return self.codes.shape[1]
+
+    @property
+    def pq_len(self) -> int:
+        return self.codebooks.shape[2]
+
+    @property
+    def pq_book_size(self) -> int:
+        return 1 << self.pq_bits
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers_rot.shape[0]
+
+    @property
+    def list_sizes(self) -> np.ndarray:
+        return np.diff(self.list_offsets)
+
+    def tree_flatten(self):
+        leaves = (self.codes, self.source_ids, self.centers_rot,
+                  self.codebooks, self.rotation)
+        aux = (tuple(self.list_offsets.tolist()), self.metric, self.pq_bits,
+               self.codebook_kind)
+        return leaves, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        offsets, metric, pq_bits, kind = aux
+        return cls(*leaves, np.asarray(offsets, np.int64), metric, pq_bits, kind)
+
+
+def _default_pq_dim(dim: int) -> int:
+    """ivf_pq_types.hpp: pq_dim=0 → dim/4 rounded for alignment."""
+    pq = max(1, dim // 4)
+    if pq > 8:
+        pq = (pq // 8) * 8
+    return pq
+
+
+def make_rotation_matrix(key, rot_dim: int, dim: int,
+                         force_random: bool) -> jax.Array:
+    """(rot_dim, dim) with orthonormal columns (ivf_pq_build.cuh:119).
+
+    Identity(-padded) when no rotation is needed; otherwise the Q factor of
+    a gaussian — the reference uses RSVD of a gaussian for the same effect.
+    """
+    if not force_random and rot_dim == dim:
+        return jnp.eye(dim, dtype=jnp.float32)
+    if not force_random:
+        return jnp.eye(rot_dim, dim, dtype=jnp.float32)
+    g = jax.random.normal(key, (rot_dim, rot_dim), jnp.float32)
+    q, _ = jnp.linalg.qr(g)
+    return q[:, :dim]
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _kmeans_fixed(x, k, iters, key):
+    """Fixed-iteration Lloyd for codebook training — vmappable.
+
+    ``x``: (T, d) with possible repeated/padded rows; init = random distinct
+    subsample; empty clusters keep their previous center.
+    """
+    n, d = x.shape
+    perm = jax.random.permutation(key, n)[:k]
+    centers0 = x[perm]
+
+    def step(centers, _):
+        d2 = (jnp.sum(x * x, axis=1, keepdims=True)
+              - 2.0 * (x @ centers.T)
+              + jnp.sum(centers * centers, axis=1)[None, :])
+        labels = jnp.argmin(d2, axis=1)
+        sums = jax.ops.segment_sum(x, labels, num_segments=k)
+        cnts = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), labels,
+                                   num_segments=k)
+        new = jnp.where(cnts[:, None] > 0, sums / jnp.maximum(cnts, 1)[:, None],
+                        centers)
+        return new, None
+
+    centers, _ = jax.lax.scan(step, centers0, None, length=iters)
+    return centers
+
+
+def _train_per_subspace(resid_slices, book_size, iters, key):
+    """(pq_dim, T, pq_len) residual slices → (pq_dim, book, pq_len)
+    codebooks (ivf_pq_build.cuh:392 train_per_subset)."""
+    keys = jax.random.split(key, resid_slices.shape[0])
+    return jax.vmap(_kmeans_fixed, in_axes=(0, None, None, 0))(
+        resid_slices, book_size, iters, keys)
+
+
+def _train_per_cluster(resid_rot, labels, n_lists, pq_len, book_size, iters,
+                       key, samples_per_list=2048):
+    """Per-cluster codebooks over pooled subspace slices
+    (ivf_pq_build.cuh:469 train_per_cluster).
+
+    Each cluster trains on min(count*pq_dim, samples) of its residual
+    sub-vectors; clusters are padded to a common sample count by sampling
+    rows with replacement, so one vmap covers all lists.
+    """
+    n = resid_rot.shape[0]
+    pq_dim = resid_rot.shape[1] // pq_len
+    slices = resid_rot.reshape(n, pq_dim, pq_len)
+    key_rows, key_fit = jax.random.split(key)
+
+    # per-list row sampling (host: one cluster-sort pass, then slice)
+    labels_np = np.asarray(labels)
+    order = np.argsort(labels_np, kind="stable")
+    counts = np.bincount(labels_np, minlength=n_lists)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    rows = np.zeros((n_lists, samples_per_list), np.int32)
+    rng = np.random.default_rng(int(jax.random.randint(key_rows, (), 0, 1 << 30)))
+    for l in range(n_lists):
+        members = order[starts[l] : starts[l] + counts[l]]
+        if len(members) == 0:
+            members = np.array([0], np.int64)
+        rows[l] = rng.choice(members, size=samples_per_list, replace=True)
+    rows_j = jnp.asarray(rows)
+
+    # (n_lists, samples, pq_dim, pq_len) → pool subspaces into the sample axis
+    pool = slices[rows_j].reshape(n_lists, samples_per_list * pq_dim, pq_len)
+    keys = jax.random.split(key_fit, n_lists)
+    return jax.vmap(_kmeans_fixed, in_axes=(0, None, None, 0))(
+        pool, book_size, iters, keys)
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _encode(resid_rot, codebooks, labels, kind_per_cluster: bool):
+    """Residuals → (n, pq_dim) uint8 codes: per-subspace argmin."""
+    n = resid_rot.shape[0]
+    if kind_per_cluster:
+        pq_len = codebooks.shape[2]
+        pq_dim = resid_rot.shape[1] // pq_len
+        slices = resid_rot.reshape(n, pq_dim, pq_len)
+        books = codebooks[labels]                    # (n, book, pq_len)
+        d2 = (jnp.sum(slices * slices, axis=2)[:, :, None]
+              - 2.0 * jnp.einsum("nsl,nbl->nsb", slices, books)
+              + jnp.sum(books * books, axis=2)[:, None, :])
+        return jnp.argmin(d2, axis=2).astype(jnp.uint8)
+    pq_dim, _, pq_len = codebooks.shape
+    slices = resid_rot.reshape(n, pq_dim, pq_len)
+    d2 = (jnp.sum(slices * slices, axis=2)[:, :, None]
+          - 2.0 * jnp.einsum("nsl,sbl->nsb", slices, codebooks)
+          + jnp.sum(codebooks * codebooks, axis=2)[None, :, :])
+    return jnp.argmin(d2, axis=2).astype(jnp.uint8)
+
+
+@tracing.annotate("raft_tpu::ivf_pq::build")
+def build(dataset, params: IndexParams | None = None) -> Index:
+    """Train coarse quantizer + rotation + codebooks, then pack the dataset
+    (detail/ivf_pq_build.cuh:1729)."""
+    p = params or IndexParams()
+    dataset = np.asarray(dataset, np.float32)
+    n, dim = dataset.shape
+    mt = canonical_metric(p.metric)
+    expects(mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+                   DistanceType.InnerProduct),
+            "ivf_pq supports L2/IP metrics, got %s", mt.name)
+    expects(4 <= p.pq_bits <= 8, "pq_bits must be in [4,8], got %d", p.pq_bits)
+    expects(p.n_lists <= n, "n_lists %d > n %d", p.n_lists, n)
+    pq_dim = p.pq_dim or _default_pq_dim(dim)
+    pq_len = cdiv(dim, pq_dim)
+    rot_dim = pq_dim * pq_len
+    book_size = 1 << p.pq_bits
+    key = jax.random.key(p.seed)
+    k_rot, k_book = jax.random.split(key)
+
+    # coarse quantizer on a subsample (ivf_pq_build.cuh:1760-1830)
+    n_train = max(p.n_lists, min(n, int(n * p.kmeans_trainset_fraction)))
+    stride = max(1, n // n_train)
+    trainset = jnp.asarray(dataset[::stride])
+    bparams = kmeans_balanced.BalancedKMeansParams(
+        n_iters=p.kmeans_n_iters, seed=p.seed)
+    centers = kmeans_balanced.fit(trainset, p.n_lists, bparams)
+
+    rotation = make_rotation_matrix(k_rot, rot_dim, dim,
+                                    p.force_random_rotation)
+    centers_rot = centers @ rotation.T
+
+    # codebooks on rotated trainset residuals (ivf_pq_build.cuh:1855-1873)
+    train_rot = trainset @ rotation.T
+    t_labels, _ = kmeans_balanced.predict(trainset, centers)
+    t_resid = train_rot - centers_rot[t_labels]
+    if p.codebook_kind is CodebookGen.PER_SUBSPACE:
+        slices = jnp.transpose(
+            t_resid.reshape(-1, pq_dim, pq_len), (1, 0, 2))
+        codebooks = _train_per_subspace(slices, book_size, p.kmeans_n_iters,
+                                        k_book)
+    else:
+        codebooks = _train_per_cluster(t_resid, t_labels, p.n_lists, pq_len,
+                                       book_size, p.kmeans_n_iters, k_book)
+
+    index = Index(
+        jnp.zeros((0, pq_dim), jnp.uint8), jnp.zeros((0,), jnp.int32),
+        centers_rot, codebooks, rotation,
+        np.zeros(p.n_lists + 1, np.int64), mt, p.pq_bits, p.codebook_kind)
+    if p.add_data_on_build:
+        index = extend(index, dataset)
+    return index
+
+
+@tracing.annotate("raft_tpu::ivf_pq::extend")
+def extend(index: Index, new_vectors, new_ids=None,
+           batch_size: int = 1 << 17) -> Index:
+    """Assign, encode and merge new vectors (ivf_pq_build.cuh:1550)."""
+    new_vectors = np.asarray(new_vectors, np.float32)
+    expects(new_vectors.shape[1] == index.dim, "dim mismatch")
+    n_new = len(new_vectors)
+    if new_ids is None:
+        base = int(index.source_ids.max()) + 1 if index.size else 0
+        new_ids = np.arange(base, base + n_new, dtype=np.int32)
+
+    per_cluster = index.codebook_kind is CodebookGen.PER_CLUSTER
+    labels_parts, codes_parts = [], []
+    for b0 in range(0, n_new, batch_size):
+        xb = jnp.asarray(new_vectors[b0 : b0 + batch_size])
+        xb_rot = xb @ index.rotation.T
+        # nearest rotated center == nearest center (orthogonal rotation)
+        d2 = (jnp.sum(xb_rot * xb_rot, axis=1, keepdims=True)
+              - 2.0 * xb_rot @ index.centers_rot.T
+              + jnp.sum(index.centers_rot * index.centers_rot, axis=1)[None, :])
+        lb = jnp.argmin(d2, axis=1)
+        resid = xb_rot - index.centers_rot[lb]
+        cb = _encode(resid, index.codebooks, lb, per_cluster)
+        labels_parts.append(np.asarray(lb))
+        codes_parts.append(np.asarray(cb))
+    labels = np.concatenate(labels_parts)
+    new_codes = np.concatenate(codes_parts)
+
+    old_labels = np.repeat(np.arange(index.n_lists), index.list_sizes)
+    all_codes = np.concatenate([np.asarray(index.codes), new_codes])
+    all_ids = np.concatenate([np.asarray(index.source_ids),
+                              np.asarray(new_ids, np.int32)])
+    all_labels = np.concatenate([old_labels, labels])
+    codes, ids, offsets = _sort_by_list(all_codes, all_labels, all_ids,
+                                        index.n_lists)
+    return Index(jnp.asarray(codes), jnp.asarray(ids), index.centers_rot,
+                 index.codebooks, index.rotation, offsets, index.metric,
+                 index.pq_bits, index.codebook_kind)
+
+
+@tracing.annotate("raft_tpu::ivf_pq::search")
+def search(
+    index: Index,
+    queries,
+    k: int,
+    params: SearchParams | None = None,
+    filter: Optional[Bitset] = None,  # noqa: A002
+    query_chunk: int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """LUT-based approximate top-k (detail/ivf_pq_search.cuh:731)."""
+    p = params or SearchParams()
+    q = jnp.asarray(queries, jnp.float32)
+    expects(q.ndim == 2 and q.shape[1] == index.dim, "bad query shape %s",
+            tuple(q.shape))
+    expects(index.size > 0, "index is empty")
+    n_probes = min(p.n_probes, index.n_lists)
+
+    sizes_np = index.list_sizes
+    max_rows = _probe_budget(sizes_np, n_probes)
+    if query_chunk <= 0:
+        # candidates gather (S × pq_dim) + LUT (p × pq_dim × book) per query
+        per_q = max_rows * index.pq_dim * 8 + \
+            n_probes * index.pq_dim * index.pq_book_size * 4
+        query_chunk = max(1, min(q.shape[0], (256 << 20) // max(per_q, 1)))
+
+    offsets_j = jnp.asarray(index.list_offsets[:-1], jnp.int32)
+    sizes_j = jnp.asarray(sizes_np, jnp.int32)
+    mask_bits = filter.to_mask() if filter is not None else None
+
+    outs_d, outs_i = [], []
+    for c0 in range(0, q.shape[0], query_chunk):
+        qc = q[c0 : c0 + query_chunk]
+        d_c, i_c = _search_chunk(index, qc, k, n_probes, max_rows, offsets_j,
+                                 sizes_j, mask_bits, p.lut_dtype)
+        outs_d.append(d_c)
+        outs_i.append(i_c)
+    if len(outs_d) == 1:
+        return outs_d[0], outs_i[0]
+    return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+
+
+def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
+                  mask_bits, lut_dtype):
+    mt = index.metric
+    m = qc.shape[0]
+    pq_dim, book = index.pq_dim, index.pq_book_size
+    pq_len = index.pq_len
+    q_rot = qc @ index.rotation.T                       # (m, rot_dim)
+
+    # stage 1: coarse probe selection (select_clusters, ivf_pq_search.cuh:69)
+    cross = q_rot @ index.centers_rot.T
+    if mt is DistanceType.InnerProduct:
+        coarse = -cross
+    else:
+        c2 = jnp.sum(index.centers_rot * index.centers_rot, axis=1)
+        coarse = c2[None, :] - 2.0 * cross              # + q² is rank-constant
+    _, probed = select_k(coarse, n_probes, select_min=True)   # (m, p)
+
+    # stage 2: per-(query, probe) LUTs (the smem LUT analog)
+    centers_p = index.centers_rot[probed]               # (m, p, rot_dim)
+    if mt is DistanceType.InnerProduct:
+        qs = q_rot.reshape(m, pq_dim, pq_len)
+        if index.codebook_kind is CodebookGen.PER_SUBSPACE:
+            lut = -jnp.einsum("msl,sbl->msb", qs, index.codebooks)
+            lut = jnp.broadcast_to(lut[:, None], (m, n_probes, pq_dim, book))
+        else:
+            books = index.codebooks[probed]             # (m, p, book, pq_len)
+            lut = -jnp.einsum("msl,mpbl->mpsb", qs, books)
+        const = -jnp.einsum("mr,mpr->mp", q_rot, centers_p)
+    else:
+        resid = q_rot[:, None, :] - centers_p           # (m, p, rot_dim)
+        rs = resid.reshape(m, n_probes, pq_dim, pq_len)
+        if index.codebook_kind is CodebookGen.PER_SUBSPACE:
+            cb2 = jnp.sum(index.codebooks * index.codebooks, axis=2)  # (s, b)
+            lut = (jnp.sum(rs * rs, axis=3)[..., None]
+                   - 2.0 * jnp.einsum("mpsl,sbl->mpsb", rs, index.codebooks)
+                   + cb2[None, None])
+        else:
+            books = index.codebooks[probed]             # (m, p, book, pq_len)
+            cb2 = jnp.sum(books * books, axis=3)        # (m, p, b)
+            lut = (jnp.sum(rs * rs, axis=3)[..., None]
+                   - 2.0 * jnp.einsum("mpsl,mpbl->mpsb", rs, books)
+                   + cb2[:, :, None, :])
+        const = jnp.zeros((m, n_probes), jnp.float32)
+    lut = lut.astype(lut_dtype)
+
+    # stage 3: score packed codes via one flat gather per subspace
+    rows, valid, probe_of = _candidate_rows(probed, offsets_j, sizes_j,
+                                            max_rows)
+    codes_c = index.codes[rows].astype(jnp.int32)       # (m, S, pq_dim)
+    sub_ids = jnp.arange(pq_dim, dtype=jnp.int32)
+    flat = lut.reshape(m, n_probes * pq_dim * book)
+    idx = (probe_of[:, :, None] * (pq_dim * book)
+           + sub_ids[None, None, :] * book + codes_c)   # (m, S, pq_dim)
+    vals = jnp.take_along_axis(flat, idx.reshape(m, -1), axis=1)
+    dist = vals.reshape(m, max_rows, pq_dim).sum(axis=2).astype(jnp.float32)
+    dist = dist + jnp.take_along_axis(const, probe_of, axis=1)
+    if mt is DistanceType.L2SqrtExpanded:
+        dist = jnp.sqrt(jnp.maximum(dist, 0.0))
+
+    if mask_bits is not None:
+        valid = valid & mask_bits[index.source_ids[rows]]
+    dist = jnp.where(valid, dist, jnp.inf)
+    kk = min(k, max_rows)
+    out_d, locs = select_k(dist, kk, select_min=True)
+    out_i = jnp.take_along_axis(index.source_ids[rows], locs, axis=1)
+    out_i = jnp.where(jnp.isfinite(out_d), out_i, -1)
+    if mt is DistanceType.InnerProduct:
+        out_d = -out_d                                  # report true IP
+    if kk < k:
+        pad = k - kk
+        bad = -jnp.inf if mt is DistanceType.InnerProduct else jnp.inf
+        out_d = jnp.pad(out_d, ((0, 0), (0, pad)), constant_values=bad)
+        out_i = jnp.pad(out_i, ((0, 0), (0, pad)), constant_values=-1)
+    return out_d, out_i
+
+
+def reconstruct(index: Index, row_ids) -> jax.Array:
+    """Decode rows back to (approximate) input-space vectors
+    (ivf_pq helpers reconstruct_list_data, detail/ivf_pq_build.cuh)."""
+    row_ids = jnp.asarray(row_ids, jnp.int32)
+    labels = jnp.asarray(
+        np.repeat(np.arange(index.n_lists), index.list_sizes))[row_ids]
+    codes = index.codes[row_ids].astype(jnp.int32)      # (r, pq_dim)
+    if index.codebook_kind is CodebookGen.PER_CLUSTER:
+        books = index.codebooks[labels]                 # (r, book, pq_len)
+        decoded = jnp.take_along_axis(
+            books, codes[:, :, None], axis=1)           # (r, pq_dim, pq_len)
+    else:
+        decoded = index.codebooks[
+            jnp.arange(index.pq_dim)[None, :], codes]   # (r, pq_dim, pq_len)
+    y_rot = index.centers_rot[labels] + decoded.reshape(len(row_ids), -1)
+    return y_rot @ index.rotation                       # back-project
+
+
+def pack_codes(codes: np.ndarray, pq_bits: int) -> np.ndarray:
+    """Bit-pack (n, pq_dim) byte codes → (n, ceil(pq_dim*bits/8)) for
+    storage (analog of ivf_pq_codepacking.cuh)."""
+    codes = np.asarray(codes, np.uint8)
+    n, pq_dim = codes.shape
+    bits = np.unpackbits(codes[:, :, None], axis=2, count=8)[:, :, 8 - pq_bits:]
+    flat = bits.reshape(n, pq_dim * pq_bits)
+    out_bytes = cdiv(pq_dim * pq_bits, 8) * 8
+    flat = np.pad(flat, ((0, 0), (0, out_bytes - flat.shape[1])))
+    return np.packbits(flat, axis=1)
+
+
+def unpack_codes(packed: np.ndarray, pq_dim: int, pq_bits: int) -> np.ndarray:
+    """Inverse of :func:`pack_codes`."""
+    packed = np.asarray(packed, np.uint8)
+    n = packed.shape[0]
+    flat = np.unpackbits(packed, axis=1)[:, : pq_dim * pq_bits]
+    bits = flat.reshape(n, pq_dim, pq_bits)
+    weights = (1 << np.arange(pq_bits - 1, -1, -1)).astype(np.uint32)
+    return (bits * weights).sum(axis=2).astype(np.uint8)
+
+
+def save(index: Index, path) -> None:
+    """Serialize (analog of detail/ivf_pq_serialize.cuh)."""
+    save_arrays(
+        path, "ivf_pq", _SERIAL_VERSION,
+        {"metric": index.metric.value, "pq_bits": index.pq_bits,
+         "codebook_kind": index.codebook_kind.value,
+         "pq_dim": index.pq_dim},
+        {
+            "codes": pack_codes(np.asarray(index.codes), index.pq_bits),
+            "source_ids": index.source_ids,
+            "centers_rot": index.centers_rot,
+            "codebooks": index.codebooks,
+            "rotation": index.rotation,
+            "list_offsets": index.list_offsets,
+        })
+
+
+def load(path) -> Index:
+    _, version, meta, arrs = load_arrays(path, "ivf_pq")
+    expects(version == _SERIAL_VERSION, "unsupported version %d", version)
+    codes = unpack_codes(arrs["codes"], meta["pq_dim"], meta["pq_bits"])
+    return Index(
+        jnp.asarray(codes), jnp.asarray(arrs["source_ids"]),
+        jnp.asarray(arrs["centers_rot"]), jnp.asarray(arrs["codebooks"]),
+        jnp.asarray(arrs["rotation"]),
+        np.asarray(arrs["list_offsets"], np.int64),
+        DistanceType(meta["metric"]), meta["pq_bits"],
+        CodebookGen(meta["codebook_kind"]))

@@ -1,0 +1,70 @@
+"""Candidate re-ranking: analog of ``raft::neighbors::refine``.
+
+Reference: raft/neighbors/refine-inl.cuh — given candidate neighbor lists
+(e.g. from ivf_pq::search with a larger k), recompute exact distances
+against the original dataset and keep the best k (device kernel
+detail/refine_device.cuh; host/OpenMP path detail/refine_host-inl.hpp).
+
+TPU design: one gather + batched dot products + select_k; -1 candidate ids
+(padding from upstream searches) are masked out.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tracing
+from ..core.errors import expects
+from ..distance.distance_types import DistanceType, canonical_metric
+from ..matrix.select_k import select_k
+
+__all__ = ["refine"]
+
+
+@tracing.annotate("raft_tpu::refine")
+def refine(
+    dataset,
+    queries,
+    candidates,
+    k: int,
+    metric: DistanceType | str = DistanceType.L2Expanded,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact re-rank: (m, c) candidate ids → (m, k) distances + ids."""
+    x = jnp.asarray(dataset, jnp.float32)
+    q = jnp.asarray(queries, jnp.float32)
+    cand = jnp.asarray(candidates, jnp.int32)
+    mt = canonical_metric(metric)
+    expects(mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+                   DistanceType.InnerProduct, DistanceType.CosineExpanded),
+            "refine supports L2/IP/cosine metrics, got %s", mt.name)
+    expects(q.shape[1] == x.shape[1], "dim mismatch")
+    expects(cand.ndim == 2 and cand.shape[0] == q.shape[0],
+            "candidates must be (n_queries, n_candidates)")
+    expects(k <= cand.shape[1], "k %d > n_candidates %d", k, cand.shape[1])
+
+    valid = cand >= 0
+    rows = jnp.where(valid, cand, 0)
+    vecs = x[rows]                                   # (m, c, d)
+    ip = jnp.einsum("mcd,md->mc", vecs, q)
+    if mt is DistanceType.InnerProduct:
+        dist = -ip
+    elif mt is DistanceType.CosineExpanded:
+        qn = jnp.sqrt(jnp.maximum(jnp.sum(q * q, axis=1, keepdims=True), 1e-30))
+        vn = jnp.sqrt(jnp.maximum(jnp.sum(vecs * vecs, axis=2), 1e-30))
+        dist = 1.0 - ip / (qn * vn)
+    else:
+        q2 = jnp.sum(q * q, axis=1, keepdims=True)
+        v2 = jnp.sum(vecs * vecs, axis=2)
+        dist = jnp.maximum(q2 + v2 - 2.0 * ip, 0.0)
+        if mt is DistanceType.L2SqrtExpanded:
+            dist = jnp.sqrt(dist)
+
+    dist = jnp.where(valid, dist, jnp.inf)
+    vals, locs = select_k(dist, k, select_min=True)
+    ids = jnp.take_along_axis(rows, locs, axis=1)
+    ids = jnp.where(jnp.isfinite(vals), ids, -1)
+    if mt is DistanceType.InnerProduct:
+        vals = jnp.where(jnp.isfinite(vals), -vals, -jnp.inf)
+    return vals, ids
