@@ -5,6 +5,8 @@ Families mirror the reference: ``brute_force`` (exact), ``ivf_flat``,
 (CPU interop), ``ball_cover``, ``epsilon_neighborhood``; sample filters in
 ``filters``.
 """
-from . import ann_types, brute_force, ivf_flat, ivf_pq, refine
+from . import (ann_types, brute_force, cagra, ivf_flat, ivf_pq, nn_descent,
+               refine)
 
-__all__ = ["ann_types", "brute_force", "ivf_flat", "ivf_pq", "refine"]
+__all__ = ["ann_types", "brute_force", "cagra", "ivf_flat", "ivf_pq",
+           "nn_descent", "refine"]

@@ -257,49 +257,50 @@ def search(
     return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
 
 
-def _coarse_distances(qc, index: Index, mt):
-    cross = qc @ index.centers.T
-    if mt is DistanceType.InnerProduct:
-        return -cross  # pick largest IP → smallest negative
-    if mt is DistanceType.CosineExpanded:
-        qn = jnp.sqrt(jnp.maximum(jnp.sum(qc * qc, axis=1, keepdims=True), 1e-30))
-        cn = jnp.sqrt(jnp.maximum(index.center_norms, 1e-30))
-        return 1.0 - cross / (qn * cn[None, :])
-    q2 = jnp.sum(qc * qc, axis=1, keepdims=True)
-    return jnp.maximum(q2 + index.center_norms[None, :] - 2.0 * cross, 0.0)
-
-
-def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
-                  mask_bits, select_min, mt):
+def search_arrays(data, data_norms, source_ids, centers, center_norms,
+                  offsets_j, sizes_j, qc, k, n_probes, max_rows, mt,
+                  mask_bits=None):
+    """Pure-array IVF-Flat search core — everything traced, so it runs under
+    jit, vmap and shard_map alike (the multi-chip path stacks per-shard
+    arrays and calls this per shard)."""
+    select_min = is_min_close(mt)
     # stage 1: coarse probe selection (ivf_flat_search-inl.cuh:38)
-    coarse = _coarse_distances(qc, index, mt)
+    cross = qc @ centers.T
+    if mt is DistanceType.InnerProduct:
+        coarse = -cross
+    elif mt is DistanceType.CosineExpanded:
+        qn = jnp.sqrt(jnp.maximum(jnp.sum(qc * qc, axis=1, keepdims=True), 1e-30))
+        cn = jnp.sqrt(jnp.maximum(center_norms, 1e-30))
+        coarse = 1.0 - cross / (qn * cn[None, :])
+    else:
+        q2 = jnp.sum(qc * qc, axis=1, keepdims=True)
+        coarse = jnp.maximum(q2 + center_norms[None, :] - 2.0 * cross, 0.0)
     _, probed = select_k(coarse, n_probes, select_min=True)
 
     # stage 2: gather candidates and score (the fused-scan analog)
     rows, valid, _ = _candidate_rows(probed, offsets_j, sizes_j, max_rows)
-    cand = index.data[rows]                      # (m, S, d)
+    cand = data[rows]                            # (m, S, d)
     if mt is DistanceType.InnerProduct:
         dist = jnp.einsum("msd,md->ms", cand, qc)
     elif mt is DistanceType.CosineExpanded:
         ip = jnp.einsum("msd,md->ms", cand, qc)
         qn = jnp.sqrt(jnp.maximum(jnp.sum(qc * qc, axis=1, keepdims=True), 1e-30))
-        cn = jnp.sqrt(jnp.maximum(index.data_norms[rows], 1e-30))
+        cn = jnp.sqrt(jnp.maximum(data_norms[rows], 1e-30))
         dist = 1.0 - ip / (qn * cn)
     else:
         ip = jnp.einsum("msd,md->ms", cand, qc)
         q2 = jnp.sum(qc * qc, axis=1, keepdims=True)
-        dist = jnp.maximum(q2 + index.data_norms[rows] - 2.0 * ip, 0.0)
+        dist = jnp.maximum(q2 + data_norms[rows] - 2.0 * ip, 0.0)
         if mt is DistanceType.L2SqrtExpanded:
             dist = jnp.sqrt(dist)
 
     if mask_bits is not None:
-        src = index.source_ids[rows]
-        valid = valid & mask_bits[src]
+        valid = valid & mask_bits[source_ids[rows]]
     bad = jnp.inf if select_min else -jnp.inf
     dist = jnp.where(valid, dist, bad)
     kk = min(k, max_rows)
     vals, locs = select_k(dist, kk, select_min=select_min)
-    ids = jnp.take_along_axis(index.source_ids[rows], locs, axis=1)
+    ids = jnp.take_along_axis(source_ids[rows], locs, axis=1)
     ids = jnp.where(jnp.isfinite(vals) if select_min else vals > -jnp.inf,
                     ids, -1)
     if kk < k:  # pad (tiny indexes)
@@ -307,6 +308,13 @@ def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
         vals = jnp.pad(vals, ((0, 0), (0, pad)), constant_values=bad)
         ids = jnp.pad(ids, ((0, 0), (0, pad)), constant_values=-1)
     return vals, ids
+
+
+def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
+                  mask_bits, mt):
+    return search_arrays(index.data, index.data_norms, index.source_ids,
+                         index.centers, index.center_norms, offsets_j,
+                         sizes_j, qc, k, n_probes, max_rows, mt, mask_bits)
 
 
 def save(index: Index, path) -> None:

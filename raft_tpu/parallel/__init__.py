@@ -1,4 +1,4 @@
 """Multi-chip (MNMG-analog) sharded algorithms over jax.sharding meshes."""
-from . import sharded_knn
+from . import sharded_ann, sharded_knn
 
-__all__ = ["sharded_knn"]
+__all__ = ["sharded_ann", "sharded_knn"]

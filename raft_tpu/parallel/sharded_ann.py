@@ -1,0 +1,235 @@
+"""Multi-chip sharded ANN indexes (IVF-Flat and CAGRA): the MNMG analog for
+approximate search.
+
+Reference pattern (SURVEY.md §2.11.3, BASELINE north star "sharded IVF-PQ
+DEEP-1B build on v5p-32"): each rank builds an index over its own rows;
+queries are replicated; each rank searches locally and per-shard top-k
+lists are merged (detail/knn_merge_parts.cuh:172). raft-dask bootstraps
+this per worker; here one process drives the whole mesh.
+
+TPU design: per-shard index arrays are **stacked along a leading axis and
+sharded over the mesh** with `jax.sharding` (shape (p, ...) with spec
+P(AXIS, ...)); the single-chip pure-array search cores
+(ivf_flat.search_arrays, cagra._search_jit internals) run inside one
+`shard_map`, then an `all_gather` of the (k)-wide result lists crosses ICI
+for the merge — vectors never move between chips. Shard row counts are
+padded to a common size; source ids carry GLOBAL row numbers so the merge
+is trivial.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.errors import expects
+from ..distance.distance_types import DistanceType, canonical_metric, is_min_close
+from ..neighbors import brute_force, cagra, ivf_flat
+from ..utils import cdiv
+
+__all__ = ["ShardedIvfFlat", "build_ivf_flat", "search_ivf_flat",
+           "ShardedCagra", "build_cagra", "search_cagra"]
+
+AXIS = "shard"
+
+
+def _split_rows(n: int, p: int) -> list[np.ndarray]:
+    """Balanced contiguous row ranges per shard (the reference shards row
+    blocks); no shard is ever empty for n >= p."""
+    expects(n >= p, "cannot shard %d rows over %d shards", n, p)
+    return np.array_split(np.arange(n), p)
+
+
+def _stack_pad(arrs: list[np.ndarray], pad_value=0) -> np.ndarray:
+    """Stack along a new leading axis, padding dim 0 to the common max."""
+    m = max(a.shape[0] for a in arrs)
+    out = np.full((len(arrs), m) + arrs[0].shape[1:], pad_value,
+                  arrs[0].dtype)
+    for i, a in enumerate(arrs):
+        out[i, : a.shape[0]] = a
+    return out
+
+
+class ShardedIvfFlat:
+    """Stacked per-shard IVF-Flat arrays, leading axis sharded over AXIS."""
+
+    def __init__(self, mesh, data, data_norms, source_ids, centers,
+                 center_norms, offsets, sizes, n_total, metric, max_rows_tbl):
+        self.mesh = mesh
+        self.data = data                    # (p, R, d)
+        self.data_norms = data_norms        # (p, R)
+        self.source_ids = source_ids        # (p, R) global ids, -1 pad
+        self.centers = centers              # (p, L, d)
+        self.center_norms = center_norms    # (p, L)
+        self.offsets = offsets              # (p, L) row offsets (per shard)
+        self.sizes = sizes                  # (p, L) list sizes
+        self.n_total = n_total
+        self.metric = metric
+        self._max_rows_tbl = max_rows_tbl   # host: n_probes → max_rows bound
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.shape[AXIS]
+
+    def max_rows(self, n_probes: int) -> int:
+        """Static probe budget: max over shards of the n_probes largest
+        lists summed."""
+        return int(max(
+            ivf_flat._probe_budget(s, n_probes) for s in self._max_rows_tbl))
+
+
+def build_ivf_flat(dataset, mesh: Mesh,
+                   params: ivf_flat.IndexParams | None = None
+                   ) -> ShardedIvfFlat:
+    """Build one IVF-Flat index per shard over its contiguous row block
+    (the raft-dask pattern: each worker indexes its own partition)."""
+    expects(AXIS in mesh.shape, "mesh must have a %r axis", AXIS)
+    p0 = params or ivf_flat.IndexParams()
+    dataset = np.asarray(dataset, np.float32)
+    n = len(dataset)
+    p = mesh.shape[AXIS]
+    parts = _split_rows(n, p)
+    expects(p0.n_lists <= min(len(r) for r in parts),
+            "n_lists %d > smallest shard %d", p0.n_lists,
+            min(len(r) for r in parts))
+
+    shards = [ivf_flat.build(dataset[rows], p0) for rows in parts]
+    mt = shards[0].metric
+
+    data = _stack_pad([np.asarray(s.data) for s in shards])
+    norms = _stack_pad([np.asarray(s.data_norms) for s in shards])
+    # rebase local ids to global row numbers
+    gids = _stack_pad(
+        [np.asarray(s.source_ids) + parts[i][0] for i, s in enumerate(shards)],
+        pad_value=-1)
+    centers = np.stack([np.asarray(s.centers) for s in shards])
+    cnorms = np.stack([np.asarray(s.center_norms) for s in shards])
+    offsets = np.stack([s.list_offsets[:-1] for s in shards]).astype(np.int32)
+    sizes = np.stack([s.list_sizes for s in shards]).astype(np.int32)
+
+    def put(x, spec):
+        return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
+
+    return ShardedIvfFlat(
+        mesh,
+        put(data, P(AXIS, None, None)), put(norms, P(AXIS, None)),
+        put(gids, P(AXIS, None)),
+        put(centers, P(AXIS, None, None)), put(cnorms, P(AXIS, None)),
+        put(offsets, P(AXIS, None)), put(sizes, P(AXIS, None)),
+        n, mt, [s.list_sizes for s in shards])
+
+
+def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
+                    params: ivf_flat.SearchParams | None = None
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Replicated queries → per-shard local search → all_gather + merge."""
+    sp = params or ivf_flat.SearchParams()
+    q = jnp.asarray(queries, jnp.float32)
+    n_probes = min(sp.n_probes, index.centers.shape[1])
+    max_rows = index.max_rows(n_probes)
+    mt = index.metric
+    select_min = is_min_close(mt)
+
+    def local(data, norms, gids, centers, cnorms, offsets, sizes, qq):
+        args = [a[0] for a in (data, norms, gids, centers, cnorms, offsets,
+                               sizes)]
+        d, i = ivf_flat.search_arrays(
+            args[0], args[1], args[2], args[3], args[4], args[5], args[6],
+            qq, k, n_probes, max_rows, mt)
+        all_d = jax.lax.all_gather(d, AXIS)     # (p, m, k)
+        all_i = jax.lax.all_gather(i, AXIS)
+        return brute_force.knn_merge_parts(all_d, all_i, select_min)
+
+    shmap = jax.shard_map(
+        local, mesh=index.mesh,
+        in_specs=(P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
+                  P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
+                  P(AXIS, None), P()),
+        out_specs=(P(), P()),
+        check_vma=False)
+    return shmap(index.data, index.data_norms, index.source_ids,
+                 index.centers, index.center_norms, index.offsets,
+                 index.sizes, q)
+
+
+class ShardedCagra:
+    """Stacked per-shard CAGRA graphs, leading axis sharded over AXIS."""
+
+    def __init__(self, mesh, data, graphs, bases, counts, n_total, metric):
+        self.mesh = mesh
+        self.data = data        # (p, R, d) padded rows
+        self.graphs = graphs    # (p, R, deg) LOCAL neighbor ids
+        self.bases = bases      # (p,) global row base per shard
+        self.counts = counts    # (p,) real (unpadded) rows per shard
+        self.n_total = n_total
+        self.metric = metric
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.shape[AXIS]
+
+
+def build_cagra(dataset, mesh: Mesh,
+                params: cagra.IndexParams | None = None) -> ShardedCagra:
+    """Build one CAGRA graph per shard row block."""
+    expects(AXIS in mesh.shape, "mesh must have a %r axis", AXIS)
+    p0 = params or cagra.IndexParams()
+    dataset = np.asarray(dataset, np.float32)
+    n = len(dataset)
+    p = mesh.shape[AXIS]
+    parts = _split_rows(n, p)
+    shards = [cagra.build(dataset[rows], p0) for rows in parts]
+    mt = shards[0].metric
+
+    data = _stack_pad([np.asarray(s.dataset) for s in shards])
+    graphs = _stack_pad([np.asarray(s.graph) for s in shards])
+    bases = np.array([r[0] for r in parts], np.int32)
+    counts = np.array([len(r) for r in parts], np.int32)
+
+    def put(x, spec):
+        return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
+
+    return ShardedCagra(mesh, put(data, P(AXIS, None, None)),
+                        put(graphs, P(AXIS, None, None)),
+                        put(bases, P(AXIS)), put(counts, P(AXIS)), n, mt)
+
+
+def search_cagra(index: ShardedCagra, queries, k: int,
+                 params: cagra.SearchParams | None = None
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Replicated queries → per-shard graph traversal → all_gather + merge."""
+    sp = params or cagra.SearchParams()
+    q = jnp.asarray(queries, jnp.float32)
+    itopk = max(sp.itopk_size, k)
+    width = max(1, sp.search_width)
+    max_iter = sp.max_iterations or (itopk // width + 16)
+    degree = index.graphs.shape[2]
+    n_seeds = min(itopk, max(width * degree // 2,
+                             16 * sp.num_random_samplings))
+    mt = index.metric
+    select_min = mt is not DistanceType.InnerProduct
+
+    def local(data, graph, base, count, qq):
+        # padding rows (beyond this shard's real count) are masked out so
+        # random seeding can't surface them
+        valid = jnp.arange(data.shape[1], dtype=jnp.int32) < count[0]
+        d, i = cagra._search_jit(
+            data[0], graph[0], qq, valid, jax.random.key(0x5EED), itopk,
+            width, int(max_iter), k, n_seeds, mt.value)
+        gi = jnp.where(i >= 0, i + base[0], -1)
+        bad = jnp.inf if select_min else -jnp.inf
+        d = jnp.where(gi >= 0, d, bad)
+        all_d = jax.lax.all_gather(d, AXIS)
+        all_i = jax.lax.all_gather(gi, AXIS)
+        return brute_force.knn_merge_parts(all_d, all_i, select_min)
+
+    shmap = jax.shard_map(
+        local, mesh=index.mesh,
+        in_specs=(P(AXIS, None, None), P(AXIS, None, None), P(AXIS), P(AXIS),
+                  P()),
+        out_specs=(P(), P()),
+        check_vma=False)
+    return shmap(index.data, index.graphs, index.bases, index.counts, q)

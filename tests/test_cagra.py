@@ -1,0 +1,115 @@
+"""CAGRA + NN-descent tests (analog of NEIGHBORS_ANN_CAGRA_TEST /
+NEIGHBORS_ANN_NN_DESCENT_TEST): recall vs brute-force oracle (SURVEY.md §4)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ann_utils import calc_recall, naive_knn
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import cagra, nn_descent
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(7)
+    return rng.standard_normal((10_000, 32)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def queries():
+    rng = np.random.default_rng(8)
+    return rng.standard_normal((100, 32)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def knn_oracle(dataset):
+    return naive_knn(dataset, dataset, 33)  # k+1: includes self
+
+
+@pytest.fixture(scope="module")
+def built_index(dataset):
+    return cagra.build(dataset, cagra.IndexParams(
+        intermediate_graph_degree=64, graph_degree=32, seed=0))
+
+
+class TestNnDescent:
+    def test_graph_quality(self, dataset, knn_oracle):
+        k = 32
+        graph = nn_descent.build(dataset, k, n_iters=20, seed=0)
+        assert graph.shape == (len(dataset), k)
+        assert (graph != np.arange(len(dataset))[:, None]).all()  # no self
+        _, want_full = knn_oracle
+        # drop the self column from the oracle
+        want = np.empty((len(dataset), k), np.int64)
+        for i in range(len(dataset)):
+            row = want_full[i][want_full[i] != i][:k]
+            want[i] = row
+        r = calc_recall(graph, want)
+        assert r >= 0.85, f"nn_descent graph recall {r}"
+
+
+class TestCagra:
+    def test_structure(self, built_index, dataset):
+        assert built_index.size == len(dataset)
+        assert built_index.graph_degree == 32
+        g = np.asarray(built_index.graph)
+        assert g.min() >= 0 and g.max() < len(dataset)
+        assert (g != np.arange(len(dataset))[:, None]).all()  # no self loops
+
+    @pytest.mark.parametrize("itopk,min_recall", [(64, 0.90), (128, 0.95)])
+    def test_recall(self, built_index, dataset, queries, itopk, min_recall):
+        _, idx = cagra.search(built_index, queries, k=10,
+                              params=cagra.SearchParams(itopk_size=itopk))
+        _, want = naive_knn(dataset, queries, 10)
+        r = calc_recall(np.asarray(idx), want)
+        assert r >= min_recall, f"recall {r} < {min_recall} at itopk={itopk}"
+
+    def test_distances_match_l2(self, built_index, dataset, queries):
+        dist, idx = cagra.search(built_index, queries, k=5,
+                                 params=cagra.SearchParams(itopk_size=64))
+        d, i = np.asarray(dist), np.asarray(idx)
+        for row in range(0, 100, 13):
+            true = ((queries[row] - dataset[i[row, 0]]) ** 2).sum()
+            assert abs(d[row, 0] - true) < 1e-1
+
+    def test_search_width(self, built_index, dataset, queries):
+        _, idx = cagra.search(built_index, queries, k=10,
+                              params=cagra.SearchParams(itopk_size=64,
+                                                        search_width=4))
+        _, want = naive_knn(dataset, queries, 10)
+        assert calc_recall(np.asarray(idx), want) >= 0.85
+
+    def test_nn_descent_build(self, dataset, queries):
+        index = cagra.build(dataset, cagra.IndexParams(
+            intermediate_graph_degree=64, graph_degree=32,
+            build_algo=cagra.BuildAlgo.NN_DESCENT, seed=0))
+        _, idx = cagra.search(index, queries, k=10,
+                              params=cagra.SearchParams(itopk_size=64))
+        _, want = naive_knn(dataset, queries, 10)
+        assert calc_recall(np.asarray(idx), want) >= 0.85
+
+    def test_filter(self, built_index, dataset, queries):
+        _, base = naive_knn(dataset, queries, 1)
+        mask = np.ones(len(dataset), bool)
+        mask[base[:, 0]] = False
+        filt = Bitset.from_mask(jnp.asarray(mask))
+        _, idx = cagra.search(built_index, queries, k=10,
+                              params=cagra.SearchParams(itopk_size=64),
+                              filter=filt)
+        got = np.asarray(idx)
+        assert all(base[i, 0] not in got[i] for i in range(len(got)))
+
+    def test_save_load(self, tmp_path, built_index, queries):
+        cagra.save(built_index, tmp_path / "cagra.raft")
+        loaded = cagra.load(tmp_path / "cagra.raft")
+        _, i1 = cagra.search(built_index, queries, k=5,
+                             params=cagra.SearchParams(itopk_size=64))
+        _, i2 = cagra.search(loaded, queries, k=5,
+                             params=cagra.SearchParams(itopk_size=64))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_optimize_prunes_to_degree(self, dataset):
+        knn = cagra.build_knn_graph(dataset[:2000], 32, seed=0)
+        graph = cagra.optimize(knn, 16)
+        assert graph.shape == (2000, 16)
+        assert (graph != np.arange(2000)[:, None]).all()
