@@ -140,9 +140,12 @@ def build_knn_graph(dataset, k: int, metric=DistanceType.L2Expanded,
         rows = np.arange(b0, min(b0 + batch, n))
         out = np.empty((len(rows), k), np.int32)
         for r, row in enumerate(rows):
-            nb = ref[r][ref[r] != row]
-            out[r] = np.resize(nb, k) if len(nb) >= k else np.resize(
-                np.concatenate([nb, ref[r][: k - len(nb)]]), k)
+            # drop self and the -1 padding refine emits when it runs out of
+            # finite candidates; pad by cycling the valid neighbors
+            nb = ref[r][(ref[r] != row) & (ref[r] >= 0)]
+            if len(nb) == 0:
+                nb = np.array([(row + 1) % n], np.int32)
+            out[r] = np.resize(nb, k)
         graph[rows] = out
     return graph
 

@@ -14,8 +14,7 @@ rows. Search is two MXU stages: (1) coarse = queries×centroids GEMM +
 select_k → n_probes lists; (2) candidate rows of the probed lists are
 gathered per query chunk and scored with a batched GEMV + masked select_k.
 The probe budget is the sum of the n_probes largest list sizes, so shapes
-stay static under jit. A fused Pallas list-scan kernel (raft_tpu.ops)
-replaces stage 2 on TPU for HBM-bound shapes.
+stay static under jit.
 """
 from __future__ import annotations
 
@@ -232,7 +231,6 @@ def search(
     expects(index.size > 0, "index is empty")
     n_probes = min(p.n_probes, index.n_lists)
     mt = index.metric
-    select_min = is_min_close(mt)
 
     sizes_np = index.list_sizes
     max_rows = _probe_budget(sizes_np, n_probes)
@@ -249,7 +247,7 @@ def search(
     for c0 in range(0, q.shape[0], query_chunk):
         qc = q[c0 : c0 + query_chunk]
         d_c, i_c = _search_chunk(index, qc, k, n_probes, max_rows, offsets_j,
-                                 sizes_j, mask_bits, select_min, mt)
+                                 sizes_j, mask_bits, mt)
         outs_d.append(d_c)
         outs_i.append(i_c)
     if len(outs_d) == 1:

@@ -170,13 +170,15 @@ def make_rotation_matrix(key, rot_dim: int, dim: int,
                          force_random: bool) -> jax.Array:
     """(rot_dim, dim) with orthonormal columns (ivf_pq_build.cuh:119).
 
-    Identity(-padded) when no rotation is needed; otherwise the Q factor of
-    a gaussian — the reference uses RSVD of a gaussian for the same effect.
+    Identity when rot_dim == dim and no rotation is forced; otherwise the Q
+    factor of a gaussian (the reference uses RSVD of a gaussian for the same
+    effect; like the reference, rot_dim != dim always randomizes).
     """
     if not force_random and rot_dim == dim:
         return jnp.eye(dim, dtype=jnp.float32)
-    if not force_random:
-        return jnp.eye(rot_dim, dim, dtype=jnp.float32)
+    # rot_dim != dim always gets a random rotation (ivf_pq_types.hpp:87-90):
+    # a zero-padded identity would leave the tail subspace mostly zeros,
+    # wasting its codebook
     g = jax.random.normal(key, (rot_dim, rot_dim), jnp.float32)
     q, _ = jnp.linalg.qr(g)
     return q[:, :dim]

@@ -29,6 +29,14 @@ from ..matrix.select_k import select_k
 __all__ = ["build"]
 
 
+def _dedup_rows(cand: np.ndarray) -> np.ndarray:
+    """Per-row candidate dedup (the _round_batch precondition): sort desc,
+    mask adjacent repeats with -1; padding collects at the end."""
+    cand = -np.sort(-cand, axis=1)
+    cand[:, 1:][cand[:, 1:] == cand[:, :-1]] = -1
+    return cand
+
+
 def _pair_dists(x_rows, vecs, mt):
     ip = jnp.einsum("bcd,bd->bc", vecs, x_rows)
     if mt is DistanceType.InnerProduct:
@@ -174,7 +182,10 @@ def build(dataset, k: int, metric=DistanceType.L2Expanded, n_iters: int = 20,
     is_new = np.zeros((n, k), bool)
     rows_all = np.arange(n, dtype=np.int32)
 
-    # score the random init (everything that survives is a new entry)
+    # score the random init (everything that survives is a new entry);
+    # _round_batch's precondition: intra-candidate duplicates removed
+    # host-side (sort desc, mask adjacent repeats)
+    init_cand = _dedup_rows(graph.copy())
     for b0 in range(0, n, batch):
         rows = rows_all[b0 : b0 + batch]
         g_i, g_d, g_n, _ = _round_batch(
@@ -182,7 +193,7 @@ def build(dataset, k: int, metric=DistanceType.L2Expanded, n_iters: int = 20,
             jnp.full((len(rows), k), -1, jnp.int32),
             jnp.full((len(rows), k), jnp.inf, jnp.float32),
             jnp.zeros((len(rows), k), bool),
-            jnp.asarray(graph[b0 : b0 + batch]), k, mt.value)
+            jnp.asarray(init_cand[b0 : b0 + batch]), k, mt.value)
         graph[b0 : b0 + batch] = np.asarray(g_i)
         dist[b0 : b0 + batch] = np.asarray(g_d)
         is_new[b0 : b0 + batch] = np.asarray(g_n)
@@ -191,11 +202,7 @@ def build(dataset, k: int, metric=DistanceType.L2Expanded, n_iters: int = 20,
     # on it that the round's information isn't thrown away
     cap = 4 * s * s
     for _ in range(n_iters):
-        cand = _local_join_proposals(graph, is_new, s, cap, rng)  # (n, cap)
-        # dedup within each row (order is irrelevant): sort desc, mask
-        # adjacent repeats, -1 padding collects at the end
-        cand = -np.sort(-cand, axis=1)
-        cand[:, 1:][cand[:, 1:] == cand[:, :-1]] = -1
+        cand = _dedup_rows(_local_join_proposals(graph, is_new, s, cap, rng))
 
         changed = 0
         for b0 in range(0, n, batch):

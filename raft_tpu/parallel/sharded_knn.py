@@ -115,7 +115,12 @@ def dryrun(n_devices: int) -> None:
     """Driver hook: build an n-device mesh on whatever devices exist and run
     one full sharded search step on tiny shapes, verifying against the
     single-chip answer."""
-    devices = jax.devices()[:n_devices]
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        # single real TPU chip under the driver: fall back to the virtual
+        # CPU devices provided by --xla_force_host_platform_device_count
+        devices = jax.devices("cpu")
+    devices = devices[:n_devices]
     expects(len(devices) == n_devices,
             "need %d devices, have %d", n_devices, len(devices))
     mesh = Mesh(np.array(devices), (AXIS,))
