@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from ..core.errors import expects
 from ..core import tracing
-from ..utils import round_up_to
+from ..utils import hdot, round_up_to
 
 __all__ = ["fused_l2_nn_argmin", "masked_l2_nn_argmin"]
 
@@ -111,7 +111,7 @@ def masked_l2_nn_argmin(
     dist = jnp.maximum(
         jnp.sum(x * x, axis=1)[:, None]
         + jnp.sum(y * y, axis=1)[None, :]
-        - 2.0 * (x @ y.T),
+        - 2.0 * hdot(x, y.T),
         0.0,
     )
     dist = jnp.where(adj, dist, jnp.inf)

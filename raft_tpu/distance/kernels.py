@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.errors import expects
+from ..utils import hdot
 from .pairwise import pairwise_distance
 
 __all__ = ["KernelType", "KernelParams", "gram_matrix"]
@@ -43,11 +44,11 @@ def gram_matrix(x: jax.Array, y: jax.Array, params: KernelParams) -> jax.Array:
     y = jnp.asarray(y, jnp.float32)
     k = params.kernel if isinstance(params.kernel, KernelType) else KernelType(params.kernel)
     if k is KernelType.LINEAR:
-        return x @ y.T
+        return hdot(x, y.T)
     if k is KernelType.POLYNOMIAL:
-        return (params.gamma * (x @ y.T) + params.coef0) ** params.degree
+        return (params.gamma * hdot(x, y.T) + params.coef0) ** params.degree
     if k is KernelType.TANH:
-        return jnp.tanh(params.gamma * (x @ y.T) + params.coef0)
+        return jnp.tanh(params.gamma * hdot(x, y.T) + params.coef0)
     if k is KernelType.RBF:
         sq = pairwise_distance(x, y, "sqeuclidean")
         return jnp.exp(-params.gamma * sq)

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 
 from ..core.errors import expects
 from ..core import tracing
-from ..utils import cdiv
+from ..utils import cdiv, hdot
 from .distance_types import DistanceType, canonical_metric
 
 __all__ = ["pairwise_distance", "distance"]
@@ -46,7 +46,7 @@ def _l2_expanded(x, y, sqrt: bool):
     """||x-y||^2 = ||x||^2 + ||y||^2 - 2<x,y>; cross term on the MXU."""
     x2 = jnp.sum(x * x, axis=1, keepdims=True)
     y2 = jnp.sum(y * y, axis=1, keepdims=True)
-    cross = x @ y.T
+    cross = hdot(x, y.T)
     d = x2 + y2.T - 2.0 * cross
     d = jnp.maximum(d, 0.0)  # clamp fp cancellation, as the reference does
     return jnp.sqrt(d) if sqrt else d
@@ -55,7 +55,7 @@ def _l2_expanded(x, y, sqrt: bool):
 def _cosine(x, y):
     xn = jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True))
     yn = jnp.sqrt(jnp.sum(y * y, axis=1, keepdims=True))
-    cross = x @ y.T
+    cross = hdot(x, y.T)
     denom = jnp.maximum(xn * yn.T, 1e-30)
     return 1.0 - cross / denom
 
@@ -68,14 +68,14 @@ def _correlation(x, y):
 
 def _hellinger(x, y):
     # d = sqrt(1 - sum_i sqrt(x_i y_i)); inputs are probability-like (>= 0).
-    ip = jnp.sqrt(jnp.abs(x)) @ jnp.sqrt(jnp.abs(y)).T
+    ip = hdot(jnp.sqrt(jnp.abs(x)), jnp.sqrt(jnp.abs(y)).T)
     return jnp.sqrt(jnp.maximum(0.0, 1.0 - jnp.minimum(ip, 1.0)))
 
 
 def _russelrao(x, y):
     # (d - <x, y>) / d over binary-ish data (reference russel_rao.cuh).
     k = x.shape[1]
-    return (k - x @ y.T) / k
+    return (k - hdot(x, y.T)) / k
 
 
 # ---------------------------------------------------------------------------
@@ -137,7 +137,7 @@ _EXPANDED = {
     DistanceType.L2Expanded: functools.partial(_l2_expanded, sqrt=False),
     DistanceType.L2SqrtExpanded: functools.partial(_l2_expanded, sqrt=True),
     DistanceType.CosineExpanded: _cosine,
-    DistanceType.InnerProduct: lambda x, y: x @ y.T,
+    DistanceType.InnerProduct: lambda x, y: hdot(x, y.T),
     DistanceType.CorrelationExpanded: _correlation,
     DistanceType.HellingerExpanded: _hellinger,
     DistanceType.RusselRaoExpanded: _russelrao,

@@ -28,7 +28,7 @@ from ..core.serialize import load_arrays, save_arrays
 from ..distance.distance_types import DistanceType, canonical_metric, is_min_close
 from ..distance.pairwise import _ELEMENTWISE, _elementwise_tile, _haversine
 from ..matrix.select_k import select_k
-from ..utils import round_up_to
+from ..utils import hdot, round_up_to
 
 __all__ = ["Index", "build", "search", "knn", "knn_merge_parts", "save", "load"]
 
@@ -78,14 +78,14 @@ def build(dataset: jax.Array, metric="sqeuclidean", metric_arg: float = 2.0) -> 
 def _tile_distances(q, q_norm, tile, tile_norm, mt, metric_arg):
     """Distance block (n_queries, tile_rows) for one dataset tile."""
     if mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
-        d = jnp.maximum(q_norm[:, None] + tile_norm[None, :] - 2.0 * (q @ tile.T), 0.0)
+        d = jnp.maximum(q_norm[:, None] + tile_norm[None, :] - 2.0 * hdot(q, tile.T), 0.0)
         return jnp.sqrt(d) if mt is DistanceType.L2SqrtExpanded else d
     if mt is DistanceType.CosineExpanded:
         qn = jnp.sqrt(jnp.maximum(q_norm, 1e-30))
         tn = jnp.sqrt(jnp.maximum(tile_norm, 1e-30))
-        return 1.0 - (q @ tile.T) / (qn[:, None] * tn[None, :])
+        return 1.0 - hdot(q, tile.T) / (qn[:, None] * tn[None, :])
     if mt is DistanceType.InnerProduct:
-        return q @ tile.T
+        return hdot(q, tile.T)
     if mt is DistanceType.Haversine:
         return _haversine(q, tile)
     if mt in (DistanceType.CorrelationExpanded, DistanceType.HellingerExpanded,
@@ -96,6 +96,38 @@ def _tile_distances(q, q_norm, tile, tile_norm, mt, metric_arg):
     return _elementwise_tile(q, tile, mt, metric_arg)
 
 
+_PALLAS_METRICS = {
+    DistanceType.L2Expanded: "l2",
+    DistanceType.L2SqrtExpanded: "l2",
+    DistanceType.CosineExpanded: "cos",
+    DistanceType.InnerProduct: "ip",
+}
+
+
+def _search_pallas(index: Index, q, k, filter, valid_rows, precision):
+    """Fused Pallas distance+top-k path (the perf path on TPU)."""
+    from ..ops import fused_knn
+
+    n = index.size
+    mt = index.metric
+    pen = None
+    if filter is not None or valid_rows is not None:
+        pen = jnp.zeros((n,), jnp.float32)
+        if filter is not None:
+            pen = jnp.where(filter.to_mask(), pen, jnp.inf)
+        if valid_rows is not None:
+            pen = jnp.where(jnp.arange(n) < valid_rows, pen, jnp.inf)
+    vals, idxs = fused_knn(q, index.dataset, k, metric=_PALLAS_METRICS[mt],
+                           data_norms=index.norms, penalty=pen,
+                           precision=precision)
+    if mt is DistanceType.L2SqrtExpanded:
+        vals = jnp.sqrt(jnp.maximum(vals, 0.0))
+    elif mt is DistanceType.InnerProduct:
+        # kernel min-selects -dot; report the raw inner products
+        vals = jnp.where(jnp.isfinite(vals), -vals, -jnp.inf)
+    return vals, idxs
+
+
 @tracing.annotate("raft_tpu::brute_force::search")
 def search(
     index: Index,
@@ -104,6 +136,8 @@ def search(
     tile_size: int = 8192,
     filter: Optional[Bitset] = None,  # noqa: A002 - mirrors reference name
     valid_rows: Optional[jax.Array] = None,
+    algo: str = "auto",
+    precision: str = "highest",
 ) -> Tuple[jax.Array, jax.Array]:
     """k nearest neighbors of each query → (distances (m, k), indices (m, k)).
 
@@ -112,6 +146,11 @@ def search(
     ``valid_rows``: optional traced scalar; rows at index >= valid_rows are
     excluded. Used by the sharded path where the per-shard row count is only
     known inside shard_map (padding shards).
+    ``algo``: "pallas" (fused distance+top-k kernel — the TPU perf path,
+    role of detail/knn_brute_force.cuh:61 + select_warpsort), "scan"
+    (composed-XLA streaming fallback, any metric), or "auto" (pallas on TPU
+    for L2/cosine/IP, scan otherwise).
+    ``precision``: MXU precision for the pallas GEMM ("highest"/"default").
     """
     q = jnp.asarray(queries, jnp.float32)
     expects(q.ndim == 2 and q.shape[1] == index.dim,
@@ -120,6 +159,14 @@ def search(
     expects(0 < k <= n, "k=%d out of range for index of size %d", k, n)
     mt = index.metric
     select_min = is_min_close(mt)
+
+    use_pallas = (algo == "pallas" or
+                  (algo == "auto" and mt in _PALLAS_METRICS and
+                   jax.default_backend() == "tpu"))
+    if use_pallas:
+        expects(mt in _PALLAS_METRICS,
+                "algo='pallas' supports L2/cosine/IP, got %s", mt.name)
+        return _search_pallas(index, q, k, filter, valid_rows, precision)
 
     tile = min(tile_size, round_up_to(n, 128))
     n_pad = round_up_to(n, tile)

@@ -1,0 +1,11 @@
+"""Pallas TPU kernels — the performance layer.
+
+These play the role of RAFT's fused CUDA kernels: the tiled pairwise
+engine (distance/detail/pairwise_matrix/kernel_sm60.cuh), warpsort select
+(matrix/detail/select_warpsort.cuh) and the fused IVF interleaved scan
+(neighbors/detail/ivf_flat_interleaved_scan-inl.cuh). Composed XLA ops
+top out well below 1% of MXU peak on the kNN hot path because the
+per-tile full `lax.top_k` is a full sort; these kernels keep the GEMM on
+the MXU and maintain a running k-best in VMEM instead.
+"""
+from .fused_knn import fused_knn  # noqa: F401

@@ -70,8 +70,8 @@ def build(dataset, mesh: Mesh, metric="sqeuclidean", metric_arg: float = 2.0) ->
     return ShardedIndex(mesh, dataset_sharded, n, metric, metric_arg)
 
 
-def search(index: ShardedIndex, queries, k: int, tile_size: int = 8192
-           ) -> Tuple[jax.Array, jax.Array]:
+def search(index: ShardedIndex, queries, k: int, tile_size: int = 8192,
+           algo: str | None = None) -> Tuple[jax.Array, jax.Array]:
     """Sharded search: per-shard top-k then cross-shard merge.
 
     Queries are replicated; the result is replicated (every chip holds the
@@ -81,6 +81,11 @@ def search(index: ShardedIndex, queries, k: int, tile_size: int = 8192
     shard_rows = index.shard_rows
     n_total = index.n_total
     metric, metric_arg = index.metric, index.metric_arg
+    # the per-shard compute runs on the mesh's devices, not the default
+    # backend: only use the fused Pallas path when the mesh is TPU
+    if algo is None:
+        mesh_platform = index.mesh.devices.flat[0].platform
+        algo = "auto" if mesh_platform == "tpu" else "scan"
 
     def local_search(data_shard, q):
         rank = jax.lax.axis_index(AXIS)
@@ -91,7 +96,7 @@ def search(index: ShardedIndex, queries, k: int, tile_size: int = 8192
         n_valid_local = jnp.clip(n_total - base, 0, shard_rows)
         local = brute_force.build(data_shard, metric, metric_arg)
         dist, idx = brute_force.search(local, q, k, tile_size=tile_size,
-                                       valid_rows=n_valid_local)
+                                       valid_rows=n_valid_local, algo=algo)
         gidx = jnp.where(idx >= 0, idx + base, -1)
         bad = jnp.inf if select_min else -jnp.inf
         dist = jnp.where(gidx >= 0, dist, bad)
@@ -128,9 +133,14 @@ def dryrun(n_devices: int) -> None:
     data = rng.standard_normal((256 * n_devices - 17, 64)).astype(np.float32)
     q = rng.standard_normal((16, 64)).astype(np.float32)
     index = build(data, mesh)
-    dist, idx = jax.jit(lambda qq: search(index, qq, k=5, tile_size=128))(q)
+    # pin both sides to the scan engine: the check below is exact-equality
+    # on indices, which different engines may break on fp ties
+    dist, idx = jax.jit(
+        lambda qq: search(index, qq, k=5, tile_size=128, algo="scan"))(q)
     jax.block_until_ready((dist, idx))
-    # verify against single-device exact search
-    ref_d, ref_i = brute_force.knn(data, q, 5, tile_size=512)
+    # verify against single-device exact search (scan path: the comparison
+    # is exact-equality on indices, so both sides must use the same engine)
+    local = brute_force.build(data)
+    ref_d, ref_i = brute_force.search(local, q, 5, tile_size=512, algo="scan")
     np.testing.assert_array_equal(np.asarray(idx), np.asarray(ref_i))
     print(f"dryrun_multichip ok: {n_devices} devices, merged top-5 matches single-chip")

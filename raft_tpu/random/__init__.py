@@ -1,0 +1,27 @@
+"""Random generation: analog of ``raft/random/``.
+
+Reference: rng_state.hpp:29-52 (RngState: seed + stream id, generator
+choice), rng.cuh:50-418 (distribution kernels), make_blobs.cuh,
+make_regression.cuh, rmat_rectangular_generator.cuh,
+sample_without_replacement (rng.cuh:338), permute.cuh.
+
+TPU design: JAX's counter-based PRNG (threefry) replaces
+Philox/PCG — same splittable-stream semantics the reference gets from
+(seed, subsequence) pairs. ``RngState`` wraps a key and hands out
+per-call subkeys, so repeated calls advance state like the reference's
+stateful generators. Distributions are `jax.random` one-liners; the value
+here is the API surface + the dataset generators the bench harness and
+tests consume.
+"""
+from .rng import (RngState, bernoulli, discrete, exponential, gumbel,
+                  laplace, lognormal, logistic, normal, permute, rayleigh,
+                  sample_without_replacement, scaled_bernoulli, uniform,
+                  uniform_int)
+from .datagen import make_blobs, make_regression, rmat_rectangular_generator
+
+__all__ = [
+    "RngState", "uniform", "uniform_int", "normal", "bernoulli",
+    "scaled_bernoulli", "gumbel", "lognormal", "logistic", "exponential",
+    "rayleigh", "laplace", "discrete", "sample_without_replacement",
+    "permute", "make_blobs", "make_regression", "rmat_rectangular_generator",
+]

@@ -1,0 +1,126 @@
+"""Dataset generators (raft/random/make_blobs.cuh, make_regression.cuh,
+rmat_rectangular_generator.cuh)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import expects
+from .rng import RngState, _key_of
+
+__all__ = ["make_blobs", "make_regression", "rmat_rectangular_generator"]
+
+
+def make_blobs(
+    n_samples: int,
+    n_features: int,
+    n_clusters: int = 5,
+    cluster_std: float = 1.0,
+    centers: Optional[jax.Array] = None,
+    center_box: Tuple[float, float] = (-10.0, 10.0),
+    shuffle: bool = True,
+    rng: RngState | jax.Array | int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Isotropic gaussian blobs → (data (n, d) f32, labels (n,) i32).
+
+    Mirrors raft::random::make_blobs (make_blobs.cuh): uniform centers in
+    ``center_box`` unless given, equal-sized clusters, optional shuffle.
+    """
+    if isinstance(rng, int):
+        rng = RngState(rng)
+    key_c, key_n, key_s = jax.random.split(_key_of(rng), 3)
+    if centers is None:
+        centers = jax.random.uniform(
+            key_c, (n_clusters, n_features), jnp.float32,
+            center_box[0], center_box[1])
+    else:
+        centers = jnp.asarray(centers, jnp.float32)
+        n_clusters = centers.shape[0]
+    labels = jnp.arange(n_samples, dtype=jnp.int32) % n_clusters
+    noise = cluster_std * jax.random.normal(
+        key_n, (n_samples, n_features), jnp.float32)
+    data = centers[labels] + noise
+    if shuffle:
+        perm = jax.random.permutation(key_s, n_samples)
+        data, labels = data[perm], labels[perm]
+    return data, labels
+
+
+def make_regression(
+    n_samples: int,
+    n_features: int,
+    n_informative: Optional[int] = None,
+    n_targets: int = 1,
+    bias: float = 0.0,
+    noise: float = 0.0,
+    shuffle: bool = True,
+    rng: RngState | jax.Array | int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Linear-model data → (X (n, d), y (n, t), coef (d, t))
+    (make_regression.cuh)."""
+    if isinstance(rng, int):
+        rng = RngState(rng)
+    n_informative = n_informative or n_features
+    expects(n_informative <= n_features, "n_informative > n_features")
+    kx, kc, kn, ks = jax.random.split(_key_of(rng), 4)
+    x = jax.random.normal(kx, (n_samples, n_features), jnp.float32)
+    coef = jnp.zeros((n_features, n_targets), jnp.float32)
+    coef = coef.at[:n_informative].set(
+        100.0 * jax.random.uniform(kc, (n_informative, n_targets)))
+    y = x @ coef + bias
+    if noise > 0:
+        y = y + noise * jax.random.normal(kn, y.shape, jnp.float32)
+    if shuffle:
+        perm = jax.random.permutation(ks, n_samples)
+        x, y = x[perm], y[perm]
+    return x, y, coef
+
+
+def rmat_rectangular_generator(
+    rng: RngState | jax.Array,
+    theta: jax.Array,
+    r_scale: int,
+    c_scale: int,
+    n_edges: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """R-MAT edge generator → (src (e,), dst (e,)) int32
+    (rmat_rectangular_generator.cuh).
+
+    ``theta``: (max(r_scale, c_scale), 4) per-level quadrant probabilities
+    [a, b, c, d] (rows beyond a side's scale only split along the other
+    side), or a single (4,) reused at every level.
+    """
+    theta = jnp.asarray(theta, jnp.float32)
+    if theta.ndim == 1:
+        theta = jnp.broadcast_to(theta, (max(r_scale, c_scale), 4))
+    expects(theta.shape[1] == 4, "theta must have 4 quadrant probs per level")
+    key = _key_of(rng)
+    levels = max(r_scale, c_scale)
+    u = jax.random.uniform(key, (n_edges, levels))  # one draw per level
+
+    src = jnp.zeros((n_edges,), jnp.int32)
+    dst = jnp.zeros((n_edges,), jnp.int32)
+    for lvl in range(levels):
+        a, b, c, d = theta[lvl]
+        split_r = lvl < r_scale
+        split_c = lvl < c_scale
+        if split_r and split_c:
+            # quadrant choice by cumulative [a, a+b, a+b+c]
+            x = u[:, lvl]
+            right = ((x >= a) & (x < a + b)) | (x >= a + b + c)   # col bit
+            bottom = x >= a + b                                   # row bit
+        elif split_r:
+            p_bottom = (c + d) / jnp.maximum(a + b + c + d, 1e-30)
+            bottom = u[:, lvl] < p_bottom
+            right = jnp.zeros((n_edges,), bool)
+        else:
+            p_right = (b + d) / jnp.maximum(a + b + c + d, 1e-30)
+            right = u[:, lvl] < p_right
+            bottom = jnp.zeros((n_edges,), bool)
+        if split_r:
+            src = src * 2 + bottom.astype(jnp.int32)
+        if split_c:
+            dst = dst * 2 + right.astype(jnp.int32)
+    return src, dst

@@ -1,0 +1,133 @@
+"""RngState + distribution generators (raft/random/rng.cuh:50-418)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import expects
+
+__all__ = [
+    "RngState", "uniform", "uniform_int", "normal", "bernoulli",
+    "scaled_bernoulli", "gumbel", "lognormal", "logistic", "exponential",
+    "rayleigh", "laplace", "discrete", "sample_without_replacement",
+    "permute",
+]
+
+
+class RngState:
+    """Seed + stream state (rng_state.hpp:29-52).
+
+    Each draw splits off a fresh subkey, so successive calls produce
+    independent streams, mirroring the reference's advancing subsequence
+    counter. ``fork(stream)`` gives the deterministic per-stream state the
+    reference builds with (seed, subsequence).
+    """
+
+    def __init__(self, seed: int = 0, stream: int = 0):
+        self.seed = int(seed)
+        self.stream = int(stream)
+        self._key = jax.random.fold_in(jax.random.key(self.seed), self.stream)
+
+    def next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def fork(self, stream: int) -> "RngState":
+        return RngState(self.seed, stream)
+
+
+def _key_of(rng) -> jax.Array:
+    if isinstance(rng, RngState):
+        return rng.next_key()
+    return rng  # already a jax PRNG key
+
+
+def uniform(rng, shape, low=0.0, high=1.0, dtype=jnp.float32):
+    return jax.random.uniform(_key_of(rng), shape, dtype, low, high)
+
+
+def uniform_int(rng, shape, low, high, dtype=jnp.int32):
+    return jax.random.randint(_key_of(rng), shape, low, high, dtype)
+
+
+def normal(rng, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return mu + sigma * jax.random.normal(_key_of(rng), shape, dtype)
+
+
+def bernoulli(rng, shape, prob=0.5):
+    return jax.random.bernoulli(_key_of(rng), prob, shape)
+
+
+def scaled_bernoulli(rng, shape, prob=0.5, scale=1.0, dtype=jnp.float32):
+    """±scale with P(+) = prob (rng.cuh scaled_bernoulli)."""
+    b = jax.random.bernoulli(_key_of(rng), prob, shape)
+    return jnp.where(b, dtype(scale), dtype(-scale))
+
+
+def gumbel(rng, shape, mu=0.0, beta=1.0, dtype=jnp.float32):
+    return mu + beta * jax.random.gumbel(_key_of(rng), shape, dtype)
+
+
+def lognormal(rng, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return jnp.exp(normal(rng, shape, mu, sigma, dtype))
+
+
+def logistic(rng, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return mu + scale * jax.random.logistic(_key_of(rng), shape, dtype)
+
+
+def exponential(rng, shape, lam=1.0, dtype=jnp.float32):
+    return jax.random.exponential(_key_of(rng), shape, dtype) / lam
+
+
+def rayleigh(rng, shape, sigma=1.0, dtype=jnp.float32):
+    u = jax.random.uniform(_key_of(rng), shape, dtype, 1e-12, 1.0)
+    return sigma * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+def laplace(rng, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return jax.random.laplace(_key_of(rng), shape, dtype) * scale + mu
+
+
+def discrete(rng, shape, weights):
+    """Sample indices with the given (unnormalized) weights."""
+    w = jnp.asarray(weights, jnp.float32)
+    return jax.random.categorical(_key_of(rng), jnp.log(jnp.maximum(w, 1e-30)),
+                                  shape=shape).astype(jnp.int32)
+
+
+def sample_without_replacement(
+    rng, n_samples: int, pool: Optional[jax.Array] = None,
+    n_population: Optional[int] = None,
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Weighted sampling without replacement (rng.cuh:338).
+
+    Same algorithm family as the reference: one Gumbel-top-k pass over the
+    (log-)weights — a single sort, no rejection loop.
+    """
+    if pool is not None:
+        pool = jnp.asarray(pool)
+        n_pop = pool.shape[0]
+    else:
+        expects(n_population is not None, "need pool or n_population")
+        n_pop = int(n_population)
+    expects(0 < n_samples <= n_pop,
+            "n_samples %d out of range for population %d", n_samples, n_pop)
+    key = _key_of(rng)
+    if weights is None:
+        perm_scores = jax.random.uniform(key, (n_pop,))
+    else:
+        w = jnp.asarray(weights, jnp.float32)
+        g = jax.random.gumbel(key, (n_pop,))
+        perm_scores = -(jnp.log(jnp.maximum(w, 1e-30)) + g)
+    _, idx = jax.lax.top_k(-perm_scores, n_samples)
+    idx = idx.astype(jnp.int32)
+    return pool[idx] if pool is not None else idx
+
+
+def permute(rng, n: int) -> jax.Array:
+    """Random permutation of [0, n) (permute.cuh)."""
+    return jax.random.permutation(_key_of(rng), n).astype(jnp.int32)

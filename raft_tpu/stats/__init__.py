@@ -1,0 +1,30 @@
+"""Statistics: analog of ``raft/stats/``.
+
+Reference inventory (SURVEY §2.9): summary stats (mean/var/stddev/minmax/
+histogram/cov/weighted mean) and model/cluster metrics (accuracy, r2,
+rand/adjusted-rand index, mutual info, completeness, homogeneity,
+v-measure, entropy, KL, silhouette, trustworthiness, dispersion,
+contingency matrix, information criterion) plus the device-side ANN
+quality metric ``neighborhood_recall`` (stats/neighborhood_recall.cuh:86).
+
+Most of the reference's LoC here is per-dtype CUDA kernel plumbing; on TPU
+each metric is a small jnp program, jitted at the call boundary.
+"""
+from .basic import (cov, histogram, mean, mean_center, meanvar, minmax,
+                    stddev, weighted_mean)
+from .metrics import (accuracy, adjusted_rand_index, completeness_score,
+                      contingency_matrix, dispersion, entropy,
+                      homogeneity_score, information_criterion,
+                      kl_divergence, mutual_info_score, neighborhood_recall,
+                      r2_score, rand_index, silhouette_score,
+                      trustworthiness, v_measure)
+
+__all__ = [
+    "mean", "meanvar", "mean_center", "stddev", "minmax", "histogram",
+    "cov", "weighted_mean",
+    "accuracy", "r2_score", "rand_index", "adjusted_rand_index",
+    "mutual_info_score", "completeness_score", "homogeneity_score",
+    "v_measure", "entropy", "kl_divergence", "silhouette_score",
+    "trustworthiness", "dispersion", "contingency_matrix",
+    "information_criterion", "neighborhood_recall",
+]

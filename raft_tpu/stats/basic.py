@@ -1,0 +1,73 @@
+"""Summary statistics (raft/stats/{mean,meanvar,stddev,minmax,histogram,
+cov,weighted_mean}.cuh)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["mean", "meanvar", "mean_center", "stddev", "minmax",
+           "histogram", "cov", "weighted_mean"]
+
+
+def mean(x, axis: int = 0) -> jax.Array:
+    return jnp.mean(jnp.asarray(x, jnp.float32), axis=axis)
+
+
+def meanvar(x, axis: int = 0, sample: bool = True):
+    """(mean, var) in one pass (meanvar.cuh)."""
+    x = jnp.asarray(x, jnp.float32)
+    mu = jnp.mean(x, axis=axis)
+    ddof = 1 if sample else 0
+    var = jnp.var(x, axis=axis, ddof=ddof)
+    return mu, var
+
+
+def mean_center(x, mu=None, axis: int = 0) -> jax.Array:
+    x = jnp.asarray(x, jnp.float32)
+    mu = jnp.mean(x, axis=axis, keepdims=True) if mu is None else mu
+    return x - mu
+
+
+def stddev(x, axis: int = 0, sample: bool = True) -> jax.Array:
+    return jnp.sqrt(meanvar(x, axis, sample)[1])
+
+
+def minmax(x, axis: int = 0) -> Tuple[jax.Array, jax.Array]:
+    x = jnp.asarray(x)
+    return jnp.min(x, axis=axis), jnp.max(x, axis=axis)
+
+
+def histogram(x, n_bins: int, lo: Optional[float] = None,
+              hi: Optional[float] = None) -> Tuple[jax.Array, jax.Array]:
+    """Per-column histogram → (counts (bins,) or (bins, cols), edges)."""
+    x = jnp.asarray(x, jnp.float32)
+    lo = jnp.min(x) if lo is None else lo
+    hi = jnp.max(x) if hi is None else hi
+    edges = jnp.linspace(lo, hi, n_bins + 1)
+    scaled = (x - lo) / jnp.maximum(hi - lo, 1e-30) * n_bins
+    b = jnp.clip(scaled.astype(jnp.int32), 0, n_bins - 1)
+    if x.ndim == 1:
+        counts = jnp.zeros((n_bins,), jnp.int32).at[b].add(1)
+    else:
+        cols = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape)
+        counts = jnp.zeros((n_bins, x.shape[1]), jnp.int32).at[
+            b.reshape(-1), cols.reshape(-1)].add(1)
+    return counts, edges
+
+
+def cov(x, sample: bool = True, centered: bool = False) -> jax.Array:
+    """(d, d) covariance of rows (cov.cuh)."""
+    x = jnp.asarray(x, jnp.float32)
+    if not centered:
+        x = x - jnp.mean(x, axis=0, keepdims=True)
+    denom = x.shape[0] - (1 if sample else 0)
+    return jnp.matmul(x.T, x, precision="highest") / denom
+
+
+def weighted_mean(x, weights, axis: int = 0) -> jax.Array:
+    x = jnp.asarray(x, jnp.float32)
+    w = jnp.asarray(weights, jnp.float32)
+    w = jnp.expand_dims(w, axis=1 - axis) if x.ndim == 2 and w.ndim == 1 else w
+    return jnp.sum(x * w, axis=axis) / jnp.maximum(jnp.sum(w, axis=axis), 1e-30)

@@ -1,0 +1,240 @@
+"""Model/cluster quality metrics (raft/stats/*.cuh) including the ANN
+recall metric ``neighborhood_recall`` (stats/neighborhood_recall.cuh:86)."""
+from __future__ import annotations
+
+import enum
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import expects
+
+__all__ = [
+    "accuracy", "r2_score", "contingency_matrix", "rand_index",
+    "adjusted_rand_index", "mutual_info_score", "entropy",
+    "completeness_score", "homogeneity_score", "v_measure",
+    "kl_divergence", "silhouette_score", "trustworthiness", "dispersion",
+    "information_criterion", "neighborhood_recall",
+]
+
+
+def accuracy(predictions, labels) -> jax.Array:
+    """Fraction of exact matches (stats/accuracy.cuh)."""
+    p, l = jnp.asarray(predictions), jnp.asarray(labels)
+    return jnp.mean((p == l).astype(jnp.float32))
+
+
+def r2_score(y, y_hat) -> jax.Array:
+    """Coefficient of determination (stats/regression_metrics.cuh)."""
+    y = jnp.asarray(y, jnp.float32)
+    y_hat = jnp.asarray(y_hat, jnp.float32)
+    ss_res = jnp.sum((y - y_hat) ** 2)
+    ss_tot = jnp.sum((y - jnp.mean(y)) ** 2)
+    return 1.0 - ss_res / jnp.maximum(ss_tot, 1e-30)
+
+
+def contingency_matrix(labels_a, labels_b,
+                       n_classes: Optional[int] = None) -> jax.Array:
+    """(ca, cb) count matrix (stats/contingency_matrix.cuh). Labels must be
+    in [0, n_classes); pass n_classes for a static shape under jit."""
+    a = jnp.asarray(labels_a, jnp.int32)
+    b = jnp.asarray(labels_b, jnp.int32)
+    if n_classes is None:
+        n_classes = int(max(int(jnp.max(a)), int(jnp.max(b))) + 1)
+    m = jnp.zeros((n_classes, n_classes), jnp.int32)
+    return m.at[a, b].add(1)
+
+
+def rand_index(labels_a, labels_b) -> jax.Array:
+    """Rand index via pair counts (stats/rand_index.cuh)."""
+    a = jnp.asarray(labels_a, jnp.int32)
+    b = jnp.asarray(labels_b, jnp.int32)
+    same_a = a[:, None] == a[None, :]
+    same_b = b[:, None] == b[None, :]
+    n = a.shape[0]
+    iu = jnp.triu_indices(n, k=1)
+    agree = (same_a == same_b)[iu]
+    return jnp.mean(agree.astype(jnp.float32))
+
+
+def _comb2(x):
+    return x * (x - 1.0) / 2.0
+
+
+def adjusted_rand_index(labels_a, labels_b,
+                        n_classes: Optional[int] = None):
+    """ARI from the contingency matrix (stats/adjusted_rand_index.cuh).
+
+    Counting runs on device; the scalar finish runs host-side in real
+    float64 (under JAX's default x64-disabled config a jnp float64 cast is
+    silently float32, which loses digits in the large-count cancellation)."""
+    import numpy as np
+
+    m = np.asarray(contingency_matrix(labels_a, labels_b, n_classes),
+                   np.float64)
+    n = m.sum()
+    sum_ij = _comb2(m).sum()
+    sum_a = _comb2(m.sum(axis=1)).sum()
+    sum_b = _comb2(m.sum(axis=0)).sum()
+    expected = sum_a * sum_b / max(_comb2(n), 1e-30)
+    max_index = 0.5 * (sum_a + sum_b)
+    return np.float64((sum_ij - expected) /
+                      max(max_index - expected, 1e-30))
+
+
+def entropy(labels, n_classes: Optional[int] = None) -> jax.Array:
+    """Shannon entropy (nats) of a label distribution (stats/entropy.cuh)."""
+    l = jnp.asarray(labels, jnp.int32)
+    if n_classes is None:
+        n_classes = int(jnp.max(l)) + 1
+    counts = jnp.zeros((n_classes,), jnp.float32).at[l].add(1.0)
+    p = counts / jnp.maximum(jnp.sum(counts), 1e-30)
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0))
+
+
+def mutual_info_score(labels_a, labels_b,
+                      n_classes: Optional[int] = None):
+    """MI in nats (stats/mutual_info_score.cuh). Device counting, host
+    float64 finish (see adjusted_rand_index)."""
+    import numpy as np
+
+    m = np.asarray(contingency_matrix(labels_a, labels_b, n_classes),
+                   np.float64)
+    n = max(m.sum(), 1.0)
+    pij = m / n
+    pi = pij.sum(axis=1, keepdims=True)
+    pj = pij.sum(axis=0, keepdims=True)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        ratio = np.where(pij > 0, pij / np.maximum(pi * pj, 1e-300), 1.0)
+        terms = np.where(pij > 0, pij * np.log(ratio), 0.0)
+    return np.float64(terms.sum())
+
+
+def homogeneity_score(labels_true, labels_pred,
+                      n_classes: Optional[int] = None) -> jax.Array:
+    """MI(t,p)/H(t) (stats/homogeneity_score.cuh)."""
+    mi = mutual_info_score(labels_true, labels_pred, n_classes)
+    h = entropy(labels_true, n_classes)
+    return jnp.where(h > 0, mi / h, 1.0)
+
+
+def completeness_score(labels_true, labels_pred,
+                       n_classes: Optional[int] = None) -> jax.Array:
+    """MI(t,p)/H(p) (stats/completeness_score.cuh)."""
+    mi = mutual_info_score(labels_true, labels_pred, n_classes)
+    h = entropy(labels_pred, n_classes)
+    return jnp.where(h > 0, mi / h, 1.0)
+
+
+def v_measure(labels_true, labels_pred, n_classes: Optional[int] = None,
+              beta: float = 1.0) -> jax.Array:
+    """Harmonic mean of homogeneity and completeness (stats/v_measure.cuh)."""
+    h = homogeneity_score(labels_true, labels_pred, n_classes)
+    c = completeness_score(labels_true, labels_pred, n_classes)
+    denom = beta * h + c
+    return jnp.where(denom > 0, (1 + beta) * h * c / denom, 0.0)
+
+
+def kl_divergence(p, q) -> jax.Array:
+    """KL(p || q) over probability vectors (stats/kl_divergence.cuh)."""
+    p = jnp.asarray(p, jnp.float32)
+    q = jnp.asarray(q, jnp.float32)
+    return jnp.sum(jnp.where(p > 0, p * jnp.log(p / jnp.maximum(q, 1e-30)),
+                             0.0))
+
+
+def silhouette_score(x, labels, n_clusters: Optional[int] = None,
+                     metric="sqeuclidean") -> jax.Array:
+    """Mean silhouette coefficient (stats/silhouette_score.cuh)."""
+    from ..distance.pairwise import pairwise_distance
+
+    x = jnp.asarray(x, jnp.float32)
+    l = jnp.asarray(labels, jnp.int32)
+    n = x.shape[0]
+    if n_clusters is None:
+        n_clusters = int(jnp.max(l)) + 1
+    d = pairwise_distance(x, x, metric)                       # (n, n)
+    onehot = jax.nn.one_hot(l, n_clusters, dtype=jnp.float32)  # (n, c)
+    sums = d @ onehot                                          # (n, c)
+    counts = jnp.sum(onehot, axis=0)                           # (c,)
+    own = counts[l]
+    # a: mean intra-cluster distance excluding self (distance to self = 0)
+    a = jnp.take_along_axis(sums, l[:, None], axis=1)[:, 0] / \
+        jnp.maximum(own - 1.0, 1.0)
+    # b: min mean distance to other clusters
+    means = sums / jnp.maximum(counts[None, :], 1.0)
+    means = jnp.where(jax.nn.one_hot(l, n_clusters, dtype=bool),
+                      jnp.inf, means)
+    b = jnp.min(means, axis=1)
+    s = (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30)
+    s = jnp.where(own > 1, s, 0.0)   # singleton clusters score 0
+    return jnp.mean(s)
+
+
+def trustworthiness(x, x_embedded, n_neighbors: int = 5,
+                    metric="sqeuclidean") -> jax.Array:
+    """Embedding trustworthiness (stats/trustworthiness_score.cuh)."""
+    from ..distance.pairwise import pairwise_distance
+
+    x = jnp.asarray(x, jnp.float32)
+    e = jnp.asarray(x_embedded, jnp.float32)
+    n = x.shape[0]
+    expects(n_neighbors < n // 2, "n_neighbors must be < n/2")
+    eye = jnp.eye(n, dtype=bool)
+    d_orig = jnp.where(eye, jnp.inf, pairwise_distance(x, x, metric))
+    d_emb = jnp.where(eye, jnp.inf, pairwise_distance(e, e, metric))
+    # ranks in original space
+    order_orig = jnp.argsort(d_orig, axis=1)
+    rank_orig = jnp.argsort(order_orig, axis=1)   # rank of j for row i
+    nn_emb = jnp.argsort(d_emb, axis=1)[:, :n_neighbors]
+    r = jnp.take_along_axis(rank_orig, nn_emb, axis=1)
+    penalty = jnp.maximum(r - n_neighbors + 1, 0).astype(jnp.float32)
+    scale = 2.0 / (n * n_neighbors * (2.0 * n - 3.0 * n_neighbors - 1.0))
+    return 1.0 - scale * jnp.sum(penalty)
+
+
+def dispersion(centroids, cluster_sizes, global_centroid=None) -> jax.Array:
+    """Between-cluster dispersion (stats/dispersion.cuh)."""
+    c = jnp.asarray(centroids, jnp.float32)
+    sz = jnp.asarray(cluster_sizes, jnp.float32)
+    if global_centroid is None:
+        global_centroid = jnp.sum(c * sz[:, None], axis=0) / \
+            jnp.maximum(jnp.sum(sz), 1e-30)
+    return jnp.sqrt(jnp.sum(sz * jnp.sum((c - global_centroid) ** 2, axis=1)))
+
+
+def information_criterion(log_likelihood, n_params: int, n_samples: int,
+                          kind: str = "bic") -> jax.Array:
+    """AIC/AICc/BIC batched criterion (stats/information_criterion.cuh)."""
+    ll = jnp.asarray(log_likelihood, jnp.float32)
+    if kind == "aic":
+        return 2.0 * n_params - 2.0 * ll
+    if kind == "aicc":
+        corr = (2.0 * n_params * (n_params + 1) /
+                max(n_samples - n_params - 1, 1))
+        return 2.0 * n_params - 2.0 * ll + corr
+    expects(kind == "bic", "kind must be aic|aicc|bic, got %s", kind)
+    return n_params * jnp.log(jnp.float32(n_samples)) - 2.0 * ll
+
+
+def neighborhood_recall(indices, ref_indices,
+                        distances=None, ref_distances=None,
+                        eps: float = 1e-4) -> jax.Array:
+    """ANN recall against ground truth (stats/neighborhood_recall.cuh:86).
+
+    Counts matches by id; when both distance arrays are given, a
+    distance-tie within ``eps`` also counts (the reference's tied-distance
+    relaxation). Returns the scalar recall over all (query, k) slots.
+    """
+    idx = jnp.asarray(indices)
+    ref = jnp.asarray(ref_indices)
+    expects(idx.shape == ref.shape, "shape mismatch %s vs %s",
+            idx.shape, ref.shape)
+    match = jnp.any(idx[:, :, None] == ref[:, None, :], axis=2)
+    if distances is not None and ref_distances is not None:
+        d = jnp.asarray(distances)
+        rd = jnp.asarray(ref_distances)
+        tie = jnp.any(jnp.abs(d[:, :, None] - rd[:, None, :]) <= eps, axis=2)
+        match = match | tie
+    return jnp.mean(match.astype(jnp.float32))

@@ -10,6 +10,7 @@ import math
 
 __all__ = [
     "cdiv",
+    "hdot",
     "round_up_to",
     "round_down_to",
     "next_pow2",
@@ -61,3 +62,13 @@ def pad_to(x: int, m: int) -> int:
 def log2i(x: int) -> int:
     """Integer log2 of a power of two."""
     return int(math.log2(x))
+
+
+def hdot(x, y):
+    """f32-accurate matmul (MXU 3-pass; JAX's default precision does
+    single-pass bf16 multiplies, ~1e-3 relative distance error — enough to
+    mis-rank near-ties in exact kNN). Matches the reference's fp32 cuBLAS
+    GEMMs (linalg/gemm.cuh)."""
+    import jax.numpy as jnp
+
+    return jnp.matmul(x, y, precision="highest")

@@ -1,0 +1,95 @@
+"""Oracle tests for the fused Pallas distance+top-k kernel (interpret mode
+on CPU; the same code compiles for TPU — the `-m tpu` lane runs it there)."""
+import numpy as np
+import pytest
+
+from raft_tpu.ops import fused_knn
+
+
+def _oracle(q, x, metric):
+    if metric == "l2":
+        return ((q[:, None, :].astype(np.float64) - x[None, :, :]) ** 2).sum(-1)
+    if metric == "cos":
+        qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+        xn = x / np.linalg.norm(x, axis=1, keepdims=True)
+        return 1.0 - qn @ xn.T
+    return -(q.astype(np.float64) @ x.T.astype(np.float64))
+
+
+@pytest.mark.parametrize("m,n,d,k,metric", [
+    (64, 1000, 32, 10, "l2"),
+    (33, 300, 17, 5, "cos"),
+    (16, 257, 96, 16, "ip"),
+    (8, 2048, 128, 100, "l2"),   # k > tile lane width path
+])
+def test_fused_knn_oracle(m, n, d, k, metric):
+    rng = np.random.default_rng(7)
+    q = rng.standard_normal((m, d), dtype=np.float32)
+    x = rng.standard_normal((n, d), dtype=np.float32)
+    v, i = fused_knn(q, x, k, metric=metric, interpret=True)
+    v, i = np.asarray(v), np.asarray(i)
+    ref = _oracle(q, x, metric)
+    ref_i = np.argsort(ref, axis=1)[:, :k]
+    ref_v = np.take_along_axis(ref, ref_i, axis=1)
+    np.testing.assert_allclose(v, ref_v, rtol=1e-4, atol=1e-4)
+    recall = np.mean([len(set(i[r]) & set(ref_i[r])) / k for r in range(m)])
+    assert recall == 1.0
+
+
+def test_fused_knn_penalty_excludes_rows():
+    rng = np.random.default_rng(3)
+    q = rng.standard_normal((16, 32), dtype=np.float32)
+    x = rng.standard_normal((500, 32), dtype=np.float32)
+    pen = np.zeros(500, np.float32)
+    pen[::2] = np.inf
+    v, i = fused_knn(q, x, 8, penalty=pen, interpret=True)
+    assert np.all(np.asarray(i) % 2 == 1)
+    assert np.all(np.isfinite(np.asarray(v)))
+
+
+def test_fused_knn_k_exceeds_valid_rows():
+    """More requested neighbors than admissible rows → +inf / -1 padding."""
+    rng = np.random.default_rng(4)
+    q = rng.standard_normal((8, 16), dtype=np.float32)
+    x = rng.standard_normal((40, 16), dtype=np.float32)
+    pen = np.full(40, np.inf, np.float32)
+    pen[:5] = 0.0
+    v, i = fused_knn(q, x, 10, penalty=pen, interpret=True)
+    v, i = np.asarray(v), np.asarray(i)
+    assert np.all(np.isfinite(v[:, :5])) and np.all(np.isinf(v[:, 5:]))
+    assert set(i[:, :5].ravel()) <= {0, 1, 2, 3, 4}
+    assert np.all(i[:, 5:] == -1)
+
+
+@pytest.mark.parametrize("metric", ["sqeuclidean", "euclidean", "cosine",
+                                    "inner_product"])
+def test_brute_force_pallas_matches_scan(metric):
+    from raft_tpu.neighbors import brute_force
+
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((700, 48), dtype=np.float32)
+    q = rng.standard_normal((50, 48), dtype=np.float32)
+    index = brute_force.build(x, metric=metric)
+    vs, is_ = brute_force.search(index, q, 10, algo="scan")
+    vp, ip = brute_force.search(index, q, 10, algo="pallas")
+    np.testing.assert_allclose(np.asarray(vp), np.asarray(vs),
+                               rtol=1e-4, atol=1e-4)
+    agree = np.mean(np.asarray(ip) == np.asarray(is_))
+    assert agree > 0.99  # ties may order differently
+
+
+def test_brute_force_pallas_filter():
+    from raft_tpu.core.bitset import Bitset
+    from raft_tpu.neighbors import brute_force
+
+    rng = np.random.default_rng(12)
+    x = rng.standard_normal((300, 32), dtype=np.float32)
+    q = rng.standard_normal((20, 32), dtype=np.float32)
+    keep = rng.random(300) > 0.5
+    bs = Bitset.from_mask(keep)
+    index = brute_force.build(x)
+    vs, is_ = brute_force.search(index, q, 5, filter=bs, algo="scan")
+    vp, ip = brute_force.search(index, q, 5, filter=bs, algo="pallas")
+    np.testing.assert_allclose(np.asarray(vp), np.asarray(vs),
+                               rtol=1e-4, atol=1e-4)
+    assert keep[np.asarray(ip)].all()

@@ -1,0 +1,207 @@
+"""Tests for raft_tpu.random and raft_tpu.stats (oracle: numpy/scipy/sklearn
+formulas computed directly)."""
+import numpy as np
+import pytest
+
+from raft_tpu import random as rrnd
+from raft_tpu import stats
+
+
+class TestRng:
+    def test_rng_state_streams(self):
+        a = rrnd.uniform(rrnd.RngState(1), (100,))
+        b = rrnd.uniform(rrnd.RngState(1), (100,))
+        c = rrnd.uniform(rrnd.RngState(1, stream=7), (100,))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.allclose(np.asarray(a), np.asarray(c))
+
+    def test_rng_state_advances(self):
+        st = rrnd.RngState(0)
+        a, b = rrnd.uniform(st, (50,)), rrnd.uniform(st, (50,))
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.parametrize("fn,kw,mean,std", [
+        (rrnd.uniform, {}, 0.5, 0.2887),
+        (rrnd.normal, dict(mu=2.0, sigma=3.0), 2.0, 3.0),
+        (rrnd.exponential, dict(lam=2.0), 0.5, 0.5),
+        (rrnd.laplace, dict(mu=1.0, scale=0.5), 1.0, 0.7071),
+        (rrnd.rayleigh, dict(sigma=1.0), 1.2533, 0.6551),
+    ])
+    def test_distribution_moments(self, fn, kw, mean, std):
+        x = np.asarray(fn(rrnd.RngState(3), (20000,), **kw))
+        assert abs(x.mean() - mean) < 0.05 * max(1.0, abs(mean)) + 0.02
+        assert abs(x.std() - std) < 0.06
+
+    def test_bernoulli_and_scaled(self):
+        st = rrnd.RngState(5)
+        b = np.asarray(rrnd.bernoulli(st, (10000,), prob=0.3))
+        assert abs(b.mean() - 0.3) < 0.02
+        s = np.asarray(rrnd.scaled_bernoulli(st, (1000,), prob=0.5, scale=2.0))
+        assert set(np.unique(s)) == {-2.0, 2.0}
+
+    def test_sample_without_replacement(self):
+        idx = np.asarray(rrnd.sample_without_replacement(
+            rrnd.RngState(0), 50, n_population=100))
+        assert len(np.unique(idx)) == 50
+        assert idx.min() >= 0 and idx.max() < 100
+
+    def test_sample_without_replacement_weighted(self):
+        w = np.zeros(100); w[:60] = 1.0
+        idx = np.asarray(rrnd.sample_without_replacement(
+            rrnd.RngState(0), 50, n_population=100, weights=w))
+        assert len(np.unique(idx)) == 50 and idx.max() < 60
+
+    def test_permute(self):
+        p = np.asarray(rrnd.permute(rrnd.RngState(0), 64))
+        assert sorted(p.tolist()) == list(range(64))
+
+    def test_discrete(self):
+        d = np.asarray(rrnd.discrete(rrnd.RngState(1), (5000,),
+                                     [0.1, 0.0, 0.9]))
+        assert set(np.unique(d)) <= {0, 2}
+        assert abs((d == 2).mean() - 0.9) < 0.03
+
+
+class TestDatagen:
+    def test_make_blobs_separable(self):
+        x, y = rrnd.make_blobs(600, 8, n_clusters=3, cluster_std=0.1,
+                               rng=0)
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.shape == (600, 8) and set(np.unique(y)) == {0, 1, 2}
+        # cluster members are tight around their mean vs global spread
+        for c in range(3):
+            assert x[y == c].std(0).mean() < 0.15
+        assert x.std(0).mean() > 1.0
+
+    def test_make_blobs_given_centers(self):
+        centers = np.array([[0.0, 0.0], [100.0, 100.0]], np.float32)
+        x, y = rrnd.make_blobs(100, 2, centers=centers, cluster_std=0.5,
+                               shuffle=False, rng=1)
+        x, y = np.asarray(x), np.asarray(y)
+        np.testing.assert_allclose(x[y == 1].mean(0), [100, 100], atol=0.5)
+
+    def test_make_regression_recoverable(self):
+        x, y, coef = rrnd.make_regression(500, 10, noise=0.0, rng=2)
+        x, y, coef = np.asarray(x), np.asarray(y), np.asarray(coef)
+        est, *_ = np.linalg.lstsq(x, y, rcond=None)
+        np.testing.assert_allclose(est, coef, atol=1e-2)
+
+    def test_rmat_shapes_and_skew(self):
+        theta = np.array([0.57, 0.19, 0.19, 0.05], np.float32)
+        src, dst = rrnd.rmat_rectangular_generator(
+            rrnd.RngState(0), theta, r_scale=8, c_scale=8, n_edges=20000)
+        src, dst = np.asarray(src), np.asarray(dst)
+        assert src.min() >= 0 and src.max() < 256
+        assert dst.min() >= 0 and dst.max() < 256
+        # power-law-ish: low-id nodes (quadrant a attractor) dominate
+        assert (src < 128).mean() > 0.6
+
+
+class TestBasicStats:
+    def test_meanvar_cov(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((200, 5)).astype(np.float32)
+        mu, var = stats.meanvar(x)
+        np.testing.assert_allclose(np.asarray(mu), x.mean(0), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(var), x.var(0, ddof=1),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(stats.cov(x)),
+                                   np.cov(x.T), rtol=1e-3, atol=1e-4)
+
+    def test_histogram(self):
+        x = np.array([0.0, 0.1, 0.5, 0.9, 1.0], np.float32)
+        counts, edges = stats.histogram(x, 2, lo=0.0, hi=1.0)
+        np.testing.assert_array_equal(np.asarray(counts), [2, 3])
+
+    def test_weighted_mean(self):
+        x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        w = np.array([1.0, 3.0], np.float32)
+        np.testing.assert_allclose(np.asarray(stats.weighted_mean(x, w)),
+                                   [2.5, 3.5])
+
+    def test_minmax(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        lo, hi = stats.minmax(x)
+        np.testing.assert_array_equal(np.asarray(lo), x.min(0))
+        np.testing.assert_array_equal(np.asarray(hi), x.max(0))
+
+
+class TestMetrics:
+    def test_accuracy_r2(self):
+        assert float(stats.accuracy([1, 2, 3, 4], [1, 2, 0, 4])) == 0.75
+        y = np.array([1.0, 2.0, 3.0]); yh = np.array([1.1, 1.9, 3.2])
+        from sklearn.metrics import r2_score as sk_r2
+        np.testing.assert_allclose(float(stats.r2_score(y, yh)),
+                                   sk_r2(y, yh), rtol=1e-5)
+
+    def test_cluster_metrics_vs_sklearn(self):
+        from sklearn import metrics as skm
+        rng = np.random.default_rng(0)
+        a = rng.integers(0, 4, 300)
+        b = (a + (rng.random(300) < 0.2).astype(int)) % 4
+        np.testing.assert_allclose(float(stats.adjusted_rand_index(a, b, 4)),
+                                   skm.adjusted_rand_score(a, b), atol=1e-5)
+        np.testing.assert_allclose(float(stats.mutual_info_score(a, b, 4)),
+                                   skm.mutual_info_score(a, b), atol=1e-5)
+        np.testing.assert_allclose(float(stats.homogeneity_score(a, b, 4)),
+                                   skm.homogeneity_score(a, b), atol=1e-4)
+        np.testing.assert_allclose(float(stats.completeness_score(a, b, 4)),
+                                   skm.completeness_score(a, b), atol=1e-4)
+        np.testing.assert_allclose(float(stats.v_measure(a, b, 4)),
+                                   skm.v_measure_score(a, b), atol=1e-4)
+
+    def test_rand_index(self):
+        a = np.array([0, 0, 1, 1]); b = np.array([0, 0, 1, 2])
+        # pairs: (0,1) agree, (2,3) split ref... compute directly
+        from sklearn.metrics import rand_score
+        np.testing.assert_allclose(float(stats.rand_index(a, b)),
+                                   rand_score(a, b), atol=1e-6)
+
+    def test_entropy_kl(self):
+        labels = np.array([0, 0, 1, 1])
+        np.testing.assert_allclose(float(stats.entropy(labels, 2)),
+                                   np.log(2), rtol=1e-5)
+        p = np.array([0.5, 0.5]); q = np.array([0.9, 0.1])
+        ref = (0.5 * np.log(0.5 / 0.9) + 0.5 * np.log(0.5 / 0.1))
+        np.testing.assert_allclose(float(stats.kl_divergence(p, q)), ref,
+                                   rtol=1e-5)
+
+    def test_silhouette_vs_sklearn(self):
+        from sklearn.metrics import silhouette_score as sk_sil
+        from raft_tpu import random as rrnd2
+        x, y = rrnd2.make_blobs(120, 4, n_clusters=3, cluster_std=0.5, rng=5)
+        x, y = np.asarray(x), np.asarray(y)
+        ours = float(stats.silhouette_score(x, y, 3, metric="euclidean"))
+        ref = sk_sil(x, y, metric="euclidean")
+        np.testing.assert_allclose(ours, ref, atol=1e-3)
+
+    def test_trustworthiness_identity(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((60, 5)).astype(np.float32)
+        t = float(stats.trustworthiness(x, x, n_neighbors=5))
+        np.testing.assert_allclose(t, 1.0, atol=1e-6)
+        from sklearn.manifold import trustworthiness as sk_tw
+        e = x[:, :2]
+        np.testing.assert_allclose(
+            float(stats.trustworthiness(x, e, n_neighbors=5)),
+            sk_tw(x, e, n_neighbors=5), atol=1e-3)
+
+    def test_neighborhood_recall(self):
+        idx = np.array([[0, 1, 2], [3, 4, 5]])
+        ref = np.array([[2, 1, 9], [3, 4, 5]])
+        np.testing.assert_allclose(
+            float(stats.neighborhood_recall(idx, ref)), 5 / 6, rtol=1e-6)
+
+    def test_neighborhood_recall_distance_ties(self):
+        idx = np.array([[0, 7]]); ref = np.array([[0, 1]])
+        d = np.array([[1.0, 2.0]]); rd = np.array([[1.0, 2.0]])
+        # id 7 != 1 but distance ties at 2.0 → counts
+        np.testing.assert_allclose(
+            float(stats.neighborhood_recall(idx, ref, d, rd)), 1.0)
+
+    def test_information_criterion(self):
+        ll = np.float32(-100.0)
+        assert float(stats.information_criterion(ll, 3, 50, "aic")) == \
+            pytest.approx(206.0)
+        assert float(stats.information_criterion(ll, 3, 50, "bic")) == \
+            pytest.approx(3 * np.log(50) + 200.0, rel=1e-6)
