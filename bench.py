@@ -37,22 +37,26 @@ def main():
     # Reference point: RAFT brute-force on A100 is ~O(10k) QPS at this shape;
     # use 10k QPS as the provisional baseline until the harness regenerates it.
     baseline_qps = 10_000.0
-    # roofline accounting for the fused kernel: GEMM flops and one full
-    # dataset read from HBM per query tile (tile size from the kernel's own
-    # heuristic so the number tracks the real traffic)
-    import importlib
-    _fk = importlib.import_module("raft_tpu.ops.fused_knn")
-    tm, _ = _fk._pick_tiles(d, k)
-    gflops = 2.0 * nq * n * d / dt / 1e9
-    hbm_gb = (nq / tm) * n * d * 4 / dt / 1e9
-    print(json.dumps({
+    out = {
         "metric": "brute_force_knn_qps_100k_d128_k10",
         "value": round(qps, 2),
         "unit": "queries/s",
         "vs_baseline": round(qps / baseline_qps, 3),
-        "achieved_gflops": round(gflops, 1),
-        "hbm_read_gbps": round(hbm_gb, 1),
-    }))
+    }
+    if jax.default_backend() == "tpu":
+        # roofline accounting for the fused kernel (the path auto-dispatch
+        # takes on TPU; off-TPU the scan fallback ran and these numbers
+        # would describe a kernel that never executed): GEMM flops and one
+        # full dataset HBM read per query tile, tile size from the kernel's
+        # own heuristic
+        import importlib
+        import math
+        _pick = importlib.import_module("raft_tpu.ops.fused_knn")._pick_tiles
+        tm, _ = _pick(d, k)
+        n_qtiles = math.ceil(nq / tm)
+        out["achieved_gflops"] = round(2.0 * nq * n * d / dt / 1e9, 1)
+        out["hbm_read_gbps"] = round(n_qtiles * n * d * 4 / dt / 1e9, 1)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,25 @@
+"""ANN benchmark harness: analog of ``python/raft-ann-bench`` +
+``cpp/bench/ann``.
+
+Reference: the CLI pipeline get_dataset → generate_groundtruth → run →
+data_export → plot (raft-ann-bench/run/__main__.py:141-256) driving
+executables that emit Google-Benchmark JSON with Recall/QPS counters
+(cpp/bench/ann/src/common/benchmark.hpp:320-371).
+
+TPU design: one in-process harness — datasets (synthetic generators +
+big-ann fbin/ibin + ann-benchmarks HDF5 readers), brute-force ground
+truth on-chip, param-sweep runner producing the same JSON counter schema
+(so the reference's export/plot tooling carries over), CSV export with
+pareto-frontier marking, and QPS-vs-recall plots.
+
+CLI: ``python -m raft_tpu.bench run --dataset blobs-100000x128 ...``
+"""
+from .datasets import (generate_groundtruth, load_dataset, read_fbin,
+                       read_ibin, write_fbin, write_ibin)
+from .runner import BenchResult, default_configs, run_benchmarks
+
+__all__ = [
+    "read_fbin", "write_fbin", "read_ibin", "write_ibin", "load_dataset",
+    "generate_groundtruth", "run_benchmarks", "default_configs",
+    "BenchResult",
+]

@@ -1,0 +1,161 @@
+"""CLI: ``python -m raft_tpu.bench <subcommand>``.
+
+Mirrors the raft-ann-bench subcommands (run/__main__.py:141-256):
+``groundtruth`` (generate_groundtruth), ``run``, ``export``
+(data_export: GBench JSON → CSV with pareto marking), ``plot``
+(QPS-vs-recall curves).
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import platform
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def _cmd_groundtruth(args):
+    from .datasets import generate_groundtruth, load_dataset, write_fbin, write_ibin
+
+    base, queries, _, metric = load_dataset(args.dataset, args.dataset_dir)
+    d, i = generate_groundtruth(base, queries, args.k, metric)
+    out = Path(args.output or f"{args.dataset}.gt")
+    out.mkdir(parents=True, exist_ok=True)
+    write_ibin(out / "groundtruth.neighbors.ibin", i)
+    write_fbin(out / "groundtruth.distances.fbin", d)
+    print(f"wrote ground truth (k={args.k}) to {out}/")
+
+
+def _cmd_run(args):
+    import jax
+
+    from .datasets import generate_groundtruth, load_dataset
+    from .runner import run_benchmarks, to_gbench_json
+
+    base, queries, gt, metric = load_dataset(args.dataset, args.dataset_dir)
+    if args.metric:
+        metric = args.metric
+    if gt is None or gt.shape[1] < args.k:
+        print("# generating ground truth (exact brute force)...")
+        _, gt = generate_groundtruth(base, queries, max(args.k, 100), metric)
+    results = run_benchmarks(
+        base, queries, gt, k=args.k, metric=metric,
+        algos=args.algorithms.split(","), batch_size=args.batch_size,
+        reps=args.reps)
+    context = {
+        "date": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "dataset": args.dataset,
+        "host_name": platform.node(),
+        "executable": "raft_tpu.bench",
+        "num_cpus": 0,
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+    }
+    doc = to_gbench_json(results, context)
+    out = Path(args.output or f"{args.dataset}.bench.json")
+    out.write_text(doc)
+    print(f"wrote {len(results)} benchmark cases to {out}")
+
+
+def _pareto(points):
+    """Mark pareto-optimal (recall, qps) points (data_export analog)."""
+    best = []
+    for idx, (r, q) in enumerate(points):
+        dominated = any(r2 >= r and q2 > q or r2 > r and q2 >= q
+                        for r2, q2 in points)
+        best.append(not dominated)
+    return best
+
+
+def _cmd_export(args):
+    doc = json.loads(Path(args.input).read_text())
+    rows = doc["benchmarks"]
+    by_algo = {}
+    for r in rows:
+        algo = r["name"].split(".")[0]
+        by_algo.setdefault(algo, []).append(r)
+    out = Path(args.output or Path(args.input).with_suffix(".csv"))
+    with open(out, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["algo", "name", "recall", "qps", "latency_s",
+                    "build_time", "pareto"])
+        for algo, rs in by_algo.items():
+            flags = _pareto([(r["Recall"], r["items_per_second"])
+                             for r in rs])
+            for r, p in zip(rs, flags):
+                w.writerow([algo, r["name"], r["Recall"],
+                            r["items_per_second"], r["Latency"],
+                            r.get("build_time", ""), int(p)])
+    print(f"wrote {out}")
+
+
+def _cmd_plot(args):
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    doc = json.loads(Path(args.input).read_text())
+    by_algo = {}
+    for r in doc["benchmarks"]:
+        algo = r["name"].split(".")[0]
+        by_algo.setdefault(algo, []).append((r["Recall"],
+                                             r["items_per_second"]))
+    fig, ax = plt.subplots(figsize=(8, 6))
+    for algo, pts in sorted(by_algo.items()):
+        pts.sort()
+        ax.plot([p[0] for p in pts], [p[1] for p in pts], "o-", label=algo)
+    ax.set_xlabel(f"recall@k")
+    ax.set_ylabel("QPS")
+    ax.set_yscale("log")
+    ax.set_title(doc.get("context", {}).get("dataset", ""))
+    ax.grid(True, alpha=0.3)
+    ax.legend()
+    out = Path(args.output or Path(args.input).with_suffix(".png"))
+    fig.savefig(out, dpi=120, bbox_inches="tight")
+    print(f"wrote {out}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="python -m raft_tpu.bench")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("groundtruth", help="exact GT via brute force")
+    g.add_argument("--dataset", required=True)
+    g.add_argument("--dataset-dir", default=None)
+    g.add_argument("-k", type=int, default=100)
+    g.add_argument("--output", default=None)
+    g.set_defaults(fn=_cmd_groundtruth)
+
+    r = sub.add_parser("run", help="run QPS@recall sweeps")
+    r.add_argument("--dataset", required=True)
+    r.add_argument("--dataset-dir", default=None)
+    r.add_argument("--algorithms",
+                   default="raft_brute_force,raft_ivf_flat,raft_ivf_pq,"
+                           "raft_cagra")
+    r.add_argument("-k", type=int, default=10)
+    r.add_argument("--batch-size", type=int, default=None)
+    r.add_argument("--reps", type=int, default=5)
+    r.add_argument("--metric", default=None)
+    r.add_argument("--output", default=None)
+    r.set_defaults(fn=_cmd_run)
+
+    e = sub.add_parser("export", help="GBench JSON → CSV + pareto")
+    e.add_argument("--input", required=True)
+    e.add_argument("--output", default=None)
+    e.set_defaults(fn=_cmd_export)
+
+    pl = sub.add_parser("plot", help="QPS-vs-recall curves")
+    pl.add_argument("--input", required=True)
+    pl.add_argument("--output", default=None)
+    pl.set_defaults(fn=_cmd_plot)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,130 @@
+"""Dataset IO + ground truth for the bench harness.
+
+Formats (raft-ann-bench get_dataset/split_groundtruth):
+- ``.fbin``/``.ibin``: big-ann-benchmarks binary — int32 (n, d) header then
+  row-major f32/i32 payload.
+- ann-benchmarks ``.hdf5``: train/test/neighbors/distances datasets.
+- synthetic specs: ``blobs-{n}x{d}``, ``uniform-{n}x{d}`` generated with
+  raft_tpu.random (no network in the TPU environment; real corpora can be
+  dropped into the dataset dir as fbin/hdf5).
+"""
+from __future__ import annotations
+
+import os
+import re
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..core.errors import expects
+
+__all__ = ["read_fbin", "write_fbin", "read_ibin", "write_ibin",
+           "load_dataset", "generate_groundtruth"]
+
+
+def _read_bin(path, dtype) -> np.ndarray:
+    with open(path, "rb") as f:
+        n, d = np.fromfile(f, np.int32, 2)
+        return np.fromfile(f, dtype, int(n) * int(d)).reshape(int(n), int(d))
+
+
+def _write_bin(path, arr, dtype) -> None:
+    arr = np.ascontiguousarray(arr, dtype)
+    with open(path, "wb") as f:
+        np.asarray(arr.shape, np.int32).tofile(f)
+        arr.tofile(f)
+
+
+def read_fbin(path) -> np.ndarray:
+    return _read_bin(path, np.float32)
+
+
+def write_fbin(path, arr) -> None:
+    _write_bin(path, arr, np.float32)
+
+
+def read_ibin(path) -> np.ndarray:
+    return _read_bin(path, np.int32)
+
+
+def write_ibin(path, arr) -> None:
+    _write_bin(path, arr, np.int32)
+
+
+_SYNTH = re.compile(r"^(blobs|uniform)-(\d+)x(\d+)$")
+
+
+def load_dataset(
+    name: str,
+    dataset_dir: Optional[str] = None,
+    n_queries: int = 10_000,
+    seed: int = 1234,
+) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray], str]:
+    """→ (base, queries, gt_indices or None, metric).
+
+    ``name`` is a synthetic spec (``blobs-1000000x128``), an
+    ann-benchmarks HDF5 basename (``sift-128-euclidean`` with
+    ``{name}.hdf5`` in ``dataset_dir``), or a big-ann layout directory
+    (``{name}/base.fbin``, ``query.fbin``, optional
+    ``groundtruth.neighbors.ibin``). Metric is inferred: "-angular"/"-dot"
+    → inner-product family, else sqeuclidean (the raft-ann-bench mapping).
+    """
+    dataset_dir = dataset_dir or os.environ.get(
+        "RAFT_TPU_DATASET_DIR", "datasets")
+    m = _SYNTH.match(name)
+    if m:
+        kind, n, d = m.group(1), int(m.group(2)), int(m.group(3))
+        from .. import random as rrnd
+        rng = rrnd.RngState(seed)
+        if kind == "blobs":
+            base, _ = rrnd.make_blobs(n + n_queries, d,
+                                      n_clusters=max(16, d // 2),
+                                      cluster_std=3.0, rng=rng)
+            base = np.asarray(base)
+        else:
+            base = np.asarray(rrnd.uniform(rng, (n + n_queries, d)))
+        return base[:n], base[n:], None, "sqeuclidean"
+
+    h5 = Path(dataset_dir) / f"{name}.hdf5"
+    if h5.exists():
+        import h5py
+
+        with h5py.File(h5, "r") as f:
+            base = np.asarray(f["train"], np.float32)
+            queries = np.asarray(f["test"], np.float32)
+            gt = (np.asarray(f["neighbors"], np.int32)
+                  if "neighbors" in f else None)
+        metric = ("inner_product" if name.endswith(("-angular", "-dot"))
+                  else "sqeuclidean")
+        return base, queries, gt, metric
+
+    d = Path(dataset_dir) / name
+    if (d / "base.fbin").exists():
+        base = read_fbin(d / "base.fbin")
+        queries = read_fbin(d / "query.fbin")
+        gtp = d / "groundtruth.neighbors.ibin"
+        gt = read_ibin(gtp) if gtp.exists() else None
+        return base, queries, gt, "sqeuclidean"
+
+    expects(False, "dataset %r not found (no synthetic match, %s, or %s)",
+            name, str(h5), str(d / "base.fbin"))
+
+
+def generate_groundtruth(base, queries, k: int = 100,
+                         metric: str = "sqeuclidean",
+                         batch: int = 10_000) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact kNN ground truth on-device (generate_groundtruth CLI analog;
+    the reference also uses its own brute force for this)."""
+    import jax
+
+    from ..neighbors import brute_force
+
+    index = brute_force.build(np.asarray(base, np.float32), metric)
+    outs_d, outs_i = [], []
+    for b0 in range(0, len(queries), batch):
+        d, i = brute_force.search(index, queries[b0 : b0 + batch], k)
+        jax.block_until_ready((d, i))
+        outs_d.append(np.asarray(d))
+        outs_i.append(np.asarray(i))
+    return np.concatenate(outs_d), np.concatenate(outs_i)

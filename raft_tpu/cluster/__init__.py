@@ -1,5 +1,7 @@
 """Clustering: Lloyd k-means, balanced hierarchical k-means, single-linkage
-(SURVEY.md §2.7). single_linkage lands with the sparse/MST subsystem."""
+(SURVEY.md §2.7)."""
 from . import kmeans, kmeans_balanced
+from .single_linkage import SingleLinkageOutput, single_linkage
 
-__all__ = ["kmeans", "kmeans_balanced"]
+__all__ = ["kmeans", "kmeans_balanced", "single_linkage",
+           "SingleLinkageOutput"]

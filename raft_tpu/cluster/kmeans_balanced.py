@@ -142,10 +142,13 @@ def fit(x, n_clusters: int, params: BalancedKMeansParams | None = None) -> jax.A
             break
         alloc[i] -= 1
 
-    # level 2: seed fine centers per mesocluster from its own points, then
-    # polish jointly with balancing
+    # level 2: seed fine centers per mesocluster from a random sample of its
+    # own points (host-side — a jitted per-meso kmeans++ would recompile for
+    # every distinct (|meso|, alloc) shape, which dominated build time; the
+    # joint _balanced_lloyd polish + adjust_centers rounds below do the
+    # quality work, as in build_hierarchical)
     fine_list = []
-    keys = jax.random.split(k_fine_key, n_meso)
+    seed_rng = np.random.default_rng(p.seed ^ 0x9E3779B9)
     labels_np = np.asarray(meso_labels)
     x_np = np.asarray(x)
     for m in range(n_meso):
@@ -153,13 +156,11 @@ def fit(x, n_clusters: int, params: BalancedKMeansParams | None = None) -> jax.A
         km = int(alloc[m])
         if len(pts) == 0:
             fine_list.append(np.asarray(meso_centers)[m : m + 1].repeat(km, 0))
-            continue
-        if len(pts) <= km:
-            reps = np.resize(pts, (km, d))
-            fine_list.append(reps)
-            continue
-        seeds = _plus_plus(keys[m], jnp.asarray(pts), km)
-        fine_list.append(np.asarray(seeds))
+        elif len(pts) <= km:
+            fine_list.append(np.resize(pts, (km, d)))
+        else:
+            picks = seed_rng.choice(len(pts), km, replace=False)
+            fine_list.append(pts[picks])
     centers0 = jnp.asarray(np.concatenate(fine_list, axis=0))
 
     key_bal = jax.random.key(p.seed + 17)

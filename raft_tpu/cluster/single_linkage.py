@@ -1,0 +1,172 @@
+"""Single-linkage agglomerative clustering: analog of
+``raft::cluster::single_linkage``.
+
+Reference: cluster/detail/{connectivities,mst,agglomerative,
+single_linkage}.cuh — kNN-graph connectivities → MST → dendrogram →
+flat labels at n_clusters.
+
+TPU design: the kNN graph comes from the fused brute-force kernel
+(connectivities_knn analog, exact), the MST from the sparse Boruvka
+solver; dendrogram/label extraction is host union-find (agglomerative.cuh
+runs host-side in the reference too).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import tracing
+from ..core.errors import expects
+
+__all__ = ["SingleLinkageOutput", "single_linkage"]
+
+
+@dataclasses.dataclass
+class SingleLinkageOutput:
+    """Mirror of raft::cluster::linkage_output."""
+
+    labels: np.ndarray          # (n,) flat cluster labels
+    children: np.ndarray        # (n-1, 2) merged cluster ids (scipy layout)
+    deltas: np.ndarray          # (n-1,) merge distances
+    sizes: np.ndarray           # (n-1,) merged cluster sizes
+    n_clusters: int
+
+
+def _knn_connectivities(x: np.ndarray, c: int):
+    """Symmetric kNN edge list via the exact brute-force path
+    (detail/connectivities.cuh knn_graph_connectivities)."""
+    from ..neighbors import brute_force
+
+    n = len(x)
+    k = min(c + 1, n)
+    d, i = brute_force.knn(x, x, k, metric="sqeuclidean")
+    d, i = np.asarray(d), np.asarray(i)
+    rows = np.repeat(np.arange(n, dtype=np.int32), k)
+    cols = i.reshape(-1)
+    vals = np.sqrt(np.maximum(d.reshape(-1), 0.0))
+    keep = (cols >= 0) & (cols != rows)
+    return rows[keep], cols[keep], vals[keep]
+
+
+def _connect_components(x, ms, md, mw, n):
+    """Bridge a disconnected kNN forest: per round, every component adds its
+    minimum cross-component edge (detail/connectivities.cuh
+    connect_components / FixConnectivitiesRedOp role), Boruvka-style until
+    one tree remains. Cross edges carry true L2 distances."""
+    from ..core.bitset import Bitset
+    from ..neighbors import brute_force
+
+    index = brute_force.build(x, metric="sqeuclidean")
+    ms, md, mw = list(ms), list(md), list(mw)
+    for _ in range(64):
+        parent = np.arange(n)
+
+        def find(i):
+            while parent[i] != i:
+                parent[i] = parent[parent[i]]
+                i = parent[i]
+            return i
+
+        for a, b in zip(ms, md):
+            ra, rb = find(int(a)), find(int(b))
+            if ra != rb:
+                parent[max(ra, rb)] = min(ra, rb)
+        comp = np.array([find(i) for i in range(n)])
+        comps = np.unique(comp)
+        if len(comps) == 1:
+            break
+        for cid in comps:
+            mask = comp != cid                    # candidates outside
+            members = np.nonzero(comp == cid)[0]
+            d, i = brute_force.search(index, x[members], 1,
+                                      filter=Bitset.from_mask(mask))
+            d = np.asarray(d)[:, 0]
+            i = np.asarray(i)[:, 0]
+            best = int(np.argmin(d))
+            ms.append(int(members[best]))
+            md.append(int(i[best]))
+            mw.append(float(np.sqrt(max(d[best], 0.0))))
+    # the added bridges may include duplicates across components; the
+    # dendrogram pass ignores cycle edges, but trim to a forest here so the
+    # n-1 contract holds
+    parent = np.arange(n)
+
+    def find2(i):
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    order = np.argsort(np.asarray(mw), kind="stable")
+    ks, kd, kw = [], [], []
+    for e in order:
+        ra, rb = find2(int(ms[e])), find2(int(md[e]))
+        if ra != rb:
+            parent[max(ra, rb)] = min(ra, rb)
+            ks.append(int(ms[e]))
+            kd.append(int(md[e]))
+            kw.append(float(mw[e]))
+    return (np.asarray(ks, np.int32), np.asarray(kd, np.int32),
+            np.asarray(kw, np.float32))
+
+
+@tracing.annotate("raft_tpu::cluster::single_linkage")
+def single_linkage(x, n_clusters: int, c: int = 15) -> SingleLinkageOutput:
+    """Fit single-linkage over a c-NN connectivity graph
+    (single_linkage.cuh API: x, n_clusters, c)."""
+    from ..sparse import COO, mst
+
+    x = np.asarray(x, np.float32)
+    n = len(x)
+    expects(1 <= n_clusters <= n, "bad n_clusters %d", n_clusters)
+
+    rows, cols, vals = _knn_connectivities(x, c)
+    coo = COO(jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(vals),
+              (n, n))
+    ms, md, mw = mst(coo)
+    if len(mw) < n - 1:
+        ms, md, mw = _connect_components(x, ms, md, mw, n)
+    expects(len(mw) == n - 1, "could not connect kNN graph (%d of %d edges)",
+            len(mw), n - 1)
+
+    # dendrogram: merge MST edges ascending (scipy linkage layout:
+    # cluster ids >= n are merge nodes)
+    order = np.argsort(mw, kind="stable")
+    parent = np.arange(2 * n - 1)
+    cluster_of = np.arange(n)       # current scipy-id of each root
+    size = np.ones(2 * n - 1, np.int64)
+    children = np.zeros((n - 1, 2), np.int64)
+    deltas = np.zeros(n - 1, np.float64)
+    sizes = np.zeros(n - 1, np.int64)
+
+    def find(p, x0):
+        while p[x0] != x0:
+            p[x0] = p[p[x0]]
+            x0 = p[x0]
+        return x0
+
+    for t, e in enumerate(order):
+        ra, rb = find(parent, int(ms[e])), find(parent, int(md[e]))
+        ca, cb = cluster_of[ra], cluster_of[rb]
+        children[t] = (min(ca, cb), max(ca, cb))
+        deltas[t] = mw[e]
+        new_id = n + t
+        sizes[t] = size[ca] + size[cb]
+        size[new_id] = sizes[t]
+        root = min(ra, rb)
+        parent[max(ra, rb)] = root
+        cluster_of[root] = new_id
+
+    # flat labels: cut before the last (n_clusters - 1) merges
+    parent = np.arange(n)
+    for t, e in enumerate(order[: n - n_clusters]):
+        ra, rb = find(parent, int(ms[e])), find(parent, int(md[e]))
+        parent[max(ra, rb)] = min(ra, rb)
+    roots = np.array([find(parent, i) for i in range(n)])
+    _, labels = np.unique(roots, return_inverse=True)
+    return SingleLinkageOutput(labels.astype(np.int32), children, deltas,
+                               sizes, n_clusters)

@@ -1,0 +1,23 @@
+"""Distributed communicator: analog of ``raft/core/comms.hpp`` + ``raft/comms/``.
+
+Reference: `comms_iface`/`comms_t` (core/comms.hpp:123-230) — rank/size,
+comm_split, barrier, collectives (allreduce/bcast/reduce/allgather/
+allgatherv/gather/gatherv/reducescatter), p2p send/recv — implemented by
+std_comms (NCCL+UCX, comms/detail/std_comms.hpp:56) and mpi_comms
+(comms/detail/mpi_comms.hpp:107), injected into `resources` and consumed
+by MNMG algorithms.
+
+TPU design: collectives are XLA ops over a *named mesh axis*, so the
+communicator is a value that names the axis and is used inside
+`shard_map`/`pjit` — the compiler lowers each call to the matching ICI/DCN
+collective. `comm_split` maps to `axis_index_groups` (static subgroups, the
+XLA analog of a color split); p2p maps to `ppermute`. Multi-host bootstrap
+(the raft-dask Comms.init path, python/raft-dask/raft_dask/common/comms.py:
+93-245) is `jax.distributed.initialize` + mesh construction — see
+``bootstrap``.
+"""
+from .comms import AxisComms, Comms
+from .bootstrap import init_comms, local_mesh
+from . import comms_test
+
+__all__ = ["Comms", "AxisComms", "init_comms", "local_mesh", "comms_test"]

@@ -1,0 +1,71 @@
+"""Multi-host bootstrap: the raft-dask ``Comms`` analog.
+
+Reference: python/raft-dask/raft_dask/common/comms.py:93-245 — pick an
+NCCL root, broadcast the uniqueId, run per-worker init that injects a
+ready communicator into each worker's handle (§3.5 call stack).
+
+TPU design: `jax.distributed.initialize` plays the bootstrap role
+(coordinator address ≈ the NCCL uniqueId broadcast; process_id ≈ rank);
+after it, every process sees the global device set and a `Mesh` over
+those devices is the communicator clique. `init_comms` wires the result
+into a `Resources` so algorithms reach it via `get_comms()`, exactly the
+reference's injection pattern (comms/std_comms.hpp:69).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..core.errors import expects
+from .comms import AxisComms
+
+__all__ = ["init_comms", "local_mesh"]
+
+
+def local_mesh(n_devices: Optional[int] = None, axis: str = "shard",
+               platform: Optional[str] = None) -> Mesh:
+    """1-D mesh over local devices (the LocalCUDACluster-style test path).
+
+    Falls back to CPU devices when the default platform has too few (the
+    single-TPU-chip + 8-virtual-CPU development setup).
+    """
+    devices = jax.devices(platform) if platform else jax.devices()
+    if n_devices is not None and len(devices) < n_devices:
+        devices = jax.devices("cpu")
+    if n_devices is not None:
+        expects(len(devices) >= n_devices, "need %d devices, have %d",
+                n_devices, len(devices))
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def init_comms(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    n_devices: Optional[int] = None,
+    axis: str = "shard",
+    resources=None,
+) -> Tuple[Mesh, AxisComms]:
+    """Bootstrap a communicator clique → (mesh, comms).
+
+    Single-process (coordinator_address None): a mesh over local devices —
+    the raft-dask LocalCluster path. Multi-process: initializes
+    `jax.distributed` first (DCN bootstrap; every process must call this
+    with the same coordinator, mirroring Comms.init's client.run fan-out),
+    then builds the mesh over the *global* device set.
+
+    When ``resources`` is given, the comms object is injected via
+    ``set_comms`` (the build_comms_nccl_only analog).
+    """
+    if coordinator_address is not None:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+    mesh = local_mesh(n_devices, axis)
+    comms = AxisComms(axis, size=mesh.shape[axis])
+    if resources is not None:
+        resources.set_comms(comms)
+    return mesh, comms

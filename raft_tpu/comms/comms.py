@@ -1,0 +1,176 @@
+"""The communicator protocol + the XLA-collective implementation.
+
+Method-for-method mirror of `comms_t` (core/comms.hpp:335-540): each
+reference entry point appears here with the same name and contract, lowered
+to the corresponding `jax.lax` collective over a named mesh axis.
+"""
+from __future__ import annotations
+
+from typing import Optional, Protocol, Sequence, Tuple, runtime_checkable
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import expects
+
+__all__ = ["Comms", "AxisComms"]
+
+_REDUCE = {
+    "sum": jax.lax.psum,
+    "max": jax.lax.pmax,
+    "min": jax.lax.pmin,
+}
+
+
+@runtime_checkable
+class Comms(Protocol):
+    """Structural protocol for communicators (comms_iface, core/comms.hpp:123).
+
+    Anything with this surface can be injected into ``Resources.set_comms``
+    and drives the `parallel/` MNMG algorithms.
+    """
+
+    def get_size(self) -> int: ...
+    def get_rank(self) -> jax.Array: ...
+    def barrier(self) -> None: ...
+    def allreduce(self, x, op: str = "sum") -> jax.Array: ...
+    def bcast(self, x, root: int = 0) -> jax.Array: ...
+    def reduce(self, x, root: int = 0, op: str = "sum") -> jax.Array: ...
+    def allgather(self, x) -> jax.Array: ...
+    def reducescatter(self, x, op: str = "sum") -> jax.Array: ...
+    def comm_split(self, n_groups: int) -> "Comms": ...
+
+
+class AxisComms:
+    """Collectives over one named mesh axis, used inside shard_map/pjit.
+
+    ``groups``: optional static subgroups (`axis_index_groups`), the result
+    of `comm_split` — the XLA analog of NCCL's color/key split
+    (std_comms.hpp comm_split). All collectives then act within the
+    caller's group.
+    """
+
+    def __init__(self, axis: str = "shard", size: Optional[int] = None,
+                 groups: Optional[Sequence[Sequence[int]]] = None):
+        self.axis = axis
+        self._size = size
+        self.groups = tuple(tuple(g) for g in groups) if groups else None
+
+    # -- topology ----------------------------------------------------------
+    def get_size(self) -> int:
+        """Ranks in this communicator (group size after a split)."""
+        if self.groups is not None:
+            return len(self.groups[0])
+        if self._size is not None:
+            return self._size
+        return jax.lax.axis_size(self.axis)
+
+    def get_rank(self) -> jax.Array:
+        """Caller's rank (traced; within its group after a split)."""
+        idx = jax.lax.axis_index(self.axis)
+        if self.groups is None:
+            return idx
+        # rank within group = position of idx in its group row
+        g = jnp.asarray(self.groups)                       # (ng, gs)
+        pos = jnp.argmax(jnp.any(g == idx, axis=1))        # group row
+        return jnp.argmax(g[pos] == idx)
+
+    def comm_split(self, n_groups: int) -> "AxisComms":
+        """Static color split into ``n_groups`` equal contiguous groups
+        (core/comms.hpp comm_split; colors must be static under XLA)."""
+        size = self.get_size()
+        expects(self.groups is None, "nested comm_split not supported")
+        expects(size % n_groups == 0, "size %d not divisible into %d groups",
+                size, n_groups)
+        gs = size // n_groups
+        groups = [list(range(g * gs, (g + 1) * gs)) for g in range(n_groups)]
+        return AxisComms(self.axis, size, groups)
+
+    # -- collectives (comms_t device API, core/comms.hpp:389-540) ----------
+    def barrier(self) -> None:
+        """Collective fence: a tiny psum every rank must join
+        (comms_t::barrier). Under XLA the program order already sequences
+        collectives; this exists for API parity and cross-rank sync tests."""
+        jax.lax.psum(jnp.zeros((), jnp.int32), self.axis,
+                     axis_index_groups=self.groups)
+
+    def allreduce(self, x, op: str = "sum") -> jax.Array:
+        expects(op in _REDUCE, "unsupported reduce op %s", op)
+        return _REDUCE[op](x, self.axis, axis_index_groups=self.groups)
+
+    def bcast(self, x, root: int = 0) -> jax.Array:
+        """Every rank gets root's value (comms_t::bcast)."""
+        rank = jax.lax.axis_index(self.axis) if self.groups is None else \
+            self.get_rank()
+        masked = jnp.where(rank == root, x, jnp.zeros_like(x))
+        return jax.lax.psum(masked, self.axis, axis_index_groups=self.groups)
+
+    def reduce(self, x, root: int = 0, op: str = "sum") -> jax.Array:
+        """Reduction delivered to root; other ranks get zeros
+        (comms_t::reduce — non-roots' buffers are unspecified there)."""
+        red = self.allreduce(x, op)
+        rank = self.get_rank()
+        return jnp.where(rank == root, red, jnp.zeros_like(red))
+
+    def allgather(self, x) -> jax.Array:
+        """(…,) per rank → (size, …) on every rank (comms_t::allgather)."""
+        return jax.lax.all_gather(x, self.axis,
+                                  axis_index_groups=self.groups)
+
+    def allgatherv(self, x, counts: Sequence[int]) -> Tuple[jax.Array, jax.Array]:
+        """Variable-count allgather (comms_t::allgatherv): ranks contribute
+        ``counts[r]`` valid rows out of a common padded buffer. Returns
+        (stacked (size, max_rows, …), counts array) — the ragged result the
+        reference writes at displacements, in padded-dense TPU form."""
+        g = self.allgather(x)
+        return g, jnp.asarray(counts, jnp.int32)
+
+    def gather(self, x, root: int = 0) -> jax.Array:
+        """allgather then select at root (comms_t::gather; non-roots get
+        zeros — the reference leaves their recv buffers untouched)."""
+        g = self.allgather(x)
+        rank = self.get_rank()
+        return jnp.where(rank == root, g, jnp.zeros_like(g))
+
+    def gatherv(self, x, counts: Sequence[int], root: int = 0):
+        g, c = self.allgatherv(x, counts)
+        rank = self.get_rank()
+        return jnp.where(rank == root, g, jnp.zeros_like(g)), c
+
+    def reducescatter(self, x, op: str = "sum") -> jax.Array:
+        """Reduce then scatter blocks by rank (comms_t::reducescatter).
+        ``x``: (size * block, …) on each rank → (block, …) per rank."""
+        expects(op == "sum", "reducescatter supports sum (psum_scatter)")
+        size = self.get_size()
+        expects(x.shape[0] % size == 0,
+                "leading dim %d not divisible by %d", x.shape[0], size)
+        return jax.lax.psum_scatter(
+            x.reshape(size, x.shape[0] // size, *x.shape[1:]), self.axis,
+            scatter_dimension=0, axis_index_groups=self.groups,
+            tiled=False)
+
+    # -- p2p (comms_t::device_send/device_recv/device_sendrecv) ------------
+    def device_sendrecv(self, x, dest_offset: int = 1) -> jax.Array:
+        """Ring shift: every rank sends to (rank + dest_offset) % size and
+        receives from (rank - dest_offset) % size — the collective-safe
+        XLA form of paired device_send/device_recv (core/comms.hpp:607-666;
+        arbitrary tag-addressed p2p is host-side in the reference via UCX
+        and has no in-graph XLA analog)."""
+        size = self.get_size()
+        if self.groups is None:
+            perm = [(s, (s + dest_offset) % size) for s in range(size)]
+        else:
+            perm = [(g[s], g[(s + dest_offset) % size])
+                    for g in self.groups for s in range(size)]
+        return jax.lax.ppermute(x, self.axis, perm)
+
+    def device_multicast_sendrecv(self, x, dests: Sequence[int]):
+        """One ppermute per destination offset (comms_t::
+        device_multicast_sendrecv)."""
+        return [self.device_sendrecv(x, d) for d in dests]
+
+    # -- stream-ordering API parity ----------------------------------------
+    def sync_stream(self) -> None:
+        """No-op: XLA programs are already stream-ordered; exists so MNMG
+        call sites can keep the reference's call shape
+        (comms_t::sync_stream)."""

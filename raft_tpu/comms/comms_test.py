@@ -1,0 +1,144 @@
+"""Comms self-tests: analog of ``raft/comms/comms_test.hpp:34-84``.
+
+Each ``test_collective_*`` runs the real collective inside shard_map over
+the given mesh and returns True on success — callable from user code for
+cluster smoke-tests, exactly like the reference's perform_test_comms_*
+entry points surfaced through raft-dask (comms_utils.pyx:78-175).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .comms import AxisComms
+
+__all__ = [
+    "test_collective_allreduce", "test_collective_broadcast",
+    "test_collective_reduce", "test_collective_allgather",
+    "test_collective_gather", "test_collective_reducescatter",
+    "test_pointToPoint_ring", "test_commsplit", "run_all",
+]
+
+
+def _run(mesh: Mesh, fn, out_specs=P()):
+    axis = mesh.axis_names[0]
+    comms = AxisComms(axis, size=mesh.shape[axis])
+    shmap = jax.shard_map(functools.partial(fn, comms), mesh=mesh,
+                          in_specs=(), out_specs=out_specs, check_vma=False)
+    return np.asarray(jax.jit(shmap)())
+
+
+def test_collective_allreduce(mesh: Mesh) -> bool:
+    """Each rank contributes 1; result must be size (comms_test.hpp:34)."""
+    p = mesh.devices.size
+
+    def body(comms):
+        return comms.allreduce(jnp.float32(1.0))
+
+    return bool(_run(mesh, body) == p)
+
+
+def test_collective_broadcast(mesh: Mesh) -> bool:
+    """Root holds 42; everyone must end with 42."""
+    def body(comms):
+        rank = comms.get_rank()
+        val = jnp.where(rank == 0, jnp.float32(42.0), jnp.float32(0.0))
+        got = comms.bcast(val, root=0)
+        return comms.allreduce((got == 42.0).astype(jnp.float32))
+
+    p = mesh.devices.size
+    return bool(_run(mesh, body) == p)
+
+
+def test_collective_reduce(mesh: Mesh) -> bool:
+    def body(comms):
+        red = comms.reduce(jnp.float32(1.0), root=0)
+        rank = comms.get_rank()
+        ok = jnp.where(rank == 0, red == comms.get_size(), red == 0.0)
+        return comms.allreduce(ok.astype(jnp.float32))
+
+    p = mesh.devices.size
+    return bool(_run(mesh, body) == p)
+
+
+def test_collective_allgather(mesh: Mesh) -> bool:
+    """Gather ranks; every rank must see [0..p)."""
+    def body(comms):
+        g = comms.allgather(comms.get_rank().astype(jnp.float32))
+        want = jnp.arange(comms.get_size(), dtype=jnp.float32)
+        return comms.allreduce(jnp.all(g == want).astype(jnp.float32))
+
+    p = mesh.devices.size
+    return bool(_run(mesh, body) == p)
+
+
+def test_collective_gather(mesh: Mesh) -> bool:
+    def body(comms):
+        g = comms.gather(comms.get_rank().astype(jnp.float32), root=0)
+        want = jnp.arange(comms.get_size(), dtype=jnp.float32)
+        rank = comms.get_rank()
+        ok = jnp.where(rank == 0, jnp.all(g == want), jnp.all(g == 0.0))
+        return comms.allreduce(ok.astype(jnp.float32))
+
+    p = mesh.devices.size
+    return bool(_run(mesh, body) == p)
+
+
+def test_collective_reducescatter(mesh: Mesh) -> bool:
+    """Each rank contributes [0..p); rank r must end with p * r."""
+    def body(comms):
+        p = comms.get_size()
+        contrib = jnp.arange(p, dtype=jnp.float32)
+        mine = comms.reducescatter(contrib)
+        want = comms.get_rank().astype(jnp.float32) * p
+        return comms.allreduce(jnp.all(mine == want).astype(jnp.float32))
+
+    p = mesh.devices.size
+    return bool(_run(mesh, body) == p)
+
+
+def test_pointToPoint_ring(mesh: Mesh) -> bool:
+    """Ring sendrecv: rank r receives from r-1 (comms_test.hpp p2p analog)."""
+    def body(comms):
+        rank = comms.get_rank().astype(jnp.float32)
+        got = comms.device_sendrecv(rank, dest_offset=1)
+        want = (comms.get_rank() - 1) % comms.get_size()
+        return comms.allreduce((got == want).astype(jnp.float32))
+
+    p = mesh.devices.size
+    return bool(_run(mesh, body) == p)
+
+
+def test_commsplit(mesh: Mesh, n_groups: int = 2) -> bool:
+    """Split into groups; in-group allreduce must equal the group size."""
+    def body(comms):
+        sub = comms.comm_split(n_groups)
+        red = sub.allreduce(jnp.float32(1.0))
+        ok = red == sub.get_size()
+        # in-group rank must be in [0, group size)
+        r = sub.get_rank()
+        ok = ok & (r >= 0) & (r < sub.get_size())
+        return comms.allreduce(ok.astype(jnp.float32))
+
+    p = mesh.devices.size
+    return bool(_run(mesh, body) == p)
+
+
+def run_all(mesh: Mesh) -> dict:
+    """Run the full self-test battery → {name: bool}."""
+    results = {
+        "allreduce": test_collective_allreduce(mesh),
+        "broadcast": test_collective_broadcast(mesh),
+        "reduce": test_collective_reduce(mesh),
+        "allgather": test_collective_allgather(mesh),
+        "gather": test_collective_gather(mesh),
+        "reducescatter": test_collective_reducescatter(mesh),
+        "p2p_ring": test_pointToPoint_ring(mesh),
+    }
+    if mesh.devices.size % 2 == 0:
+        results["commsplit"] = test_commsplit(mesh, 2)
+    return results

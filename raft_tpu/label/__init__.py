@@ -1,0 +1,65 @@
+"""Label utilities: analog of ``raft/label/``.
+
+Reference: label/classlabels.cuh (getUniquelabels, make_monotonic) and
+label/merge_labels.cuh (union-find-flavored label merging over an
+adjacency).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["get_unique_labels", "make_monotonic", "merge_labels"]
+
+
+def get_unique_labels(labels) -> jax.Array:
+    """Sorted unique labels (classlabels.cuh getUniquelabels)."""
+    return jnp.unique(jnp.asarray(labels))
+
+
+def make_monotonic(labels, ignore: int | None = None) -> Tuple[jax.Array, int]:
+    """Remap labels to 0..n_unique-1 preserving order
+    (classlabels.cuh make_monotonic). ``ignore``: label left untouched
+    (the reference's MLCommon convention uses -1 noise labels).
+    Host-side: unique count is data-dependent."""
+    l = np.asarray(labels)
+    mask = np.ones_like(l, bool) if ignore is None else (l != ignore)
+    uniq = np.unique(l[mask])
+    lut = {v: i for i, v in enumerate(uniq.tolist())}
+    out = np.array([lut[v] if m else v
+                    for v, m in zip(l.tolist(), mask.tolist())])
+    return jnp.asarray(out), len(uniq)
+
+
+def merge_labels(labels_a, labels_b, mask=None) -> jax.Array:
+    """Merge two labelings: rows where ``mask`` is set act as merge points —
+    every label connected through a shared row collapses to the smallest
+    member label (merge_labels.cuh, the label-equivalence propagation).
+
+    Implemented as host union-find (the reference's iterative min-
+    propagation kernel has data-dependent trip count)."""
+    a = np.asarray(labels_a).copy()
+    b = np.asarray(labels_b)
+    m = np.ones_like(a, bool) if mask is None else np.asarray(mask, bool)
+
+    parent: dict[int, int] = {}
+
+    def find(x: int) -> int:
+        while parent.setdefault(x, x) != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    def union(x: int, y: int) -> None:
+        rx, ry = find(x), find(y)
+        if rx != ry:
+            parent[max(rx, ry)] = min(rx, ry)
+
+    for av, bv, mv in zip(a.tolist(), b.tolist(), m.tolist()):
+        if mv:
+            union(av, bv)
+    out = np.array([find(v) for v in a.tolist()])
+    return jnp.asarray(out)

@@ -1,0 +1,158 @@
+"""Random-ball-cover kNN + epsilon neighborhoods: analog of
+``raft::neighbors::ball_cover`` / ``epsilon_neighborhood``.
+
+Reference: spatial/knn/detail/ball_cover.cuh:62-168 — sqrt(n) landmarks,
+points grouped under their closest landmark, queries probe landmarks in
+distance order with triangle-inequality pruning
+(|d(q,L) - d(L,x)| <= d(q,x)); eps queries in
+neighbors/epsilon_neighborhood.cuh (dense adj + vertex degrees) with an
+RBC-pruned variant (eps_nn ball_cover.cuh:120).
+
+TPU design note: the reference's per-thread landmark pruning is a
+SIMT-divergence optimization — it saves lanes on a GPU, but on the MXU a
+distance tile costs the same whether half its rows would have been
+pruned, so exact kNN rides the fused brute-force kernel unchanged. What
+the RBC *structure* buys on TPU is the probe-limited approximate mode
+(landmark-grouped gathers, same machinery as IVF-Flat with the landmark
+set as the coarse quantizer) and landmark-level (not row-level) pruning
+for eps queries. Radii are kept per landmark so the eps path can skip
+whole groups, which is the part that does vectorize.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..cluster import kmeans_balanced
+from ..core import tracing
+from ..core.errors import expects
+from ..distance.distance_types import DistanceType, canonical_metric
+from ..distance.pairwise import pairwise_distance
+from . import brute_force, ivf_flat
+
+__all__ = ["BallCoverIndex", "build", "knn", "eps_nn",
+           "epsilon_neighborhood"]
+
+
+@dataclasses.dataclass
+class BallCoverIndex:
+    """Landmark-grouped dataset (ball_cover.cuh BallCoverIndex).
+
+    Internally an IVF-Flat layout whose "lists" are landmark balls, plus
+    per-landmark radii (max member distance) for group-level pruning.
+    """
+
+    ivf: ivf_flat.Index
+    radii: jax.Array          # (n_landmarks,) max member distance (L2)
+    metric: DistanceType
+
+    @property
+    def size(self) -> int:
+        return self.ivf.size
+
+    @property
+    def dim(self) -> int:
+        return self.ivf.dim
+
+    @property
+    def n_landmarks(self) -> int:
+        return self.ivf.n_lists
+
+
+@tracing.annotate("raft_tpu::ball_cover::build")
+def build(dataset, n_landmarks: int = 0, metric="sqeuclidean",
+          seed: int = 0) -> BallCoverIndex:
+    """Group the dataset under ~sqrt(n) landmarks (ball_cover.cuh:62).
+
+    Landmarks come from balanced k-means (the reference samples random
+    points; trained landmarks give tighter balls → better pruning).
+    """
+    dataset = np.asarray(dataset, np.float32)
+    n = len(dataset)
+    mt = canonical_metric(metric)
+    expects(mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded),
+            "ball_cover supports L2 metrics, got %s", mt.name)
+    if n_landmarks <= 0:
+        n_landmarks = max(1, int(np.sqrt(n)))
+    expects(n_landmarks <= n, "n_landmarks %d > n %d", n_landmarks, n)
+
+    idx = ivf_flat.build(dataset, ivf_flat.IndexParams(
+        n_lists=n_landmarks, metric=DistanceType.L2Expanded, seed=seed))
+    # per-landmark radius: max member distance (exact, for rigorous bounds)
+    labels = np.repeat(np.arange(idx.n_lists), idx.list_sizes)
+    member_d = np.sqrt(np.maximum(np.asarray(
+        jnp.sum((idx.data - idx.centers[jnp.asarray(labels)]) ** 2, axis=1)),
+        0.0))
+    radii = np.zeros(idx.n_lists, np.float32)
+    np.maximum.at(radii, labels, member_d)
+    return BallCoverIndex(idx, jnp.asarray(radii), mt)
+
+
+@tracing.annotate("raft_tpu::ball_cover::knn")
+def knn(index: BallCoverIndex, queries, k: int, n_probes: int = 0
+        ) -> Tuple[jax.Array, jax.Array]:
+    """k nearest neighbors.
+
+    ``n_probes`` = 0 → exact (the reference's all-knn contract), served by
+    the fused brute-force kernel — see the module docstring for why
+    row-level triangle pruning is a no-op on the MXU. ``n_probes`` > 0 →
+    probe that many closest landmarks (the RBC approximate mode; recall
+    rises with probes exactly as IVF-Flat).
+    """
+    q = jnp.asarray(queries, jnp.float32)
+    if n_probes <= 0:
+        bf = brute_force.Index(index.ivf.data, index.ivf.data_norms,
+                               index.metric)
+        d, loc = brute_force.search(bf, q, k)
+        ids = jnp.where(loc >= 0,
+                        jnp.take(index.ivf.source_ids, jnp.maximum(loc, 0)),
+                        -1)
+        return d, ids
+    sp = ivf_flat.SearchParams(n_probes=n_probes)
+    ivf = dataclasses.replace(index.ivf, metric=index.metric) \
+        if index.ivf.metric is not index.metric else index.ivf
+    return ivf_flat.search(ivf, q, k, sp)
+
+
+@tracing.annotate("raft_tpu::ball_cover::eps_nn")
+def eps_nn(index: BallCoverIndex, queries, eps: float
+           ) -> Tuple[jax.Array, jax.Array]:
+    """Epsilon neighborhood with landmark pruning (ball_cover.cuh:120
+    eps_nn) → (adj (m, n) bool over ORIGINAL row ids, degrees (m,)).
+
+    Landmark groups whose ball lies entirely outside the eps-ball of a
+    query (d(q, L) > eps + radius(L)) are skipped group-wise; surviving
+    groups get exact distances.
+    """
+    q = jnp.asarray(queries, jnp.float32)
+    m = q.shape[0]
+    n = index.size
+    # group-level prune (vectorized over (m, landmarks))
+    dql = jnp.sqrt(jnp.maximum(pairwise_distance(
+        q, index.ivf.centers, "sqeuclidean"), 0.0))
+    alive = dql <= (eps + index.radii)[None, :]          # (m, L)
+    # exact distances for members of surviving groups
+    labels = jnp.asarray(np.repeat(np.arange(index.ivf.n_lists),
+                                   index.ivf.list_sizes))
+    row_alive = jnp.take_along_axis(
+        alive, jnp.broadcast_to(labels[None, :], (m, n)), axis=1)
+    d2 = pairwise_distance(q, index.ivf.data, "sqeuclidean")
+    inside = row_alive & (d2 <= eps * eps)
+    # scatter back to original row order
+    adj = jnp.zeros((m, n), bool)
+    adj = adj.at[:, index.ivf.source_ids].set(inside)
+    return adj, jnp.sum(inside, axis=1).astype(jnp.int32)
+
+
+def epsilon_neighborhood(x, y, eps: float) -> Tuple[jax.Array, jax.Array]:
+    """Dense eps-neighborhood (neighbors/epsilon_neighborhood.cuh:
+    epsUnexpL2SqNeighborhood): adj[i, j] = ||x_i - y_j||² <= eps², plus
+    vertex degrees."""
+    d2 = pairwise_distance(jnp.asarray(x, jnp.float32),
+                           jnp.asarray(y, jnp.float32), "sqeuclidean")
+    adj = d2 <= eps * eps
+    return adj, jnp.sum(adj, axis=1).astype(jnp.int32)

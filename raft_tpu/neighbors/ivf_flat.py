@@ -32,7 +32,7 @@ from ..core.serialize import load_arrays, save_arrays
 from ..cluster import kmeans_balanced
 from ..distance.distance_types import DistanceType, canonical_metric, is_min_close
 from ..matrix.select_k import select_k
-from ..utils import cdiv
+from ..utils import cdiv, hdot
 
 __all__ = ["IndexParams", "SearchParams", "Index", "build", "extend", "search",
            "save", "load"]
@@ -263,7 +263,7 @@ def search_arrays(data, data_norms, source_ids, centers, center_norms,
     arrays and calls this per shard)."""
     select_min = is_min_close(mt)
     # stage 1: coarse probe selection (ivf_flat_search-inl.cuh:38)
-    cross = qc @ centers.T
+    cross = hdot(qc, centers.T)
     if mt is DistanceType.InnerProduct:
         coarse = -cross
     elif mt is DistanceType.CosineExpanded:
@@ -279,14 +279,14 @@ def search_arrays(data, data_norms, source_ids, centers, center_norms,
     rows, valid, _ = _candidate_rows(probed, offsets_j, sizes_j, max_rows)
     cand = data[rows]                            # (m, S, d)
     if mt is DistanceType.InnerProduct:
-        dist = jnp.einsum("msd,md->ms", cand, qc)
+        dist = jnp.einsum("msd,md->ms", cand, qc, precision="highest")
     elif mt is DistanceType.CosineExpanded:
-        ip = jnp.einsum("msd,md->ms", cand, qc)
+        ip = jnp.einsum("msd,md->ms", cand, qc, precision="highest")
         qn = jnp.sqrt(jnp.maximum(jnp.sum(qc * qc, axis=1, keepdims=True), 1e-30))
         cn = jnp.sqrt(jnp.maximum(data_norms[rows], 1e-30))
         dist = 1.0 - ip / (qn * cn)
     else:
-        ip = jnp.einsum("msd,md->ms", cand, qc)
+        ip = jnp.einsum("msd,md->ms", cand, qc, precision="highest")
         q2 = jnp.sum(qc * qc, axis=1, keepdims=True)
         dist = jnp.maximum(q2 + data_norms[rows] - 2.0 * ip, 0.0)
         if mt is DistanceType.L2SqrtExpanded:

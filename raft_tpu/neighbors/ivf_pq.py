@@ -47,7 +47,7 @@ from ..core.serialize import load_arrays, save_arrays
 from ..cluster import kmeans_balanced
 from ..distance.distance_types import DistanceType, canonical_metric
 from ..matrix.select_k import select_k
-from ..utils import cdiv
+from ..utils import cdiv, hdot
 from .ivf_flat import _candidate_rows, _probe_budget, _sort_by_list
 
 __all__ = ["CodebookGen", "IndexParams", "SearchParams", "Index", "build",
@@ -197,7 +197,7 @@ def _kmeans_fixed(x, k, iters, key):
 
     def step(centers, _):
         d2 = (jnp.sum(x * x, axis=1, keepdims=True)
-              - 2.0 * (x @ centers.T)
+              - 2.0 * hdot(x, centers.T)
               + jnp.sum(centers * centers, axis=1)[None, :])
         labels = jnp.argmin(d2, axis=1)
         sums = jax.ops.segment_sum(x, labels, num_segments=k)
@@ -264,13 +264,13 @@ def _encode(resid_rot, codebooks, labels, kind_per_cluster: bool):
         slices = resid_rot.reshape(n, pq_dim, pq_len)
         books = codebooks[labels]                    # (n, book, pq_len)
         d2 = (jnp.sum(slices * slices, axis=2)[:, :, None]
-              - 2.0 * jnp.einsum("nsl,nbl->nsb", slices, books)
+              - 2.0 * jnp.einsum("nsl,nbl->nsb", slices, books, precision="highest")
               + jnp.sum(books * books, axis=2)[:, None, :])
         return jnp.argmin(d2, axis=2).astype(jnp.uint8)
     pq_dim, _, pq_len = codebooks.shape
     slices = resid_rot.reshape(n, pq_dim, pq_len)
     d2 = (jnp.sum(slices * slices, axis=2)[:, :, None]
-          - 2.0 * jnp.einsum("nsl,sbl->nsb", slices, codebooks)
+          - 2.0 * jnp.einsum("nsl,sbl->nsb", slices, codebooks, precision="highest")
           + jnp.sum(codebooks * codebooks, axis=2)[None, :, :])
     return jnp.argmin(d2, axis=2).astype(jnp.uint8)
 
@@ -305,10 +305,10 @@ def build(dataset, params: IndexParams | None = None) -> Index:
 
     rotation = make_rotation_matrix(k_rot, rot_dim, dim,
                                     p.force_random_rotation)
-    centers_rot = centers @ rotation.T
+    centers_rot = hdot(centers, rotation.T)
 
     # codebooks on rotated trainset residuals (ivf_pq_build.cuh:1855-1873)
-    train_rot = trainset @ rotation.T
+    train_rot = hdot(trainset, rotation.T)
     t_labels, _ = kmeans_balanced.predict(trainset, centers)
     t_resid = train_rot - centers_rot[t_labels]
     if p.codebook_kind is CodebookGen.PER_SUBSPACE:
@@ -344,10 +344,10 @@ def extend(index: Index, new_vectors, new_ids=None,
     labels_parts, codes_parts = [], []
     for b0 in range(0, n_new, batch_size):
         xb = jnp.asarray(new_vectors[b0 : b0 + batch_size])
-        xb_rot = xb @ index.rotation.T
+        xb_rot = hdot(xb, index.rotation.T)
         # nearest rotated center == nearest center (orthogonal rotation)
         d2 = (jnp.sum(xb_rot * xb_rot, axis=1, keepdims=True)
-              - 2.0 * xb_rot @ index.centers_rot.T
+              - 2.0 * hdot(xb_rot, index.centers_rot.T)
               + jnp.sum(index.centers_rot * index.centers_rot, axis=1)[None, :])
         lb = jnp.argmin(d2, axis=1)
         resid = xb_rot - index.centers_rot[lb]
@@ -419,7 +419,7 @@ def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
     q_rot = qc @ index.rotation.T                       # (m, rot_dim)
 
     # stage 1: coarse probe selection (select_clusters, ivf_pq_search.cuh:69)
-    cross = q_rot @ index.centers_rot.T
+    cross = hdot(q_rot, index.centers_rot.T)
     if mt is DistanceType.InnerProduct:
         coarse = -cross
     else:
@@ -432,25 +432,25 @@ def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
     if mt is DistanceType.InnerProduct:
         qs = q_rot.reshape(m, pq_dim, pq_len)
         if index.codebook_kind is CodebookGen.PER_SUBSPACE:
-            lut = -jnp.einsum("msl,sbl->msb", qs, index.codebooks)
+            lut = -jnp.einsum("msl,sbl->msb", qs, index.codebooks, precision="highest")
             lut = jnp.broadcast_to(lut[:, None], (m, n_probes, pq_dim, book))
         else:
             books = index.codebooks[probed]             # (m, p, book, pq_len)
-            lut = -jnp.einsum("msl,mpbl->mpsb", qs, books)
-        const = -jnp.einsum("mr,mpr->mp", q_rot, centers_p)
+            lut = -jnp.einsum("msl,mpbl->mpsb", qs, books, precision="highest")
+        const = -jnp.einsum("mr,mpr->mp", q_rot, centers_p, precision="highest")
     else:
         resid = q_rot[:, None, :] - centers_p           # (m, p, rot_dim)
         rs = resid.reshape(m, n_probes, pq_dim, pq_len)
         if index.codebook_kind is CodebookGen.PER_SUBSPACE:
             cb2 = jnp.sum(index.codebooks * index.codebooks, axis=2)  # (s, b)
             lut = (jnp.sum(rs * rs, axis=3)[..., None]
-                   - 2.0 * jnp.einsum("mpsl,sbl->mpsb", rs, index.codebooks)
+                   - 2.0 * jnp.einsum("mpsl,sbl->mpsb", rs, index.codebooks, precision="highest")
                    + cb2[None, None])
         else:
             books = index.codebooks[probed]             # (m, p, book, pq_len)
             cb2 = jnp.sum(books * books, axis=3)        # (m, p, b)
             lut = (jnp.sum(rs * rs, axis=3)[..., None]
-                   - 2.0 * jnp.einsum("mpsl,mpbl->mpsb", rs, books)
+                   - 2.0 * jnp.einsum("mpsl,mpbl->mpsb", rs, books, precision="highest")
                    + cb2[:, :, None, :])
         const = jnp.zeros((m, n_probes), jnp.float32)
     lut = lut.astype(lut_dtype)

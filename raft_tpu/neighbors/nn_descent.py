@@ -38,7 +38,7 @@ def _dedup_rows(cand: np.ndarray) -> np.ndarray:
 
 
 def _pair_dists(x_rows, vecs, mt):
-    ip = jnp.einsum("bcd,bd->bc", vecs, x_rows)
+    ip = jnp.einsum("bcd,bd->bc", vecs, x_rows, precision="highest")
     if mt is DistanceType.InnerProduct:
         return -ip
     q2 = jnp.sum(x_rows * x_rows, axis=1, keepdims=True)
@@ -76,16 +76,19 @@ def _round_batch(dataset, rows, g_ids, g_dist, g_new, cand, k, mt_val):
 
 
 def _group_by_target(targets: np.ndarray, cands: np.ndarray, n: int,
-                     cap: int, rng) -> np.ndarray:
+                     cap: int, rng=None) -> np.ndarray:
     """Proposal edge list → (n, cap) per-target candidate table (-1 pad).
 
-    Vectorized: shuffle edges, stable-sort by target, keep the first ``cap``
-    arrivals per target.
+    Vectorized: shuffle edges (arrival order when ``rng`` is None),
+    stable-sort by target, keep the first ``cap`` arrivals per target.
     """
     live = (targets >= 0) & (cands >= 0)
     targets, cands = targets[live], cands[live]
-    perm = rng.permutation(len(targets))
-    tp, cp = targets[perm], cands[perm]
+    if rng is not None:
+        perm = rng.permutation(len(targets))
+        tp, cp = targets[perm], cands[perm]
+    else:
+        tp, cp = targets, cands
     order = np.argsort(tp, kind="stable")
     ts, cs = tp[order], cp[order]
     counts = np.bincount(ts, minlength=n)
@@ -199,8 +202,12 @@ def build(dataset, k: int, metric=DistanceType.L2Expanded, n_iters: int = 20,
         is_new[b0 : b0 + batch] = np.asarray(g_n)
 
     # each node generates ~2s×4s join proposals; keep enough of what lands
-    # on it that the round's information isn't thrown away
-    cap = 4 * s * s
+    # on it that the round's information isn't thrown away, but bound the
+    # (n, cap) int32 table to ~512 MB host RAM — an uncapped 4s² is
+    # gigabytes at n=1M. Dropped proposals are a uniform random subset
+    # (_group_by_target shuffles), so extra rounds recover the recall the
+    # way GNND's capped internal lists do.
+    cap = min(4 * s * s, max(4 * k, (512 << 20) // (4 * n)))
     for _ in range(n_iters):
         cand = _dedup_rows(_local_join_proposals(graph, is_new, s, cap, rng))
 

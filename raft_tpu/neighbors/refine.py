@@ -47,7 +47,7 @@ def refine(
     valid = cand >= 0
     rows = jnp.where(valid, cand, 0)
     vecs = x[rows]                                   # (m, c, d)
-    ip = jnp.einsum("mcd,md->mc", vecs, q)
+    ip = jnp.einsum("mcd,md->mc", vecs, q, precision="highest")
     if mt is DistanceType.InnerProduct:
         dist = -ip
     elif mt is DistanceType.CosineExpanded:

@@ -100,6 +100,10 @@ def _kernel(q_ref, d_ref, dn_ref, pen_ref, ov_ref, oi_ref, sv_ref, si_ref,
                           keepdims=True)
             at = ccol == pos
             bid = jnp.max(jnp.where(at, ci, -1), axis=1, keepdims=True)
+            # rows with no remaining finite candidate: the inf tie-scan
+            # lands on an already-retired column — emit the -1 sentinel,
+            # not that column's (real, duplicate) id
+            bid = jnp.where(jnp.isfinite(best), bid, -1)
             nv = jnp.where(lane == t, best, nv)
             ni = jnp.where(lane == t, bid, ni)
             return jnp.where(at, jnp.inf, c), nv, ni

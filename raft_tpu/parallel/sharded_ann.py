@@ -25,15 +25,26 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..comms import AxisComms
 from ..core.errors import expects
 from ..distance.distance_types import DistanceType, canonical_metric, is_min_close
-from ..neighbors import brute_force, cagra, ivf_flat
+from ..neighbors import brute_force, cagra, ivf_flat, ivf_pq
 from ..utils import cdiv
 
 __all__ = ["ShardedIvfFlat", "build_ivf_flat", "search_ivf_flat",
-           "ShardedCagra", "build_cagra", "search_cagra"]
+           "ShardedCagra", "build_cagra", "search_cagra",
+           "ShardedIvfPq", "build_ivf_pq", "search_ivf_pq"]
 
 AXIS = "shard"
+
+
+def _comms_of(mesh, res=None) -> AxisComms:
+    """Communicator for the shard axis: the injected one when a Resources
+    carries it (the reference's resource::get_comms path), else a fresh
+    AxisComms over the mesh's axis."""
+    if res is not None and res.has_comms():
+        return res.comms
+    return AxisComms(AXIS, size=mesh.shape[AXIS])
 
 
 def _split_rows(n: int, p: int) -> list[np.ndarray]:
@@ -123,15 +134,16 @@ def build_ivf_flat(dataset, mesh: Mesh,
 
 
 def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
-                    params: ivf_flat.SearchParams | None = None
-                    ) -> Tuple[jax.Array, jax.Array]:
-    """Replicated queries → per-shard local search → all_gather + merge."""
+                    params: ivf_flat.SearchParams | None = None,
+                    res=None) -> Tuple[jax.Array, jax.Array]:
+    """Replicated queries → per-shard local search → allgather + merge."""
     sp = params or ivf_flat.SearchParams()
     q = jnp.asarray(queries, jnp.float32)
     n_probes = min(sp.n_probes, index.centers.shape[1])
     max_rows = index.max_rows(n_probes)
     mt = index.metric
     select_min = is_min_close(mt)
+    comms = _comms_of(index.mesh, res)
 
     def local(data, norms, gids, centers, cnorms, offsets, sizes, qq):
         args = [a[0] for a in (data, norms, gids, centers, cnorms, offsets,
@@ -139,8 +151,8 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
         d, i = ivf_flat.search_arrays(
             args[0], args[1], args[2], args[3], args[4], args[5], args[6],
             qq, k, n_probes, max_rows, mt)
-        all_d = jax.lax.all_gather(d, AXIS)     # (p, m, k)
-        all_i = jax.lax.all_gather(i, AXIS)
+        all_d = comms.allgather(d)              # (p, m, k)
+        all_i = comms.allgather(i)
         return brute_force.knn_merge_parts(all_d, all_i, select_min)
 
     shmap = jax.shard_map(
@@ -198,9 +210,9 @@ def build_cagra(dataset, mesh: Mesh,
 
 
 def search_cagra(index: ShardedCagra, queries, k: int,
-                 params: cagra.SearchParams | None = None
-                 ) -> Tuple[jax.Array, jax.Array]:
-    """Replicated queries → per-shard graph traversal → all_gather + merge."""
+                 params: cagra.SearchParams | None = None,
+                 res=None) -> Tuple[jax.Array, jax.Array]:
+    """Replicated queries → per-shard graph traversal → allgather + merge."""
     sp = params or cagra.SearchParams()
     q = jnp.asarray(queries, jnp.float32)
     itopk = max(sp.itopk_size, k)
@@ -211,6 +223,7 @@ def search_cagra(index: ShardedCagra, queries, k: int,
                              16 * sp.num_random_samplings))
     mt = index.metric
     select_min = mt is not DistanceType.InnerProduct
+    comms = _comms_of(index.mesh, res)
 
     def local(data, graph, base, count, qq):
         # padding rows (beyond this shard's real count) are masked out so
@@ -222,8 +235,8 @@ def search_cagra(index: ShardedCagra, queries, k: int,
         gi = jnp.where(i >= 0, i + base[0], -1)
         bad = jnp.inf if select_min else -jnp.inf
         d = jnp.where(gi >= 0, d, bad)
-        all_d = jax.lax.all_gather(d, AXIS)
-        all_i = jax.lax.all_gather(gi, AXIS)
+        all_d = comms.allgather(d)
+        all_i = comms.allgather(gi)
         return brute_force.knn_merge_parts(all_d, all_i, select_min)
 
     shmap = jax.shard_map(
@@ -233,3 +246,112 @@ def search_cagra(index: ShardedCagra, queries, k: int,
         out_specs=(P(), P()),
         check_vma=False)
     return shmap(index.data, index.graphs, index.bases, index.counts, q)
+
+
+class ShardedIvfPq:
+    """Stacked per-shard IVF-PQ arrays, leading axis sharded over AXIS.
+
+    The BASELINE north-star layout (sharded IVF-PQ over a worker mesh): one
+    compressed index per shard row block, merged per-query at search time.
+    """
+
+    def __init__(self, mesh, codes, source_ids, centers_rot, codebooks,
+                 rotations, offsets, sizes, n_total, metric, pq_bits,
+                 codebook_kind, sizes_host):
+        self.mesh = mesh
+        self.codes = codes              # (p, R, pq_dim) u8, cluster-sorted
+        self.source_ids = source_ids    # (p, R) GLOBAL ids, -1 pad
+        self.centers_rot = centers_rot  # (p, L, rot_dim)
+        self.codebooks = codebooks      # (p, ...) per-shard codebooks
+        self.rotations = rotations      # (p, rot_dim, dim)
+        self.offsets = offsets          # (p, L) i32
+        self.sizes = sizes              # (p, L) i32
+        self.n_total = n_total
+        self.metric = metric
+        self.pq_bits = pq_bits
+        self.codebook_kind = codebook_kind
+        self._sizes_host = sizes_host   # list of per-shard np size arrays
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.shape[AXIS]
+
+    def max_rows(self, n_probes: int) -> int:
+        return int(max(ivf_pq._probe_budget(s, n_probes)
+                       for s in self._sizes_host))
+
+
+def build_ivf_pq(dataset, mesh: Mesh,
+                 params: ivf_pq.IndexParams | None = None) -> ShardedIvfPq:
+    """Build one IVF-PQ index per contiguous shard row block (the raft-dask
+    per-worker build of BASELINE config 5)."""
+    expects(AXIS in mesh.shape, "mesh must have a %r axis", AXIS)
+    p0 = params or ivf_pq.IndexParams()
+    dataset = np.asarray(dataset, np.float32)
+    n = len(dataset)
+    p = mesh.shape[AXIS]
+    parts = _split_rows(n, p)
+
+    shards = [ivf_pq.build(dataset[rows], p0) for rows in parts]
+    mt = shards[0].metric
+
+    codes = _stack_pad([np.asarray(s.codes) for s in shards])
+    gids = _stack_pad(
+        [np.asarray(s.source_ids) + parts[i][0] for i, s in enumerate(shards)],
+        pad_value=-1)
+    centers = np.stack([np.asarray(s.centers_rot) for s in shards])
+    books = np.stack([np.asarray(s.codebooks) for s in shards])
+    rots = np.stack([np.asarray(s.rotation) for s in shards])
+    offsets = np.stack([s.list_offsets[:-1] for s in shards]).astype(np.int32)
+    sizes = np.stack([s.list_sizes for s in shards]).astype(np.int32)
+
+    def put(x, spec):
+        return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
+
+    ndim_spec = lambda a: P(AXIS, *([None] * (a.ndim - 1)))
+    return ShardedIvfPq(
+        mesh, put(codes, ndim_spec(codes)), put(gids, ndim_spec(gids)),
+        put(centers, ndim_spec(centers)), put(books, ndim_spec(books)),
+        put(rots, ndim_spec(rots)), put(offsets, ndim_spec(offsets)),
+        put(sizes, ndim_spec(sizes)), n, mt, shards[0].pq_bits,
+        shards[0].codebook_kind, [s.list_sizes for s in shards])
+
+
+def search_ivf_pq(index: ShardedIvfPq, queries, k: int,
+                  params: ivf_pq.SearchParams | None = None,
+                  res=None) -> Tuple[jax.Array, jax.Array]:
+    """Replicated queries → per-shard LUT search → allgather + merge
+    (knn_merge_parts.cuh:172 pattern over the comms allgather)."""
+    sp = params or ivf_pq.SearchParams()
+    q = jnp.asarray(queries, jnp.float32)
+    n_probes = min(sp.n_probes, index.centers_rot.shape[1])
+    max_rows = index.max_rows(n_probes)
+    mt = index.metric
+    select_min = is_min_close(mt)
+    comms = _comms_of(index.mesh, res)
+    # dummy host offsets: _search_chunk reads offsets/sizes from the traced
+    # args, never from the Index (search() does, but we bypass it)
+    dummy_off = np.zeros(index.centers_rot.shape[1] + 1, np.int64)
+
+    def local(codes, gids, centers, books, rots, offsets, sizes, qq):
+        shard = ivf_pq.Index(
+            codes[0], gids[0], centers[0], books[0], rots[0], dummy_off,
+            mt, index.pq_bits, index.codebook_kind)
+        d, i = ivf_pq._search_chunk(shard, qq, k, n_probes, max_rows,
+                                    offsets[0], sizes[0], None, sp.lut_dtype)
+        bad = jnp.inf if select_min else -jnp.inf
+        d = jnp.where(i >= 0, d, bad)       # padded rows carry id -1
+        all_d = comms.allgather(d)
+        all_i = comms.allgather(i)
+        return brute_force.knn_merge_parts(all_d, all_i, select_min)
+
+    shmap = jax.shard_map(
+        local, mesh=index.mesh,
+        in_specs=(P(AXIS, None, None), P(AXIS, None), P(AXIS, None, None),
+                  P(AXIS, *([None] * (index.codebooks.ndim - 1))),
+                  P(AXIS, None, None), P(AXIS, None), P(AXIS, None), P()),
+        out_specs=(P(), P()),
+        check_vma=False)
+    return shmap(index.codes, index.source_ids, index.centers_rot,
+                 index.codebooks, index.rotations, index.offsets,
+                 index.sizes, q)

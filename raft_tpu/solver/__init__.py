@@ -1,0 +1,15 @@
+"""Solvers: analog of ``raft/solver/`` — the batched linear assignment
+problem (Hungarian) solver.
+
+Reference: solver/linear_assignment.cuh:54 (`LinearAssignmentProblem`,
+a GPU Hungarian/LAP batched over problem instances; lap/lap.cuh is the
+deprecated alias).
+
+TPU design: the auction algorithm instead of Hungarian row/col reduction
+— auction is synchronous-parallel by construction (all unassigned rows
+bid simultaneously each round: one argmax + one scatter-max, both native
+XLA), converges with eps-scaling, and batches over instances with vmap.
+"""
+from .lap import LinearAssignmentProblem, solve_lap
+
+__all__ = ["solve_lap", "LinearAssignmentProblem"]

@@ -1,0 +1,29 @@
+"""Sparse primitives: analog of ``raft/sparse/``.
+
+Reference inventory (SURVEY §2.8): COO/CSR containers + conversions
+(sparse/convert/), linalg (degree/norm/spmm/sddmm/symmetrize/transpose),
+ops (filter/reduce/row_op/slice/sort), sparse pairwise distances
+(sparse/distance/distance.cuh:38), sparse brute-force kNN + kNN-graph
+(sparse/neighbors/), Boruvka MST (sparse/solver/mst_solver.cuh) and
+Lanczos (sparse/solver/lanczos.cuh).
+
+TPU design: storage rides `jax.experimental.sparse.BCOO` (XLA's native
+batched-COO, with TPU lowerings for dense@sparse) wrapped in RAFT-shaped
+COO/CSR views; compute paths densify row *tiles* so the MXU does the
+work — a sparse lane-by-lane scan is exactly what the MXU is bad at.
+MST and dendrogram-building run host-side (pointer-chasing), like the
+reference's host orchestration around its kernels.
+"""
+from .coo import COO
+from .csr import CSR
+from .linalg import degree, row_norm, sddmm, spmm, symmetrize, transpose
+from .distance import pairwise_distance as sparse_pairwise_distance
+from .neighbors import brute_force_knn as sparse_brute_force_knn
+from .neighbors import knn_graph
+from .solver import lanczos_smallest, mst
+
+__all__ = [
+    "COO", "CSR", "degree", "row_norm", "spmm", "sddmm", "symmetrize",
+    "transpose", "sparse_pairwise_distance", "sparse_brute_force_knn",
+    "knn_graph", "mst", "lanczos_smallest",
+]

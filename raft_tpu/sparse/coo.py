@@ -1,0 +1,81 @@
+"""COO container (raft/core/coo_matrix.hpp + sparse/convert/coo.cuh)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import expects
+
+__all__ = ["COO"]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class COO:
+    """Coordinate-format sparse matrix (rows, cols, vals) + shape."""
+
+    rows: jax.Array      # (nnz,) i32
+    cols: jax.Array      # (nnz,) i32
+    vals: jax.Array      # (nnz,) f32
+    shape: Tuple[int, int]
+
+    @property
+    def nnz(self) -> int:
+        return self.vals.shape[0]
+
+    def tree_flatten(self):
+        return (self.rows, self.cols, self.vals), (self.shape,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, aux[0])
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_dense(cls, dense) -> "COO":
+        d = np.asarray(dense)
+        r, c = np.nonzero(d)
+        return cls(jnp.asarray(r, jnp.int32), jnp.asarray(c, jnp.int32),
+                   jnp.asarray(d[r, c], jnp.float32), d.shape)
+
+    @classmethod
+    def from_scipy(cls, m) -> "COO":
+        m = m.tocoo()
+        return cls(jnp.asarray(m.row, jnp.int32),
+                   jnp.asarray(m.col, jnp.int32),
+                   jnp.asarray(m.data, jnp.float32), m.shape)
+
+    # -- conversions -------------------------------------------------------
+    def to_dense(self) -> jax.Array:
+        out = jnp.zeros(self.shape, self.vals.dtype)
+        return out.at[self.rows, self.cols].add(self.vals)
+
+    def _row_major_order(self) -> jax.Array:
+        """Stable (row, col) ordering without an n*r+c key (which overflows
+        int32 past ~46k rows): two stable argsorts."""
+        by_col = jnp.argsort(self.cols, stable=True)
+        return by_col[jnp.argsort(self.rows[by_col], stable=True)]
+
+    def to_csr(self):
+        from .csr import CSR
+
+        order = self._row_major_order()
+        counts = jnp.zeros((self.shape[0] + 1,), jnp.int32).at[
+            self.rows[order] + 1].add(1)
+        return CSR(jnp.cumsum(counts).astype(jnp.int32),
+                   self.cols[order], self.vals[order], self.shape)
+
+    def to_bcoo(self):
+        from jax.experimental import sparse as jsparse
+
+        idx = jnp.stack([self.rows, self.cols], axis=1)
+        return jsparse.BCOO((self.vals, idx), shape=self.shape)
+
+    def sorted_by_row(self) -> "COO":
+        order = self._row_major_order()
+        return COO(self.rows[order], self.cols[order], self.vals[order],
+                   self.shape)

@@ -1,0 +1,77 @@
+"""CSR container (raft/core/csr_matrix.hpp + sparse/convert/csr.cuh)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["CSR"]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class CSR:
+    """Compressed-sparse-row matrix (indptr, indices, vals) + shape."""
+
+    indptr: jax.Array    # (n_rows+1,) i32
+    indices: jax.Array   # (nnz,) i32
+    vals: jax.Array      # (nnz,) f32
+    shape: Tuple[int, int]
+
+    @property
+    def nnz(self) -> int:
+        return self.vals.shape[0]
+
+    def tree_flatten(self):
+        return (self.indptr, self.indices, self.vals), (self.shape,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, aux[0])
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_dense(cls, dense) -> "CSR":
+        from .coo import COO
+
+        return COO.from_dense(dense).to_csr()
+
+    @classmethod
+    def from_scipy(cls, m) -> "CSR":
+        m = m.tocsr()
+        return cls(jnp.asarray(m.indptr, jnp.int32),
+                   jnp.asarray(m.indices, jnp.int32),
+                   jnp.asarray(m.data, jnp.float32), m.shape)
+
+    # -- conversions -------------------------------------------------------
+    def row_ids(self) -> jax.Array:
+        """(nnz,) row of each stored element (csr_to_coo row expansion)."""
+        ptr = np.asarray(self.indptr)
+        return jnp.asarray(np.repeat(np.arange(self.shape[0]),
+                                     np.diff(ptr)), jnp.int32)
+
+    def to_coo(self):
+        from .coo import COO
+
+        return COO(self.row_ids(), self.indices, self.vals, self.shape)
+
+    def to_dense(self) -> jax.Array:
+        out = jnp.zeros(self.shape, self.vals.dtype)
+        return out.at[self.row_ids(), self.indices].add(self.vals)
+
+    def to_bcsr(self):
+        from jax.experimental import sparse as jsparse
+
+        return jsparse.BCSR((self.vals, self.indices, self.indptr),
+                            shape=self.shape)
+
+    def slice_rows(self, start: int, stop: int) -> "CSR":
+        """Row-range slice (sparse/op/slice.cuh)."""
+        ptr = np.asarray(self.indptr)
+        lo, hi = int(ptr[start]), int(ptr[stop])
+        return CSR(jnp.asarray(ptr[start : stop + 1] - lo, jnp.int32),
+                   self.indices[lo:hi], self.vals[lo:hi],
+                   (stop - start, self.shape[1]))

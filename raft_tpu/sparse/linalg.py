@@ -1,0 +1,97 @@
+"""Sparse linalg ops (raft/sparse/linalg/: degree, norm, spmm, sddmm,
+symmetrize, transpose)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import hdot
+from .coo import COO
+from .csr import CSR
+
+__all__ = ["degree", "row_norm", "spmm", "sddmm", "symmetrize", "transpose"]
+
+
+def _as_coo(m) -> COO:
+    return m.to_coo() if isinstance(m, CSR) else m
+
+
+def degree(m) -> jax.Array:
+    """Per-row stored-element count (sparse/linalg/degree.cuh)."""
+    coo = _as_coo(m)
+    return jnp.zeros((coo.shape[0],), jnp.int32).at[coo.rows].add(1)
+
+
+def row_norm(m, norm: str = "l2") -> jax.Array:
+    """Per-row norm over stored values (sparse/linalg/norm.cuh)."""
+    coo = _as_coo(m)
+    if norm == "l1":
+        contrib = jnp.abs(coo.vals)
+    elif norm == "l2":
+        contrib = coo.vals * coo.vals
+    elif norm == "linf":
+        out = jnp.zeros((coo.shape[0],), coo.vals.dtype)
+        return out.at[coo.rows].max(jnp.abs(coo.vals))
+    else:
+        raise ValueError(f"unknown norm {norm!r}")
+    return jnp.zeros((coo.shape[0],), coo.vals.dtype).at[coo.rows].add(contrib)
+
+
+def spmm(m, dense) -> jax.Array:
+    """sparse (n, k) @ dense (k, d) → dense (n, d)
+    (sparse/linalg/spmm.hpp). Scatter-add formulation: one gather of the
+    dense rows + one segment add — XLA fuses both."""
+    coo = _as_coo(m)
+    dense = jnp.asarray(dense, jnp.float32)
+    contrib = coo.vals[:, None] * dense[coo.cols]      # (nnz, d)
+    out = jnp.zeros((coo.shape[0], dense.shape[1]), jnp.float32)
+    return out.at[coo.rows].add(contrib)
+
+
+def sddmm(a, b, mask) -> COO:
+    """Sampled dense-dense matmul: (A @ B)[i,j] at stored positions of
+    ``mask`` (sparse/linalg/sddmm.hpp)."""
+    coo = _as_coo(mask)
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    vals = jnp.sum(a[coo.rows] * b.T[coo.cols], axis=1)
+    return COO(coo.rows, coo.cols, vals, coo.shape)
+
+
+def transpose(m) -> COO:
+    """COO/CSR transpose (sparse/linalg/transpose.hpp)."""
+    coo = _as_coo(m)
+    return COO(coo.cols, coo.rows, coo.vals,
+               (coo.shape[1], coo.shape[0])).sorted_by_row()
+
+
+def symmetrize(m, op: str = "max") -> COO:
+    """Symmetrize an adjacency: combine (i,j) and (j,i) stored values with
+    ``op`` (sparse/linalg/symmetrize.cuh — the kNN-graph → undirected-graph
+    step for single-linkage/UMAP-style pipelines)."""
+    coo = _as_coo(m)
+    n = max(coo.shape)
+    # duplicate every edge in both directions, then reduce duplicates by key
+    r = jnp.concatenate([coo.rows, coo.cols])
+    c = jnp.concatenate([coo.cols, coo.rows])
+    v = jnp.concatenate([coo.vals, coo.vals])
+    by_col = jnp.argsort(c, stable=True)
+    order = by_col[jnp.argsort(r[by_col], stable=True)]
+    r, c, v = r[order], c[order], v[order]
+    first = jnp.concatenate([jnp.ones((1,), bool),
+                             (r[1:] != r[:-1]) | (c[1:] != c[:-1])])
+    seg = jnp.cumsum(first) - 1
+    n_seg = coo.nnz * 2
+    if op == "max":
+        red = jnp.full((n_seg,), -jnp.inf, v.dtype).at[seg].max(v)
+    elif op == "add":
+        red = jnp.zeros((n_seg,), v.dtype).at[seg].add(v)
+    elif op == "mean":
+        s = jnp.zeros((n_seg,), v.dtype).at[seg].add(v)
+        cnt = jnp.zeros((n_seg,), v.dtype).at[seg].add(1.0)
+        red = s / jnp.maximum(cnt, 1.0)
+    else:
+        raise ValueError(f"unknown op {op!r}")
+    keep = first
+    return COO(r[keep], c[keep],
+               red[seg[keep]], (n, n))

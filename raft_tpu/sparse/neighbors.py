@@ -1,0 +1,52 @@
+"""Sparse brute-force kNN + kNN-graph (raft/sparse/neighbors/:
+brute_force_knn, knn_graph construction for connectivities)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import expects
+from ..distance.distance_types import canonical_metric, is_min_close
+from ..matrix.select_k import select_k
+from .coo import COO
+from .csr import CSR
+from .distance import pairwise_distance
+
+__all__ = ["brute_force_knn", "knn_graph"]
+
+
+def brute_force_knn(x: CSR, y: CSR, k: int, metric="sqeuclidean",
+                    tile_rows: int = 2048) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN of each x row among y rows (sparse brute_force_knn.cuh):
+    streaming row tiles of the sparse distance + per-tile select_k."""
+    expects(0 < k <= y.shape[0], "bad k")
+    mt = canonical_metric(metric)
+    select_min = is_min_close(mt)
+    outs_d, outs_i = [], []
+    for r0 in range(0, x.shape[0], tile_rows):
+        r1 = min(r0 + tile_rows, x.shape[0])
+        d = pairwise_distance(x.slice_rows(r0, r1), y, mt)
+        dv, di = select_k(d, k, select_min=select_min)
+        outs_d.append(dv)
+        outs_i.append(di)
+    return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+
+
+def knn_graph(x: CSR, k: int, metric="sqeuclidean") -> COO:
+    """Symmetric kNN connectivity graph (sparse/neighbors/knn_graph.cuh):
+    kNN per row (self removed) → COO with distance values, symmetrized."""
+    from .linalg import symmetrize
+
+    n = x.shape[0]
+    d, i = brute_force_knn(x, x, min(k + 1, n), metric)
+    d, i = np.asarray(d), np.asarray(i)
+    rows = np.repeat(np.arange(n, dtype=np.int32), i.shape[1])
+    cols = i.reshape(-1)
+    vals = d.reshape(-1).astype(np.float32)
+    keep = cols != rows          # drop self edges
+    coo = COO(jnp.asarray(rows[keep]), jnp.asarray(cols[keep]),
+              jnp.asarray(vals[keep]), (n, n))
+    return symmetrize(coo, op="max")

@@ -1,0 +1,141 @@
+"""Sparse solvers: Boruvka MST (sparse/solver/mst_solver.cuh) and a
+Lanczos eigensolver (sparse/solver/lanczos.cuh)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import expects
+from .coo import COO
+from .csr import CSR
+from .linalg import spmm
+
+__all__ = ["mst", "lanczos_smallest"]
+
+
+def mst(graph, symmetrize_input: bool = True
+        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Minimum spanning forest of a weighted undirected graph →
+    (src, dst, weight) edge arrays, |V|-components edges.
+
+    Boruvka rounds (mst_solver.cuh): every component claims its minimum
+    outgoing edge, claimed edges merge components; O(log V) rounds. Runs
+    host-side in vectorized numpy — the union-find is pointer-chasing the
+    TPU has no business doing, exactly why the reference keeps MST in its
+    own solver.
+    """
+    coo = graph.to_coo() if isinstance(graph, CSR) else graph
+    if symmetrize_input:
+        from .linalg import symmetrize
+
+        coo = symmetrize(coo, op="max")
+    src = np.asarray(coo.rows, np.int64)
+    dst = np.asarray(coo.cols, np.int64)
+    w = np.asarray(coo.vals, np.float64)
+    keep = src != dst
+    src, dst, w = src[keep], dst[keep], w[keep]
+    n = coo.shape[0]
+
+    comp = np.arange(n)
+    out_s, out_d, out_w = [], [], []
+
+    def find_root(comp):
+        # full path compression by repeated pointer jumping
+        while True:
+            nxt = comp[comp]
+            if (nxt == comp).all():
+                return comp
+            comp = nxt
+
+    for _ in range(64):  # ≥ log2(n) rounds always suffice
+        cs, cd = comp[src], comp[dst]
+        live = cs != cd
+        if not live.any():
+            break
+        ls, ld, lw = cs[live], cd[live], w[live]
+        eid = np.nonzero(live)[0]
+        # min outgoing edge per component (consider both endpoints); weight
+        # ties break on global edge id — the standard Boruvka tie-break that
+        # keeps the union of picks acyclic
+        allc = np.concatenate([ls, ld])
+        alle = np.concatenate([eid, eid])
+        allw = np.concatenate([lw, lw])
+        order = np.lexsort((alle, allw, allc))
+        first = np.concatenate([[True], allc[order][1:] != allc[order][:-1]])
+        pick = np.unique(alle[order][first])
+        # merge: point the larger root at the smaller for each picked edge;
+        # several merges may hit one root — min-scatter then re-root
+        a, b = comp[src[pick]], comp[dst[pick]]
+        lo, hi = np.minimum(a, b), np.maximum(a, b)
+        parent = np.arange(n)
+        np.minimum.at(parent, hi, lo)
+        comp = find_root(parent[comp])
+        out_s.append(src[pick])
+        out_d.append(dst[pick])
+        out_w.append(w[pick])
+    s = np.concatenate(out_s) if out_s else np.empty(0, np.int64)
+    d = np.concatenate(out_d) if out_d else np.empty(0, np.int64)
+    ww = np.concatenate(out_w) if out_w else np.empty(0, np.float64)
+    # Kruskal filter over the O(n log n) candidates: simultaneous scatter
+    # merges above can drop a merge, so the raw picks may contain a cycle —
+    # a final union-find pass guarantees a forest with the same min weight
+    order = np.argsort(ww, kind="stable")
+    parent = np.arange(n)
+
+    def find(x: int) -> int:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = int(parent[x])
+        return x
+
+    ks, kd, kw = [], [], []
+    for e in order:
+        ra, rb = find(int(s[e])), find(int(d[e]))
+        if ra != rb:
+            parent[max(ra, rb)] = min(ra, rb)
+            ks.append(int(s[e]))
+            kd.append(int(d[e]))
+            kw.append(float(ww[e]))
+    return (np.asarray(ks, np.int32), np.asarray(kd, np.int32),
+            np.asarray(kw, np.float32))
+
+
+def lanczos_smallest(a, k: int, n_iter: int = 0, seed: int = 0
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """k smallest eigenpairs of a symmetric sparse matrix →
+    (eigenvalues (k,), eigenvectors (n, k)).
+
+    The lanczos.cuh solver role. A single-vector Lanczos chain cannot
+    separate a degenerate eigenvalue (e.g. the q zero modes of a
+    q-component graph Laplacian reach the chain through one direction of
+    its start vector), so the solver is a *block* Krylov method — LOBPCG,
+    whose block inner products are batched matmats (the MXU-friendly
+    shape) — with a dense fallback for small problems.
+    """
+    coo = a.to_coo() if isinstance(a, CSR) else a
+    n = coo.shape[0]
+    expects(0 < k < n, "bad k=%d for n=%d", k, n)
+
+    if n <= 512:
+        dense = np.asarray(coo.to_dense(), np.float64)
+        evals, evecs = np.linalg.eigh(dense)
+        return (jnp.asarray(evals[:k].astype(np.float32)),
+                jnp.asarray(evecs[:, :k].astype(np.float32)))
+
+    import scipy.sparse as sp
+    from scipy.sparse.linalg import lobpcg
+
+    mat = sp.coo_matrix(
+        (np.asarray(coo.vals, np.float64),
+         (np.asarray(coo.rows), np.asarray(coo.cols))),
+        shape=coo.shape).tocsr()
+    rng = np.random.default_rng(seed)
+    x0 = rng.standard_normal((n, k)).astype(np.float64)
+    evals, evecs = lobpcg(mat, x0, largest=False, tol=1e-8,
+                          maxiter=n_iter or 500)
+    order = np.argsort(evals)
+    return (jnp.asarray(evals[order].astype(np.float32)),
+            jnp.asarray(evecs[:, order].astype(np.float32)))

@@ -1,0 +1,117 @@
+"""Bench harness tests: IO formats, dataset loading, runner schema, CLI
+export/plot — on tiny shapes (CPU)."""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from raft_tpu import bench
+
+
+class TestIO:
+    def test_fbin_ibin_roundtrip(self, tmp_path):
+        a = np.random.default_rng(0).standard_normal((13, 7)).astype(np.float32)
+        bench.write_fbin(tmp_path / "a.fbin", a)
+        np.testing.assert_array_equal(bench.read_fbin(tmp_path / "a.fbin"), a)
+        b = np.arange(12, dtype=np.int32).reshape(4, 3)
+        bench.write_ibin(tmp_path / "b.ibin", b)
+        np.testing.assert_array_equal(bench.read_ibin(tmp_path / "b.ibin"), b)
+
+    def test_load_synthetic(self):
+        base, q, gt, metric = bench.load_dataset("blobs-1000x16",
+                                                 n_queries=100)
+        assert base.shape == (1000, 16) and q.shape == (100, 16)
+        assert gt is None and metric == "sqeuclidean"
+
+    def test_load_bigann_dir(self, tmp_path):
+        rng = np.random.default_rng(1)
+        base = rng.standard_normal((200, 8)).astype(np.float32)
+        qs = rng.standard_normal((20, 8)).astype(np.float32)
+        d = tmp_path / "toy"
+        d.mkdir()
+        bench.write_fbin(d / "base.fbin", base)
+        bench.write_fbin(d / "query.fbin", qs)
+        got_b, got_q, gt, metric = bench.load_dataset(
+            "toy", dataset_dir=str(tmp_path))
+        np.testing.assert_array_equal(got_b, base)
+        np.testing.assert_array_equal(got_q, qs)
+        assert gt is None
+
+    def test_load_hdf5(self, tmp_path):
+        import h5py
+
+        rng = np.random.default_rng(2)
+        with h5py.File(tmp_path / "toy-8-angular.hdf5", "w") as f:
+            f["train"] = rng.standard_normal((100, 8)).astype(np.float32)
+            f["test"] = rng.standard_normal((10, 8)).astype(np.float32)
+            f["neighbors"] = rng.integers(0, 100, (10, 5)).astype(np.int32)
+        base, q, gt, metric = bench.load_dataset("toy-8-angular",
+                                                 dataset_dir=str(tmp_path))
+        assert base.shape == (100, 8) and gt.shape == (10, 5)
+        assert metric == "inner_product"
+
+
+class TestGroundTruth:
+    def test_matches_naive(self):
+        from ann_utils import naive_knn
+
+        rng = np.random.default_rng(3)
+        base = rng.standard_normal((500, 16)).astype(np.float32)
+        qs = rng.standard_normal((30, 16)).astype(np.float32)
+        d, i = bench.generate_groundtruth(base, qs, k=5)
+        _, want = naive_knn(base, qs, 5)
+        assert np.mean([len(set(i[r]) & set(want[r])) / 5
+                        for r in range(30)]) == 1.0
+
+
+class TestRunner:
+    def test_runner_schema_and_recall(self):
+        base, q, _, metric = bench.load_dataset("blobs-2000x16",
+                                                n_queries=64)
+        _, gt = bench.generate_groundtruth(base, q, k=10, metric=metric)
+        results = bench.run_benchmarks(
+            base, q, gt, k=10, metric=metric,
+            algos=("raft_brute_force", "raft_ivf_flat"), reps=1,
+            verbose=False)
+        assert len(results) > 1
+        bf = [r for r in results if r.algo == "raft_brute_force"][0]
+        assert bf.recall == 1.0          # exact search must be perfect
+        assert bf.qps > 0
+        g = bf.to_gbench()
+        for key in ("name", "items_per_second", "Recall", "Latency"):
+            assert key in g
+        # wider probes → recall must not decrease (allow fp jitter)
+        ivf = sorted((r for r in results if r.algo == "raft_ivf_flat"),
+                     key=lambda r: r.search_params["n_probes"])
+        assert ivf[-1].recall >= ivf[0].recall - 0.02
+
+
+class TestCli:
+    def test_export_and_plot(self, tmp_path):
+        from raft_tpu.bench.__main__ import main
+
+        doc = {
+            "context": {"dataset": "toy"},
+            "benchmarks": [
+                {"name": "algoA.p1/search", "Recall": 0.8,
+                 "items_per_second": 1000.0, "Latency": 0.01},
+                {"name": "algoA.p2/search", "Recall": 0.9,
+                 "items_per_second": 500.0, "Latency": 0.02},
+                {"name": "algoA.p3/search", "Recall": 0.7,
+                 "items_per_second": 400.0, "Latency": 0.02},  # dominated
+            ],
+        }
+        src = tmp_path / "r.json"
+        src.write_text(json.dumps(doc))
+        main(["export", "--input", str(src)])
+        csv_text = (tmp_path / "r.csv").read_text()
+        rows = [l.split(",") for l in csv_text.strip().splitlines()[1:]]
+        pareto = {r[1]: r[-1] for r in rows}
+        assert pareto["algoA.p1/search"] == "1"
+        assert pareto["algoA.p2/search"] == "1"
+        assert pareto["algoA.p3/search"] == "0"
+        main(["plot", "--input", str(src)])
+        assert (tmp_path / "r.png").exists()

@@ -1,0 +1,97 @@
+"""hnsw (CPU graph search) + ball_cover / epsilon_neighborhood tests
+(oracle: exact brute force, recall thresholds as in NEIGHBORS_TEST)."""
+import numpy as np
+import pytest
+
+from ann_utils import calc_recall, naive_knn
+from raft_tpu.neighbors import ball_cover, cagra, hnsw
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(17)
+    return rng.standard_normal((4_000, 24)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def queries():
+    rng = np.random.default_rng(18)
+    return rng.standard_normal((60, 24)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def oracle(dataset, queries):
+    return naive_knn(dataset, queries, 10)
+
+
+@pytest.fixture(scope="module")
+def cagra_index(dataset):
+    return cagra.build(dataset, cagra.IndexParams(
+        intermediate_graph_degree=48, graph_degree=24, seed=0))
+
+
+class TestHnsw:
+    def test_recall(self, cagra_index, queries, oracle):
+        h = hnsw.from_cagra(cagra_index)
+        d, i = hnsw.search(h, queries, 10, ef=96)
+        _, want = oracle
+        r = calc_recall(i, want)
+        assert r >= 0.9, f"hnsw recall {r}"
+        assert (i >= 0).all()
+
+    def test_ef_improves_recall(self, cagra_index, queries, oracle):
+        h = hnsw.from_cagra(cagra_index)
+        _, want = oracle
+        _, i_lo = hnsw.search(h, queries, 10, ef=16)
+        _, i_hi = hnsw.search(h, queries, 10, ef=128)
+        assert calc_recall(i_hi, want) >= calc_recall(i_lo, want)
+
+    def test_save_load_roundtrip(self, cagra_index, queries, tmp_path):
+        h = hnsw.from_cagra(cagra_index)
+        hnsw.save(h, tmp_path / "h.bin")
+        h2 = hnsw.load(tmp_path / "h.bin")
+        d1, i1 = hnsw.search(h, queries[:5], 5)
+        d2, i2 = hnsw.search(h2, queries[:5], 5)
+        np.testing.assert_array_equal(i1, i2)
+
+    def test_distances_are_exact(self, cagra_index, dataset, queries):
+        h = hnsw.from_cagra(cagra_index)
+        d, i = hnsw.search(h, queries[:3], 5)
+        for r in range(3):
+            want = ((dataset[i[r]] - queries[r]) ** 2).sum(1)
+            np.testing.assert_allclose(d[r], want, rtol=1e-4)
+
+
+class TestBallCover:
+    def test_exact_knn(self, dataset, queries, oracle):
+        index = ball_cover.build(dataset)
+        d, i = ball_cover.knn(index, queries, 10)
+        _, want = oracle
+        assert calc_recall(np.asarray(i), want) == 1.0
+
+    def test_probe_mode_recall_rises(self, dataset, queries, oracle):
+        index = ball_cover.build(dataset, n_landmarks=64)
+        _, want = oracle
+        _, i_lo = ball_cover.knn(index, queries, 10, n_probes=2)
+        _, i_hi = ball_cover.knn(index, queries, 10, n_probes=32)
+        r_lo = calc_recall(np.asarray(i_lo), want)
+        r_hi = calc_recall(np.asarray(i_hi), want)
+        assert r_hi >= max(r_lo, 0.9)
+
+    def test_eps_nn_matches_dense(self, dataset, queries):
+        index = ball_cover.build(dataset, n_landmarks=32)
+        eps = 5.5
+        adj, vd = ball_cover.eps_nn(index, queries, eps)
+        want_adj, want_vd = ball_cover.epsilon_neighborhood(
+            queries, dataset, eps)
+        np.testing.assert_array_equal(np.asarray(adj), np.asarray(want_adj))
+        np.testing.assert_array_equal(np.asarray(vd), np.asarray(want_vd))
+        assert int(np.asarray(vd).sum()) > 0  # eps chosen to be non-trivial
+
+    def test_radii_cover_members(self, dataset):
+        index = ball_cover.build(dataset, n_landmarks=16)
+        labels = np.repeat(np.arange(index.ivf.n_lists),
+                           index.ivf.list_sizes)
+        d = np.sqrt(((np.asarray(index.ivf.data) -
+                      np.asarray(index.ivf.centers)[labels]) ** 2).sum(1))
+        assert (d <= np.asarray(index.radii)[labels] + 1e-4).all()

@@ -47,6 +47,21 @@ def test_fused_knn_penalty_excludes_rows():
     assert np.all(np.isfinite(np.asarray(v)))
 
 
+def test_fused_knn_sparse_survivors_across_tiles():
+    """<k unmasked rows spread over multiple tiles: unfilled slots must be
+    -1/inf, never a duplicated real id (regression: the inf tie-scan used
+    to re-emit column 0's retired id)."""
+    rng = np.random.default_rng(9)
+    q = rng.standard_normal((4, 64), dtype=np.float32)
+    x = rng.standard_normal((2048, 64), dtype=np.float32)
+    pen = np.full(2048, np.inf, np.float32)
+    pen[[10, 1500]] = 0.0
+    v, i = fused_knn(q, x, 3, penalty=pen, interpret=True)
+    v, i = np.asarray(v), np.asarray(i)
+    assert set(i[:, :2].ravel()) == {10, 1500}
+    assert np.all(i[:, 2] == -1) and np.all(np.isinf(v[:, 2]))
+
+
 def test_fused_knn_k_exceeds_valid_rows():
     """More requested neighbors than admissible rows → +inf / -1 padding."""
     rng = np.random.default_rng(4)

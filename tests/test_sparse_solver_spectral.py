@@ -1,0 +1,224 @@
+"""Sparse subsystem + solver (LAP, MST, Lanczos) + single_linkage + label
++ spectral tests. Oracles: scipy/sklearn."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from raft_tpu.sparse import (COO, CSR, degree, knn_graph, lanczos_smallest,
+                             mst, row_norm, sddmm,
+                             sparse_brute_force_knn,
+                             sparse_pairwise_distance, spmm, symmetrize,
+                             transpose)
+
+
+@pytest.fixture(scope="module")
+def rand_sparse():
+    rng = np.random.default_rng(0)
+    d = rng.random((60, 40)).astype(np.float32)
+    d[d < 0.7] = 0
+    return d
+
+
+class TestContainers:
+    def test_roundtrips(self, rand_sparse):
+        c = COO.from_dense(rand_sparse)
+        np.testing.assert_allclose(np.asarray(c.to_dense()), rand_sparse)
+        csr = c.to_csr()
+        np.testing.assert_allclose(np.asarray(csr.to_dense()), rand_sparse)
+        back = csr.to_coo().to_dense()
+        np.testing.assert_allclose(np.asarray(back), rand_sparse)
+
+    def test_from_scipy(self, rand_sparse):
+        m = sp.csr_matrix(rand_sparse)
+        csr = CSR.from_scipy(m)
+        np.testing.assert_allclose(np.asarray(csr.to_dense()), rand_sparse)
+
+    def test_slice_rows(self, rand_sparse):
+        csr = CSR.from_dense(rand_sparse)
+        s = csr.slice_rows(10, 30)
+        np.testing.assert_allclose(np.asarray(s.to_dense()),
+                                   rand_sparse[10:30])
+
+
+class TestLinalg:
+    def test_degree_norm(self, rand_sparse):
+        csr = CSR.from_dense(rand_sparse)
+        np.testing.assert_array_equal(np.asarray(degree(csr)),
+                                      (rand_sparse != 0).sum(1))
+        np.testing.assert_allclose(np.asarray(row_norm(csr, "l2")),
+                                   (rand_sparse ** 2).sum(1), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(row_norm(csr, "l1")),
+                                   np.abs(rand_sparse).sum(1), rtol=1e-5)
+
+    def test_spmm_transpose(self, rand_sparse):
+        csr = CSR.from_dense(rand_sparse)
+        b = np.random.default_rng(1).random((40, 8)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(spmm(csr, b)),
+                                   rand_sparse @ b, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(transpose(csr).to_dense()),
+                                   rand_sparse.T)
+
+    def test_sddmm(self):
+        rng = np.random.default_rng(2)
+        a = rng.random((10, 6)).astype(np.float32)
+        b = rng.random((6, 12)).astype(np.float32)
+        mask = (rng.random((10, 12)) < 0.3).astype(np.float32)
+        out = sddmm(a, b, COO.from_dense(mask))
+        want = (a @ b) * (mask != 0)
+        np.testing.assert_allclose(np.asarray(out.to_dense()), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_symmetrize(self):
+        d = np.array([[0, 3, 0], [1, 0, 0], [0, 5, 0]], np.float32)
+        s = symmetrize(COO.from_dense(d), op="max")
+        want = np.maximum(d, d.T)
+        np.testing.assert_allclose(np.asarray(s.to_dense()), want)
+
+
+class TestSparseDistance:
+    @pytest.mark.parametrize("metric", ["sqeuclidean", "cosine",
+                                        "inner_product", "l1"])
+    def test_matches_dense(self, rand_sparse, metric):
+        from raft_tpu.distance.pairwise import pairwise_distance as dense_pd
+
+        x = CSR.from_dense(rand_sparse[:20])
+        y = CSR.from_dense(rand_sparse[20:])
+        got = sparse_pairwise_distance(x, y, metric)
+        want = dense_pd(rand_sparse[:20], rand_sparse[20:], metric)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_jaccard(self):
+        x = np.array([[1, 1, 0, 0], [1, 0, 1, 0]], np.float32)
+        got = np.asarray(sparse_pairwise_distance(
+            CSR.from_dense(x), CSR.from_dense(x), "jaccard"))
+        assert got[0, 0] == 0
+        np.testing.assert_allclose(got[0, 1], 1 - 1 / 3, rtol=1e-6)
+
+    def test_sparse_knn(self, rand_sparse):
+        x = CSR.from_dense(rand_sparse)
+        d, i = sparse_brute_force_knn(x, x, 5)
+        # self is nearest with distance 0
+        np.testing.assert_array_equal(np.asarray(i)[:, 0],
+                                      np.arange(rand_sparse.shape[0]))
+
+    def test_knn_graph_symmetric(self, rand_sparse):
+        g = knn_graph(CSR.from_dense(rand_sparse), 4)
+        dense = np.asarray(g.to_dense())
+        np.testing.assert_allclose(dense, dense.T)
+
+
+class TestMst:
+    def test_matches_scipy(self):
+        from scipy.sparse.csgraph import minimum_spanning_tree
+
+        rng = np.random.default_rng(3)
+        g = rng.random((50, 50))
+        g = (g + g.T) / 2
+        g[g > 0.4] = 0
+        np.fill_diagonal(g, 0)
+        s, d, w = mst(COO.from_dense(g))
+        want = minimum_spanning_tree(sp.csr_matrix(g)).sum()
+        np.testing.assert_allclose(w.sum(), want, rtol=1e-5)
+
+    def test_forest_on_disconnected(self):
+        g = np.zeros((6, 6), np.float32)
+        g[0, 1] = g[1, 0] = 1.0
+        g[2, 3] = g[3, 2] = 2.0
+        g[4, 5] = g[5, 4] = 3.0
+        s, d, w = mst(COO.from_dense(g))
+        assert len(w) == 3
+
+
+class TestLanczos:
+    def test_smallest_eigs(self):
+        rng = np.random.default_rng(4)
+        a = rng.random((40, 40))
+        a = (a + a.T) / 2
+        a[np.abs(a) < 0.4] = 0
+        np.fill_diagonal(a, np.abs(a).sum(1) + 1)   # make it PD-ish sparse
+        vals, vecs = lanczos_smallest(COO.from_dense(a), 3)
+        want = np.sort(np.linalg.eigvalsh(a))[:3]
+        np.testing.assert_allclose(np.asarray(vals), want, rtol=1e-3,
+                                   atol=1e-3)
+        # residuals ||Av - λv|| small
+        for j in range(3):
+            v = np.asarray(vecs)[:, j]
+            r = a @ v - float(vals[j]) * v
+            assert np.linalg.norm(r) < 1e-2
+
+
+class TestSingleLinkage:
+    def test_matches_scipy_labels(self):
+        from scipy.cluster.hierarchy import fcluster, linkage
+
+        from raft_tpu.cluster import single_linkage
+
+        rng = np.random.default_rng(5)
+        x = np.concatenate([
+            rng.standard_normal((30, 4)) + 8,
+            rng.standard_normal((30, 4)) - 8,
+            rng.standard_normal((30, 4)),
+        ]).astype(np.float32)
+        out = single_linkage(x, n_clusters=3, c=20)
+        want = fcluster(linkage(x, method="single"), 3, criterion="maxclust")
+        # same partition up to label permutation
+        from raft_tpu import stats
+        ari = float(stats.adjusted_rand_index(out.labels, want - 1, 90))
+        assert ari == pytest.approx(1.0)
+
+    def test_dendrogram_shape(self):
+        from raft_tpu.cluster import single_linkage
+
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal((25, 3)).astype(np.float32)
+        out = single_linkage(x, n_clusters=4, c=24)
+        assert out.children.shape == (24, 2)
+        assert (np.diff(out.deltas) >= -1e-6).all()   # ascending merges
+        assert len(np.unique(out.labels)) == 4
+
+
+class TestLabel:
+    def test_make_monotonic(self):
+        from raft_tpu.label import get_unique_labels, make_monotonic
+
+        l = np.array([10, 30, 10, 20, 30])
+        out, n = make_monotonic(l)
+        np.testing.assert_array_equal(np.asarray(out), [0, 2, 0, 1, 2])
+        assert n == 3
+        np.testing.assert_array_equal(np.asarray(get_unique_labels(l)),
+                                      [10, 20, 30])
+
+    def test_merge_labels(self):
+        from raft_tpu.label import merge_labels
+
+        a = np.array([0, 0, 1, 1, 2])
+        b = np.array([5, 6, 6, 7, 8])
+        mask = np.array([True, True, True, False, False])
+        # b connects label 0 and 1 through shared b-label 6
+        out = np.asarray(merge_labels(a, b, mask))
+        assert out[0] == out[1] == out[2] == out[3]
+        assert out[4] != out[0]
+
+
+class TestSpectral:
+    def test_partition_two_blobs(self):
+        from raft_tpu.spectral import analyze_partition, partition
+        from raft_tpu.sparse import CSR, knn_graph
+
+        rng = np.random.default_rng(7)
+        x = np.concatenate([rng.standard_normal((40, 5)) + 10,
+                            rng.standard_normal((40, 5)) - 10])
+        g = knn_graph(CSR.from_dense(x.astype(np.float32)), 6)
+        # similarity weights (spectral wants affinity, not distance)
+        from raft_tpu.sparse import COO
+        aff = COO(g.rows, g.cols,
+                  jnp.exp(-jnp.asarray(g.vals) / 10.0), g.shape)
+        labels, vals, emb = partition(aff, 2)
+        want = np.array([0] * 40 + [1] * 40)
+        from raft_tpu import stats
+        ari = float(stats.adjusted_rand_index(labels, want, 2))
+        assert ari == pytest.approx(1.0)
+        cut, cost = analyze_partition(aff, labels)
+        assert cut < 1.0  # blobs are far apart → near-zero cut
