@@ -95,8 +95,15 @@ def load_dataset(
             queries = np.asarray(f["test"], np.float32)
             gt = (np.asarray(f["neighbors"], np.int32)
                   if "neighbors" in f else None)
-        metric = ("inner_product" if name.endswith(("-angular", "-dot"))
-                  else "sqeuclidean")
+        # ann-benchmarks conventions: -angular ground truth is cosine
+        # distance (NOT raw dot product — unnormalized vectors rank
+        # differently); -dot is inner product
+        if name.endswith("-angular"):
+            metric = "cosine"
+        elif name.endswith("-dot"):
+            metric = "inner_product"
+        else:
+            metric = "sqeuclidean"
         return base, queries, gt, metric
 
     d = Path(dataset_dir) / name

@@ -136,9 +136,15 @@ def load(path) -> Index:
 
 
 def to_hnswlib(index: Index):
-    """Hand the graph to a real hnswlib index when the package exists
-    (bit-parity with the reference's serving stack); raises ImportError
-    otherwise — the in-tree `search` needs nothing external."""
+    """Build a fresh hnswlib index over the same dataset (convenience
+    bridge when the optional package exists; raises ImportError otherwise).
+
+    NOTE: hnswlib's Python API offers no way to transplant an external
+    base-layer graph, so this REBUILDS with hnswlib's own construction —
+    the CAGRA graph is not carried over. The faithful base-layer search
+    over the exported CAGRA graph is the in-tree ``search`` above (the
+    reference's serialize_to_hnswlib graph handover needs hnswlib's C++
+    internals, which aren't reachable from Python)."""
     import hnswlib  # noqa: F401 — optional dependency
 
     space = ("ip" if index.metric is DistanceType.InnerProduct

@@ -214,6 +214,46 @@ def _candidate_rows(probed_lists, offsets_j, sizes_j, max_rows):
     return rows, valid, probe_of
 
 
+_PALLAS_METRICS = {
+    DistanceType.L2Expanded: "l2",
+    DistanceType.L2SqrtExpanded: "l2",
+    DistanceType.CosineExpanded: "cos",
+    DistanceType.InnerProduct: "ip",
+}
+
+
+def _search_pallas(index, q, k, n_probes, offsets_j, sizes_j, precision):
+    """Fused query-grouped list scan (the TPU perf path; ops/ivf_scan.py)."""
+    from ..ops import fused_knn
+    from ..ops.ivf_scan import _ivf_flat_scan_jit, pad_for_scan
+
+    mt = index.metric
+    # coarse stage through the fused kernel too: the select_k fallback is a
+    # full n_lists-wide sort per query, which dominates the whole search
+    _, probed = fused_knn(q, index.centers, n_probes,
+                          metric=_PALLAS_METRICS[mt],
+                          data_norms=index.center_norms,
+                          precision=precision)
+    lmax = int(index.list_sizes.max())
+    # the aligned-DMA padding copies the dataset: do it once per index
+    cache = getattr(index, "_scan_pad", None)
+    if cache is None or cache[0] != lmax:
+        cache = (lmax, *pad_for_scan(index.data, index.data_norms, lmax))
+        index._scan_pad = cache
+    interpret = jax.default_backend() != "tpu"
+    vals, rows = _ivf_flat_scan_jit(cache[1], cache[2], probed,
+                                    offsets_j, sizes_j, q, k, lmax,
+                                    _PALLAS_METRICS[mt], interpret,
+                                    precision)
+    ids = jnp.where(rows >= 0,
+                    jnp.take(index.source_ids, jnp.maximum(rows, 0)), -1)
+    if mt is DistanceType.L2SqrtExpanded:
+        vals = jnp.sqrt(jnp.maximum(vals, 0.0))
+    elif mt is DistanceType.InnerProduct:
+        vals = jnp.where(jnp.isfinite(vals), -vals, -jnp.inf)
+    return vals, ids
+
+
 @tracing.annotate("raft_tpu::ivf_flat::search")
 def search(
     index: Index,
@@ -222,9 +262,16 @@ def search(
     params: SearchParams | None = None,
     filter: Optional[Bitset] = None,  # noqa: A002
     query_chunk: int = 0,
+    algo: str = "auto",
+    precision: str = "highest",
 ) -> Tuple[jax.Array, jax.Array]:
     """Probe the n_probes nearest lists per query and return exact top-k over
-    their members → (distances (m, k), indices (m, k)) with original ids."""
+    their members → (distances (m, k), indices (m, k)) with original ids.
+
+    ``algo``: "pallas" (fused query-grouped list scan — the TPU perf path,
+    role of the interleaved-scan kernel), "xla" (gather-based composed-XLA
+    path; required for ``filter``), "auto" (pallas on TPU when no filter).
+    """
     p = params or SearchParams()
     q = jnp.asarray(queries, jnp.float32)
     expects(q.ndim == 2 and q.shape[1] == index.dim, "bad query shape %s", q.shape)
@@ -232,15 +279,41 @@ def search(
     n_probes = min(p.n_probes, index.n_lists)
     mt = index.metric
 
+    offsets_j = jnp.asarray(index.list_offsets[:-1], jnp.int32)
     sizes_np = index.list_sizes
+    sizes_j = jnp.asarray(sizes_np, jnp.int32)
+
+    use_pallas = (algo == "pallas" or
+                  (algo == "auto" and filter is None and
+                   mt in _PALLAS_METRICS and
+                   jax.default_backend() == "tpu"))
+    if use_pallas:
+        expects(filter is None, "algo='pallas' does not take a filter")
+        expects(mt in _PALLAS_METRICS, "metric %s unsupported by pallas",
+                mt.name)
+        dim_pad = -(-index.dim // 128) * 128
+        if query_chunk <= 0:
+            # bound the (pairs × dim) query blocks to ~256 MB
+            per_q = n_probes * dim_pad * 4
+            query_chunk = max(1, min(q.shape[0],
+                                     (256 << 20) // max(per_q, 1)))
+        outs_d, outs_i = [], []
+        for c0 in range(0, q.shape[0], query_chunk):
+            d_c, i_c = _search_pallas(index, q[c0 : c0 + query_chunk], k,
+                                      n_probes, offsets_j, sizes_j,
+                                      precision)
+            outs_d.append(d_c)
+            outs_i.append(i_c)
+        if len(outs_d) == 1:
+            return outs_d[0], outs_i[0]
+        return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+
     max_rows = _probe_budget(sizes_np, n_probes)
     if query_chunk <= 0:
         # bound gathered candidates to ~256 MB
         per_q = max_rows * index.dim * 4
         query_chunk = max(1, min(q.shape[0], (256 << 20) // max(per_q, 1)))
 
-    offsets_j = jnp.asarray(index.list_offsets[:-1], jnp.int32)
-    sizes_j = jnp.asarray(sizes_np, jnp.int32)
     mask_bits = filter.to_mask() if filter is not None else None
 
     outs_d, outs_i = [], []

@@ -369,6 +369,50 @@ def extend(index: Index, new_vectors, new_ids=None,
                  index.pq_bits, index.codebook_kind)
 
 
+def _search_pallas(index: Index, q, k, n_probes, lut_dtype, precision):
+    """Fused query-grouped PQ scan (ops/ivf_pq_scan.py) — the TPU perf
+    path (expanded-form LUT + one-hot GEMM scoring)."""
+    from ..ops import fused_knn
+    from ..ops.ivf_pq_scan import (_ivf_pq_scan_jit, decoded_row_norms,
+                                   make_cb_matrix, pad_codes_for_scan)
+
+    mt = index.metric
+    lmax = int(index.list_sizes.max())
+    # per-index prep (row norms, CB matrix, aligned-DMA padding): all are
+    # full passes over the compressed dataset — cache, don't redo per call
+    cache = getattr(index, "_scan_cache", None)
+    if cache is None or cache["n"] != index.size or cache["lmax"] != lmax:
+        rn = decoded_row_norms(index.codes, index.centers_rot,
+                               index.codebooks, index.list_offsets)
+        codes_p, norms_p = pad_codes_for_scan(index.codes, rn, lmax,
+                                              index.pq_dim)
+        cache = {"n": index.size, "lmax": lmax, "codes_p": codes_p,
+                 "norms_p": norms_p, "cbm": make_cb_matrix(index.codebooks)}
+        index._scan_cache = cache
+
+    q_rot = hdot(q, index.rotation.T)
+    coarse_metric = "ip" if mt is DistanceType.InnerProduct else "l2"
+    _, probed = fused_knn(q_rot, index.centers_rot, n_probes,
+                          metric=coarse_metric, precision=precision)
+    lut_bf16 = jnp.dtype(lut_dtype) != jnp.float32
+    interpret = jax.default_backend() != "tpu"
+    vals, rows = _ivf_pq_scan_jit(
+        cache["codes_p"], cache["norms_p"], index.centers_rot,
+        cache["cbm"], probed,
+        jnp.asarray(index.list_offsets[:-1], jnp.int32),
+        jnp.asarray(index.list_sizes, jnp.int32), q_rot, k, lmax,
+        index.pq_dim, index.pq_book_size,
+        "ip" if mt is DistanceType.InnerProduct else "l2",
+        lut_bf16, interpret, precision)
+    ids = jnp.where(rows >= 0,
+                    jnp.take(index.source_ids, jnp.maximum(rows, 0)), -1)
+    if mt is DistanceType.L2SqrtExpanded:
+        vals = jnp.sqrt(jnp.maximum(vals, 0.0))
+    elif mt is DistanceType.InnerProduct:
+        vals = jnp.where(jnp.isfinite(vals), -vals, -jnp.inf)
+    return vals, ids
+
+
 @tracing.annotate("raft_tpu::ivf_pq::search")
 def search(
     index: Index,
@@ -377,14 +421,43 @@ def search(
     params: SearchParams | None = None,
     filter: Optional[Bitset] = None,  # noqa: A002
     query_chunk: int = 0,
+    algo: str = "auto",
+    precision: str = "highest",
 ) -> Tuple[jax.Array, jax.Array]:
-    """LUT-based approximate top-k (detail/ivf_pq_search.cuh:731)."""
+    """LUT-based approximate top-k (detail/ivf_pq_search.cuh:731).
+
+    ``algo``: "pallas" (fused query-grouped PQ scan — the TPU perf path;
+    PER_SUBSPACE codebooks, no filter), "xla" (gather path, any config),
+    "auto" (pallas on TPU when eligible).
+    """
     p = params or SearchParams()
     q = jnp.asarray(queries, jnp.float32)
     expects(q.ndim == 2 and q.shape[1] == index.dim, "bad query shape %s",
             tuple(q.shape))
     expects(index.size > 0, "index is empty")
     n_probes = min(p.n_probes, index.n_lists)
+
+    use_pallas = (algo == "pallas" or
+                  (algo == "auto" and filter is None and
+                   index.codebook_kind is CodebookGen.PER_SUBSPACE and
+                   jax.default_backend() == "tpu"))
+    if use_pallas:
+        expects(filter is None, "algo='pallas' does not take a filter")
+        expects(index.codebook_kind is CodebookGen.PER_SUBSPACE,
+                "algo='pallas' needs PER_SUBSPACE codebooks")
+        if query_chunk <= 0:
+            per_q = n_probes * index.rot_dim * 4 * 2
+            query_chunk = max(1, min(q.shape[0],
+                                     (256 << 20) // max(per_q, 1)))
+        outs_d, outs_i = [], []
+        for c0 in range(0, q.shape[0], query_chunk):
+            d_c, i_c = _search_pallas(index, q[c0 : c0 + query_chunk], k,
+                                      n_probes, p.lut_dtype, precision)
+            outs_d.append(d_c)
+            outs_i.append(i_c)
+        if len(outs_d) == 1:
+            return outs_d[0], outs_i[0]
+        return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
 
     sizes_np = index.list_sizes
     max_rows = _probe_budget(sizes_np, n_probes)

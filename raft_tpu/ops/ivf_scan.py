@@ -1,0 +1,265 @@
+"""Query-grouped IVF-Flat list scan — the fused interleaved-scan analog.
+
+Reference role: neighbors/detail/ivf_flat_interleaved_scan-inl.cuh:1085
+(fused per-list scan + top-k) — on GPU each CTA walks one (query, probe)
+pair's list. A TPU grid step wants a dense MXU tile instead, so the
+mapping is inverted: (query, probe) pairs are sorted by list id and
+packed into fixed-size *query groups per list*; each grid step DMAs one
+list's contiguous row range (the cluster-sorted layout makes every probe
+a dense slice — no per-row gathers) and scores a (group × list) block on
+the MXU, extracting the per-pair top-k in VMEM. A final XLA select_k
+merges each query's probe results.
+
+The pair grouping itself is all XLA sorts/cumsums on device; nothing
+host-side touches per-query data. List offsets are arbitrary: the DMA
+start is rounded down to the sublane multiple and the window masked.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..utils import cdiv, round_up_to
+
+__all__ = ["ivf_flat_scan"]
+
+_QG = 128            # queries per group (MXU-height tile)
+_INT_BIG = 2**30
+
+
+def pack_pairs(probed: jax.Array, n_lists: int):
+    """Pack the (query, probe) pairs into per-list groups of _QG queries.
+
+    → (qtable (G, QG) query ids, glist (G,) list per group, galive (G,),
+    flat (mp,) output slot per sorted pair, order (mp,) pair sort, G).
+    Shared by the IVF-Flat and IVF-PQ scan kernels.
+    """
+    m, p = probed.shape
+    lids = probed.reshape(-1)                       # (mp,)
+    qids = jnp.repeat(jnp.arange(m, dtype=jnp.int32), p)
+    order = jnp.argsort(lids, stable=True)
+    slids, sqids = lids[order], qids[order]
+    counts = jnp.zeros((n_lists,), jnp.int32).at[slids].add(1)
+    starts = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                              jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+    pos = jnp.arange(m * p, dtype=jnp.int32) - starts[slids]
+    gcounts = -(-counts // _QG)                     # cdiv per list
+    gbase = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                             jnp.cumsum(gcounts)[:-1].astype(jnp.int32)])
+    gid = gbase[slids] + pos // _QG
+    lane = pos % _QG
+    n_groups = cdiv(m * p, _QG) + n_lists           # static bound
+
+    flat = gid * _QG + lane
+    qtable = jnp.zeros((n_groups * _QG,), jnp.int32).at[flat].set(
+        sqids, mode="drop").reshape(n_groups, _QG)
+    glist = jnp.zeros((n_groups,), jnp.int32).at[gid].set(
+        slids, mode="drop")
+    galive = jnp.zeros((n_groups,), bool).at[gid].max(True, mode="drop")
+    return qtable, glist, galive, flat, order, n_groups
+
+
+def merge_pairs(gv, gi, flat, order, m: int, p: int, k: int):
+    """Per-pair (G, QG, kp) kernel outputs → per-query final top-k."""
+    from ..matrix.select_k import select_k
+
+    n_slots = gv.shape[0] * gv.shape[1]
+    gv = gv[:, :, :k].reshape(n_slots, k)
+    gi = gi[:, :, :k].reshape(n_slots, k)
+    inv = jnp.argsort(order)
+    pair_v = gv[flat][inv].reshape(m, p * k)
+    pair_i = gi[flat][inv].reshape(m, p * k)
+    out_v, sel = select_k(pair_v, k, select_min=True)
+    out_i = jnp.take_along_axis(pair_i, sel, axis=1)
+    return out_v, jnp.where(jnp.isfinite(out_v), out_i, -1)
+
+
+def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, data_ref,
+            ov_ref, oi_ref, rows_vmem, sem,
+            *, k: int, kp: int, lmax: int, metric: str, precision: str):
+    g = pl.program_id(0)
+    off = offs_ref[g]
+    size = sizes_ref[g]
+    off_al = (off // 8) * 8
+    extra = off - off_al
+
+    # DMA this group's list rows: one contiguous, sublane-aligned range
+    copy = pltpu.make_async_copy(
+        data_ref.at[pl.ds(off_al, lmax), :], rows_vmem, sem)
+    copy.start()
+    q = qb_ref[0]                                   # (QG, dim_pad)
+    qn = qn_ref[0]                                  # (QG, 1)
+    copy.wait()
+    rows = rows_vmem[:]                             # (lmax, dim_pad)
+
+    dot = jax.lax.dot_general(q, rows, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32,
+                              precision=jax.lax.Precision(precision))
+    if metric == "l2":
+        dist = jnp.maximum(qn + dn_ref[0, 0] - 2.0 * dot, 0.0)
+    elif metric == "cos":
+        dist = 1.0 - dot / jnp.maximum(qn * dn_ref[0, 0], 1e-30)
+    else:                                           # "ip"
+        dist = -dot
+    col = jax.lax.broadcasted_iota(jnp.int32, (_QG, lmax), 1)
+    dist = jnp.where((col >= extra) & (col < extra + size), dist, jnp.inf)
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (_QG, kp), 1)
+
+    def extract(t, state):
+        c, nv, ni = state
+        best = jnp.min(c, axis=1, keepdims=True)
+        pos = jnp.min(jnp.where(c <= best, col, _INT_BIG), axis=1,
+                      keepdims=True)
+        at = col == pos
+        bid = jnp.where(jnp.isfinite(best), off_al + pos, -1)
+        nv = jnp.where(lane == t, best, nv)
+        ni = jnp.where(lane == t, bid, ni)
+        return jnp.where(at, jnp.inf, c), nv, ni
+
+    state = (dist, jnp.full((_QG, kp), jnp.inf, jnp.float32),
+             jnp.full((_QG, kp), -1, jnp.int32))
+    if k <= 16:
+        for t in range(k):
+            state = extract(t, state)
+    else:
+        state = jax.lax.fori_loop(0, k, extract, state)
+    ov_ref[0] = state[1]
+    oi_ref[0] = state[2]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "lmax", "n_groups", "metric", "interpret",
+                     "precision"))
+def _scan_groups(qblocks, qnorms, dnorm_slices, data, goffs, gsizes,
+                 k: int, lmax: int, n_groups: int, metric: str,
+                 interpret: bool, precision: str):
+    kp = round_up_to(k, 128)
+    dim_pad = qblocks.shape[2]
+    kern = functools.partial(_kernel, k=k, kp=kp, lmax=lmax,
+                             metric=metric, precision=precision)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_groups,),
+        in_specs=[
+            pl.BlockSpec((1, _QG, dim_pad), lambda g, o, s: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _QG, 1), lambda g, o, s: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, lmax), lambda g, o, s: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),      # data stays in HBM
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _QG, kp), lambda g, o, s: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _QG, kp), lambda g, o, s: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((lmax, dim_pad), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((n_groups, _QG, kp), jnp.float32),
+            jax.ShapeDtypeStruct((n_groups, _QG, kp), jnp.int32),
+        ],
+        interpret=interpret,
+    )(goffs, gsizes, qblocks, qnorms, dnorm_slices, data)
+
+
+def ivf_flat_scan(
+    data: jax.Array,            # (n, dim) cluster-sorted
+    data_norms: jax.Array,      # (n,) squared L2 norms
+    probed: jax.Array,          # (m, p) probed list ids
+    offsets: jax.Array,         # (n_lists,) row offsets (arbitrary)
+    sizes: jax.Array,           # (n_lists,) list sizes
+    queries: jax.Array,         # (m, dim)
+    k: int,
+    lmax: int,                  # static bound: max list size (unaligned)
+    metric: str = "l2",
+    interpret: Optional[bool] = None,
+    precision: str = "highest",
+) -> Tuple[jax.Array, jax.Array]:
+    """Scan probed lists → per-query k best (values, ROW ids into ``data``'s
+    sorted order, -1 when fewer than k candidates); caller maps row ids to
+    source ids and applies metric postprocessing.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    data_p, norms_p = pad_for_scan(data, data_norms, lmax)
+    return _ivf_flat_scan_jit(data_p, norms_p, probed, offsets, sizes,
+                              queries, k, lmax, metric, interpret, precision)
+
+
+def scan_window(lmax: int) -> int:
+    """DMA window: max list + up-to-8 alignment slack, rounded to the
+    128-lane tile so (1, window) norm blocks lower cleanly."""
+    return round_up_to(lmax + 8, 128)
+
+
+@functools.partial(jax.jit, static_argnames=("lmax",))
+def pad_for_scan(data, data_norms, lmax: int):
+    """Row/col-pad the dataset for the scan kernel's aligned DMA windows.
+
+    A full-dataset copy — call once per index (callers cache the result),
+    not per search."""
+    lmax_pad = scan_window(lmax)
+    dim_pad = round_up_to(data.shape[1], 128)
+    data_p = jnp.pad(jnp.asarray(data, jnp.float32),
+                     ((0, lmax_pad), (0, dim_pad - data.shape[1])))
+    norms_p = jnp.pad(jnp.asarray(data_norms, jnp.float32), (0, lmax_pad))
+    return data_p, norms_p
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "lmax", "metric", "interpret", "precision"))
+def _ivf_flat_scan_jit(data_p, norms_p, probed, offsets, sizes, queries,
+                       k: int, lmax: int, metric: str, interpret: bool,
+                       precision: str):
+    # one jit over grouping + kernel + merge: the grouping chain is ~20
+    # gather/sort ops over ~100 MB intermediates, far too hot to dispatch
+    # eagerly per op
+    m, p = probed.shape
+    n_lists = offsets.shape[0]
+    lmax_pad = scan_window(lmax)
+    dim_pad = data_p.shape[1]
+    dim = queries.shape[1]
+    q = jnp.pad(jnp.asarray(queries, jnp.float32),
+                ((0, 0), (0, dim_pad - dim)))
+
+    qtable, glist, galive, flat, order, n_groups = pack_pairs(probed,
+                                                              n_lists)
+
+    qblocks = q[qtable]                             # (G, QG, dim_pad)
+    sq = jnp.sum(qblocks * qblocks, axis=2, keepdims=True)
+    qn = sq if metric == "l2" else jnp.sqrt(jnp.maximum(sq, 1e-30))
+    goffs = offsets[glist]
+    gsizes = jnp.where(galive, sizes[glist], 0)
+
+    # per-group norm windows, matching the kernel's down-aligned DMA
+    goffs_al = (goffs // 8) * 8
+    dn = jax.vmap(lambda o: jax.lax.dynamic_slice(
+        norms_p, (o,), (lmax_pad,)))(goffs_al)
+    if metric == "cos":
+        dn = jnp.sqrt(jnp.maximum(dn, 1e-30))
+    dn = dn[:, None, :]                             # (G, 1, L): TPU block
+                                                    # rule wants full minors
+
+    gv, gi = _scan_groups(qblocks, qn, dn, data_p, goffs, gsizes, k,
+                          lmax_pad, int(n_groups), metric, interpret,
+                          precision)
+
+    return merge_pairs(gv, gi, flat, order, m, p, k)

@@ -16,10 +16,16 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# RAFT_TPU_TEST_LANE=1 keeps the real accelerator visible so `-m tpu`
+# tests compile on device; the default lane pins everything to the
+# 8-device virtual CPU mesh.
+_TPU_LANE = os.environ.get("RAFT_TPU_TEST_LANE", "") == "1"
+if not _TPU_LANE:
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
-assert jax.device_count() == 8, "tests expect the 8-device virtual CPU mesh"
+if not _TPU_LANE:
+    assert jax.device_count() == 8, "tests expect the 8-device virtual CPU mesh"
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -35,3 +41,17 @@ def res():
     from raft_tpu.core import Resources
 
     return Resources(seed=0)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Skip `tpu`-marked tests unless the TPU lane is active (and, in the
+    TPU lane, skip everything else — collectives expect the CPU mesh)."""
+    skip_tpu = pytest.mark.skip(reason="needs RAFT_TPU_TEST_LANE=1 + a TPU")
+    skip_cpu = pytest.mark.skip(reason="TPU lane runs only -m tpu tests")
+    on_tpu = _TPU_LANE and jax.default_backend() == "tpu"
+    for item in items:
+        is_tpu_test = "tpu" in item.keywords
+        if is_tpu_test and not on_tpu:
+            item.add_marker(skip_tpu)
+        elif not is_tpu_test and _TPU_LANE:
+            item.add_marker(skip_cpu)

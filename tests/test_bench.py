@@ -51,7 +51,8 @@ class TestIO:
         base, q, gt, metric = bench.load_dataset("toy-8-angular",
                                                  dataset_dir=str(tmp_path))
         assert base.shape == (100, 8) and gt.shape == (10, 5)
-        assert metric == "inner_product"
+        # ann-benchmarks "-angular" ground truth is cosine distance
+        assert metric == "cosine"
 
 
 class TestGroundTruth:

@@ -93,6 +93,61 @@ def test_brute_force_pallas_matches_scan(metric):
     assert agree > 0.99  # ties may order differently
 
 
+class TestIvfScanParity:
+    """CPU interpret-mode parity for the query-grouped IVF scan kernels —
+    the pallas paths must match the XLA gather paths bit-for-bit (flat)
+    / to equal quality (PQ) without TPU hardware in the loop."""
+
+    def test_ivf_flat_pallas_matches_xla(self):
+        from raft_tpu.neighbors import ivf_flat
+
+        rng = np.random.default_rng(21)
+        data = rng.standard_normal((2000, 40), dtype=np.float32)
+        q = rng.standard_normal((25, 40), dtype=np.float32)
+        for metric in ["sqeuclidean", "cosine", "inner_product"]:
+            index = ivf_flat.build(data, ivf_flat.IndexParams(
+                n_lists=16, metric=metric, seed=0))
+            dx, ix = ivf_flat.search(index, q, 8,
+                                     ivf_flat.SearchParams(n_probes=16),
+                                     algo="xla")
+            dp, ip = ivf_flat.search(index, q, 8,
+                                     ivf_flat.SearchParams(n_probes=16),
+                                     algo="pallas")
+            assert np.mean(np.asarray(ip) == np.asarray(ix)) > 0.99, metric
+            np.testing.assert_allclose(np.asarray(dp), np.asarray(dx),
+                                       rtol=1e-3, atol=1e-3)
+
+    def test_ivf_pq_pallas_matches_xla(self):
+        from raft_tpu.neighbors import ivf_pq
+
+        rng = np.random.default_rng(22)
+        data = rng.standard_normal((2000, 32), dtype=np.float32)
+        q = rng.standard_normal((25, 32), dtype=np.float32)
+        index = ivf_pq.build(data, ivf_pq.IndexParams(
+            n_lists=16, pq_dim=8, seed=0))
+        dx, ix = ivf_pq.search(index, q, 8,
+                               ivf_pq.SearchParams(n_probes=16), algo="xla")
+        dp, ip = ivf_pq.search(index, q, 8,
+                               ivf_pq.SearchParams(n_probes=16),
+                               algo="pallas")
+        assert np.mean(np.asarray(ip) == np.asarray(ix)) > 0.95
+
+    def test_ivf_flat_pallas_small_k_and_tail_lists(self):
+        """k larger than some list sizes + uneven lists: sentinel handling."""
+        from raft_tpu.neighbors import ivf_flat
+
+        rng = np.random.default_rng(23)
+        data = rng.standard_normal((300, 16), dtype=np.float32)
+        q = rng.standard_normal((10, 16), dtype=np.float32)
+        index = ivf_flat.build(data, ivf_flat.IndexParams(n_lists=12,
+                                                          seed=0))
+        d1, i1 = ivf_flat.search(index, q, 5,
+                                 ivf_flat.SearchParams(n_probes=1),
+                                 algo="pallas")
+        i1 = np.asarray(i1)
+        assert ((i1 >= -1) & (i1 < 300)).all()
+
+
 def test_brute_force_pallas_filter():
     from raft_tpu.core.bitset import Bitset
     from raft_tpu.neighbors import brute_force

@@ -1,0 +1,81 @@
+"""On-device smoke lane: jit-compile build+search for every index type on
+the real TPU chip (VERDICT: the CPU suite can't catch TPU-only lowering
+failures). Run with::
+
+    RAFT_TPU_TEST_LANE=1 python -m pytest tests/test_tpu_lane.py -m tpu -q
+
+Shapes are small — this lane is about compilation and numerical sanity on
+hardware, not performance.
+"""
+import numpy as np
+import pytest
+
+from ann_utils import calc_recall, naive_knn
+
+pytestmark = pytest.mark.tpu
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(0)
+    return rng.standard_normal((6_000, 64)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def queries():
+    rng = np.random.default_rng(1)
+    return rng.standard_normal((64, 64)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def oracle(dataset, queries):
+    _, want = naive_knn(dataset, queries, 10)
+    return want
+
+
+def test_brute_force_pallas_on_device(dataset, queries, oracle):
+    from raft_tpu.neighbors import brute_force
+
+    index = brute_force.build(dataset)
+    d, i = brute_force.search(index, queries, 10)   # auto → pallas on TPU
+    assert calc_recall(np.asarray(i), oracle) == 1.0
+
+
+def test_ivf_flat_on_device(dataset, queries, oracle):
+    from raft_tpu.neighbors import ivf_flat
+
+    index = ivf_flat.build(dataset, ivf_flat.IndexParams(n_lists=64, seed=0))
+    d, i = ivf_flat.search(index, queries, 10,
+                           ivf_flat.SearchParams(n_probes=64))
+    assert calc_recall(np.asarray(i), oracle) == 1.0  # full probes = exact
+
+
+def test_ivf_pq_on_device(dataset, queries, oracle):
+    from raft_tpu.neighbors import ivf_pq
+
+    index = ivf_pq.build(dataset, ivf_pq.IndexParams(
+        n_lists=64, pq_dim=16, seed=0))
+    d, i = ivf_pq.search(index, queries, 10, ivf_pq.SearchParams(n_probes=64))
+    r = calc_recall(np.asarray(i), oracle)
+    assert r >= 0.75, f"ivf_pq TPU recall {r}"
+
+
+def test_cagra_on_device(dataset, queries, oracle):
+    from raft_tpu.neighbors import cagra
+
+    index = cagra.build(dataset, cagra.IndexParams(
+        intermediate_graph_degree=48, graph_degree=24,
+        build_algo=cagra.BuildAlgo.NN_DESCENT, seed=0))
+    d, i = cagra.search(index, queries, 10,
+                        cagra.SearchParams(itopk_size=96))
+    r = calc_recall(np.asarray(i), oracle)
+    assert r >= 0.9, f"cagra TPU recall {r}"
+
+
+def test_kmeans_on_device(dataset):
+    from raft_tpu.cluster import kmeans_balanced
+
+    centers, labels = kmeans_balanced.fit_predict(dataset, 32)
+    assert centers.shape == (32, 64)
+    counts = np.bincount(np.asarray(labels), minlength=32)
+    assert (counts > 0).all()
