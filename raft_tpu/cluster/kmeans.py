@@ -206,3 +206,35 @@ def fit_mini_batch(x, params: KMeansParams):
     (centers, _), _ = jax.lax.scan(step, (c0, jnp.zeros((k,), jnp.float32)), keys)
     labels, d2 = fused_l2_nn_argmin(x, centers)
     return centers, jnp.sum(d2), steps
+
+
+def auto_find_k(x, k_min: int = 2, k_max: int = 20, tol: float = 0.1,
+                params: "KMeansParams | None" = None):
+    """Pick the cluster count automatically → (best_k, centroids, labels).
+
+    Analog of cluster/detail/kmeans_auto_find_k.cuh: sweep candidate k and
+    stop at the inertia elbow — the smallest k whose next increment stops
+    paying (relative inertia improvement < ``tol``). A spherical-gaussian
+    BIC over-rewards extra clusters on well-separated data, so the elbow
+    is the decision rule; the sweep keeps each k's fit so the winner's
+    centroids come for free.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    n, d = x.shape
+    expects(2 <= k_min <= k_max < n, "bad k range [%d, %d] for n=%d",
+            k_min, k_max, n)
+    base = params or KMeansParams(n_clusters=k_min)
+
+    prev = None                       # (k, centers, inertia)
+    best_k, centers = k_max, None
+    for k in range(k_min, k_max + 1):
+        p = dataclasses.replace(base, n_clusters=k)
+        c, inertia, _ = fit(x, p)
+        inertia = max(float(inertia), 1e-30)
+        if prev is not None and (prev[2] - inertia) / prev[2] < tol:
+            best_k, centers = prev[0], prev[1]
+            break
+        prev = (k, c, inertia)
+        centers = c
+    labels, _ = predict(x, centers)
+    return best_k, centers, labels

@@ -21,7 +21,16 @@ import jax
 
 from .errors import expects
 
-__all__ = ["Resources", "DeviceResources", "device_resources_manager"]
+__all__ = ["Resources", "DeviceResources", "device_resources_manager",
+           "workspace_chunk_bytes"]
+
+
+def workspace_chunk_bytes(res) -> int:
+    """Per-chunk byte bound for streaming searches: the Resources budget
+    when injected (clamped to a sane range), else 256 MB."""
+    if res is not None:
+        return max(16 << 20, min(res.workspace_bytes, 4 << 30))
+    return 256 << 20
 
 # Default workspace budget used to size tiles in streaming algorithms (the
 # analog of the reference's workspace memory_resource limit). 2 GiB leaves

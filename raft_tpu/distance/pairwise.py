@@ -157,10 +157,19 @@ _ELEMENTWISE = {
 }
 
 
-def _tile_sizes(m: int, n: int, d: int, itemsize: int):
+def _tile_sizes(m: int, n: int, d: int, itemsize: int,
+                workspace_bytes: int | None = None):
     """Pick (tm, tn) so tm*tn*d*itemsize stays within the tile budget,
     favoring full-width n tiles (better VPU utilization)."""
-    budget = _TILE_BUDGET_BYTES // max(1, d * itemsize)
+    # the reference sizes its scratch from the resources workspace
+    # allocator; a Resources budget plays the same role here. Tiles get a
+    # bounded fraction of it so a comms-only Resources (default 2 GB
+    # workspace) doesn't silently inflate the tuned per-tile footprint.
+    if workspace_bytes is not None:
+        total = min(max(workspace_bytes // 8, 16 << 20), 256 << 20)
+    else:
+        total = _TILE_BUDGET_BYTES
+    budget = total // max(1, d * itemsize)
     tn = min(n, max(128, budget // 128))
     tm = max(1, min(m, budget // max(1, tn)))
     return tm, tn
@@ -172,11 +181,14 @@ def pairwise_distance(
     y: jax.Array,
     metric="l2_expanded",
     metric_arg: float = 2.0,
+    res=None,
 ) -> jax.Array:
     """All-pairs distances between rows of ``x`` (m, d) and ``y`` (n, d).
 
     Analog of ``raft::distance::pairwise_distance``
     (distance-inl.cuh:238-329). Returns an (m, n) array in f32.
+    ``res``: optional Resources whose workspace budget sizes the
+    element-wise tiling (the reference's workspace-allocator role).
     """
     mt = canonical_metric(metric)
     expects(x.ndim == 2 and y.ndim == 2, "inputs must be 2-D (got %dD/%dD)", x.ndim, y.ndim)
@@ -193,7 +205,8 @@ def pairwise_distance(
             "(set-based metrics live in raft_tpu.sparse.distance)", mt.name)
 
     m, n, d = x.shape[0], y.shape[0], x.shape[1]
-    tm, tn = _tile_sizes(m, n, d, x.dtype.itemsize)
+    ws = res.workspace_bytes if res is not None else None
+    tm, tn = _tile_sizes(m, n, d, x.dtype.itemsize, ws)
     if tm >= m and tn >= n:
         return _elementwise_tile(x, y, mt, metric_arg)
 

@@ -28,6 +28,7 @@ import numpy as np
 from ..core import tracing
 from ..core.bitset import Bitset
 from ..core.errors import expects
+from ..core.resources import workspace_chunk_bytes
 from ..core.serialize import load_arrays, save_arrays
 from ..cluster import kmeans_balanced
 from ..distance.distance_types import DistanceType, canonical_metric, is_min_close
@@ -254,6 +255,7 @@ def _search_pallas(index, q, k, n_probes, offsets_j, sizes_j, precision):
     return vals, ids
 
 
+
 @tracing.annotate("raft_tpu::ivf_flat::search")
 def search(
     index: Index,
@@ -264,6 +266,7 @@ def search(
     query_chunk: int = 0,
     algo: str = "auto",
     precision: str = "highest",
+    res=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Probe the n_probes nearest lists per query and return exact top-k over
     their members → (distances (m, k), indices (m, k)) with original ids.
@@ -296,7 +299,7 @@ def search(
             # bound the (pairs × dim) query blocks to ~256 MB
             per_q = n_probes * dim_pad * 4
             query_chunk = max(1, min(q.shape[0],
-                                     (256 << 20) // max(per_q, 1)))
+                                     workspace_chunk_bytes(res) // max(per_q, 1)))
         outs_d, outs_i = [], []
         for c0 in range(0, q.shape[0], query_chunk):
             d_c, i_c = _search_pallas(index, q[c0 : c0 + query_chunk], k,
@@ -312,7 +315,7 @@ def search(
     if query_chunk <= 0:
         # bound gathered candidates to ~256 MB
         per_q = max_rows * index.dim * 4
-        query_chunk = max(1, min(q.shape[0], (256 << 20) // max(per_q, 1)))
+        query_chunk = max(1, min(q.shape[0], workspace_chunk_bytes(res) // max(per_q, 1)))
 
     mask_bits = filter.to_mask() if filter is not None else None
 

