@@ -29,6 +29,7 @@ from ..distance.pairwise import pairwise_distance
 __all__ = [
     "InitMethod", "KMeansParams", "init_plus_plus", "fit", "predict",
     "fit_predict", "transform", "cluster_cost", "fit_mini_batch",
+    "auto_find_k",
 ]
 
 

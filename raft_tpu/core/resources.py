@@ -27,8 +27,11 @@ __all__ = ["Resources", "DeviceResources", "device_resources_manager",
 
 def workspace_chunk_bytes(res) -> int:
     """Per-chunk byte bound for streaming searches: the Resources budget
-    when injected (clamped to a sane range), else 256 MB."""
-    if res is not None:
+    when *explicitly configured* (clamped to a sane range), else 256 MB.
+    A default-constructed Resources (workspace untouched) keeps the tuned
+    default — passing a vanilla Resources for comms/device injection must
+    not silently inflate memory use."""
+    if res is not None and res.workspace_bytes != DEFAULT_WORKSPACE_BYTES:
         return max(16 << 20, min(res.workspace_bytes, 4 << 30))
     return 256 << 20
 

@@ -162,10 +162,13 @@ def _tile_sizes(m: int, n: int, d: int, itemsize: int,
     """Pick (tm, tn) so tm*tn*d*itemsize stays within the tile budget,
     favoring full-width n tiles (better VPU utilization)."""
     # the reference sizes its scratch from the resources workspace
-    # allocator; a Resources budget plays the same role here. Tiles get a
-    # bounded fraction of it so a comms-only Resources (default 2 GB
-    # workspace) doesn't silently inflate the tuned per-tile footprint.
-    if workspace_bytes is not None:
+    # allocator; a Resources budget plays the same role here. Only an
+    # explicitly configured budget changes the tuned tiling — a vanilla
+    # Resources (default workspace) passed for comms/device injection
+    # keeps the default footprint.
+    from ..core.resources import DEFAULT_WORKSPACE_BYTES
+    if workspace_bytes is not None and \
+            workspace_bytes != DEFAULT_WORKSPACE_BYTES:
         total = min(max(workspace_bytes // 8, 16 << 20), 256 << 20)
     else:
         total = _TILE_BUDGET_BYTES

@@ -90,7 +90,7 @@ class SearchParams:
     VMEM traffic on TPU, fp32 is exact)."""
 
     n_probes: int = 20
-    lut_dtype: jnp.dtype = jnp.float32
+    lut_dtype: jnp.dtype = jnp.bfloat16
 
 
 @jax.tree_util.register_pytree_node_class
@@ -440,14 +440,23 @@ def search(
     expects(index.size > 0, "index is empty")
     n_probes = min(p.n_probes, index.n_lists)
 
+    # wide PQ shapes need the bf16 LUT mode in the kernel (an f32 one-hot
+    # block would bust VMEM); an explicit f32-LUT request there keeps the
+    # exact gather path rather than silently downgrading precision
+    wide_needs_bf16 = (index.pq_dim * index.pq_book_size >= 8192 and
+                       jnp.dtype(p.lut_dtype) == jnp.float32)
     use_pallas = (algo == "pallas" or
                   (algo == "auto" and filter is None and
                    index.codebook_kind is CodebookGen.PER_SUBSPACE and
+                   not wide_needs_bf16 and
                    jax.default_backend() == "tpu"))
     if use_pallas:
         expects(filter is None, "algo='pallas' does not take a filter")
         expects(index.codebook_kind is CodebookGen.PER_SUBSPACE,
                 "algo='pallas' needs PER_SUBSPACE codebooks")
+        expects(not wide_needs_bf16,
+                "algo='pallas' with pq_dim*2^pq_bits >= 8192 requires the "
+                "bf16 LUT mode (SearchParams.lut_dtype=jnp.bfloat16)")
         if query_chunk <= 0:
             per_q = n_probes * index.rot_dim * 4 * 2
             query_chunk = max(1, min(q.shape[0],

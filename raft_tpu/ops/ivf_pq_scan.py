@@ -71,7 +71,7 @@ def decoded_row_norms(codes, centers_rot, codebooks, list_offsets
 def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, cent_ref, cb_ref,
             codes_ref, ov_ref, oi_ref, codes_vmem, sem,
             *, k: int, kp: int, lmax: int, pq_dim: int, book: int,
-            metric: str, lut_bf16: bool, precision: str):
+            metric: str, precision: str):
     g = pl.program_id(0)
     off = offs_ref[g]
     size = sizes_ref[g]
@@ -82,31 +82,39 @@ def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, cent_ref, cb_ref,
         codes_ref.at[pl.ds(off_al, lmax), :], codes_vmem, sem)
     copy.start()
     q = qb_ref[0]                                    # (QG, rot_pad)
-    scale = -2.0 if metric == "l2" else -1.0
-    lut = scale * jax.lax.dot_general(
-        q, cb_ref[:], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision(precision))      # (QG, pq_dim*book)
+    pqb = pq_dim * book
+    lut_t = cb_ref.dtype                             # bf16 = fp16-LUT mode
     qc = jax.lax.dot_general(
         q, cent_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision(precision))      # (QG, 1)
     copy.wait()
 
-    codes = codes_vmem[:, :pq_dim].astype(jnp.int32)     # (lmax, pq_dim)
-    # pltpu.repeat tiles whole copies: codes_rep[r, b*pq_dim+s] = codes[r, s]
-    codes_rep = pltpu.repeat(codes, book, axis=1)        # (lmax, pq_dim*book)
-    j = jax.lax.broadcasted_iota(jnp.int32, (lmax, pq_dim * book), 1)
-    oh = (codes_rep == j // pq_dim)
-    if lut_bf16:
-        oh_m = oh.astype(jnp.bfloat16)
-        lut_m = lut.astype(jnp.bfloat16)
-    else:
-        oh_m = oh.astype(jnp.float32)
-        lut_m = lut
-    pq_term = jax.lax.dot_general(
-        lut_m, oh_m, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)          # (QG, lmax)
+    # Associativity saves VMEM: q @ (CB @ OHᵀ) instead of (q @ CB) @ OHᵀ.
+    # CB @ OHᵀ is exactly the chunk's *decoded rows* (rot_pad, cw) — a few
+    # hundred KB — whereas the per-query LUT (QG, pqb) is megabytes at
+    # large pq_dim. One-hot chunks are sized to ~4 MB; at very large lmax
+    # this unrolls more GEMM pairs (compile-time cost), the accepted
+    # tradeoff for a bounded VMEM footprint.
+    itemsize = 2 if lut_t == jnp.bfloat16 else 4
+    chunk = max(128, min(lmax, ((4 << 20) // (pqb * itemsize)) // 128 * 128))
+    scale = -2.0 if metric == "l2" else -1.0
+    terms = []
+    for c0 in range(0, lmax, chunk):
+        cw = min(chunk, lmax - c0)
+        codes_c = codes_vmem[c0 : c0 + cw, :pq_dim].astype(jnp.int32)
+        # pltpu.repeat tiles copies: codes_rep[r, b*pq_dim+s] = codes[r, s]
+        codes_rep = pltpu.repeat(codes_c, book, axis=1)  # (cw, pqb)
+        j = jax.lax.broadcasted_iota(jnp.int32, (cw, pqb), 1)
+        oh = (codes_rep == j // pq_dim).astype(lut_t)
+        decoded = jax.lax.dot_general(
+            oh, cb_ref[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # (cw, rot_pad)
+        terms.append(scale * jax.lax.dot_general(
+            q, decoded, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision(precision))) # (QG, cw)
+    pq_term = jnp.concatenate(terms, axis=1) if len(terms) > 1 else terms[0]
 
     if metric == "l2":
         qn = qn_ref[0]                               # (QG, 1) ||q||²
@@ -150,8 +158,7 @@ def _scan_groups(qblocks, qnorms, dn_slices, gcenters, cb_matrix, codes,
     kp = round_up_to(k, 128)
     rot_pad = qblocks.shape[2]
     kern = functools.partial(_kernel, k=k, kp=kp, lmax=lmax, pq_dim=pq_dim,
-                             book=book, metric=metric, lut_bf16=lut_bf16,
-                             precision=precision)
+                             book=book, metric=metric, precision=precision)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(n_groups,),
@@ -240,6 +247,9 @@ def _ivf_pq_scan_jit(codes_p, norms_p, centers_rot, cb_matrix, probed,
     rot_dim = q_rot.shape[1]
     rot_pad = cb_matrix.shape[0]
     lmax_pad = round_up_to(lmax + 8, 128)
+    if lut_bf16:
+        # fp16-LUT mode: cast here so the kernel's operand dtypes match
+        cb_matrix = cb_matrix.astype(jnp.bfloat16)
     q = jnp.pad(jnp.asarray(q_rot, jnp.float32),
                 ((0, 0), (0, rot_pad - rot_dim)))
     cent_p = jnp.pad(jnp.asarray(centers_rot, jnp.float32),
