@@ -1,0 +1,83 @@
+"""On-device roofline probe: measured peaks, not assumed ones.
+
+The bench reports kernel throughput as a fraction of the *measured* peak of
+the device actually in use (matmul TFLOP/s, HBM stream GB/s), because
+assumed per-generation limits (e.g. v5e datasheet numbers) can be off by
+orders of magnitude under remote/tunneled or simulated backends.
+
+Methodology: ``ops.autotune.measure`` — one blocking ``block_until_ready``
+per call (backends can elide never-awaited dispatches, making
+block-once-after-N timing meaningless), median of ``reps`` calls. Inputs
+are generated on device — host↔device transfer never enters the timing.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.autotune import measure as _median_time
+
+__all__ = ["probe", "matmul_tflops", "hbm_stream_gbps", "dispatch_us"]
+
+
+def matmul_tflops(n: int = 8192, dtype=jnp.bfloat16, reps: int = 7) -> float:
+    """Sustained TFLOP/s of one n×n×n matmul (result consumed on device)."""
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), jnp.float32).astype(dtype)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32).astype(dtype)
+
+    @jax.jit
+    def f(a, b):
+        return jax.lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dt = _median_time(f, a, b, reps=reps)
+    return 2.0 * n ** 3 / dt / 1e12
+
+
+def hbm_stream_gbps(mbytes: int = 1024, reps: int = 7) -> float:
+    """Sustained HBM read GB/s on a streaming f32 sum reduction."""
+    n = (mbytes << 20) // 4
+    x = jax.random.normal(jax.random.PRNGKey(2), (n,), jnp.float32)
+
+    @jax.jit
+    def f(x):
+        return jnp.sum(x)
+
+    dt = _median_time(f, x, reps=reps)
+    return 4.0 * n / dt / 1e9
+
+
+def dispatch_us(reps: int = 11) -> float:
+    """Median round-trip of a trivial dispatch (1-element add + sync)."""
+    x = jnp.zeros((8, 128), jnp.float32)
+
+    @jax.jit
+    def f(x):
+        return x + 1.0
+
+    return _median_time(f, x, reps=reps) * 1e6
+
+
+def probe(quick: bool = False) -> Dict[str, float]:
+    """Measure this device's effective peaks. ~4 compiles, a few seconds
+    of runtime (plus compile time) on a healthy backend."""
+    reps = 3 if quick else 7
+    return {
+        "matmul_bf16_tflops": round(matmul_tflops(dtype=jnp.bfloat16,
+                                                  reps=reps), 1),
+        "matmul_f32_tflops": round(matmul_tflops(dtype=jnp.float32,
+                                                 reps=reps), 1),
+        "hbm_stream_gbps": round(hbm_stream_gbps(
+            mbytes=256 if quick else 1024, reps=reps), 1),
+        "dispatch_us": round(dispatch_us(), 1),
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(probe()))

@@ -116,28 +116,40 @@ def _cagra_case(base, metric, graph_degree, itopk_sweep):
     return build, make_search, [{"itopk": t} for t in itopk_sweep]
 
 
-def default_configs(base, metric, algos: Sequence[str]):
+def default_configs(base, metric, algos: Sequence[str],
+                    n_lists: Optional[int] = None,
+                    pq_dim: Optional[int] = None,
+                    probe_sweep: Optional[Sequence[int]] = None,
+                    cagra_degree: int = 32,
+                    itopk_sweep: Optional[Sequence[int]] = None):
     """The raft-ann-bench default tuning envelopes
-    (docs/ann_benchmarks_param_tuning.md:10-96) scaled to dataset size."""
+    (docs/ann_benchmarks_param_tuning.md:10-96) scaled to dataset size;
+    every envelope overridable to pin a BASELINE.md config exactly."""
     n = len(base)
-    n_lists = max(64, min(4096, int(np.sqrt(n) * 2)))
-    pq_dim = max(8, (base.shape[1] // 2 // 8) * 8 or 8)
+    if n_lists is None:
+        n_lists = max(64, min(4096, int(np.sqrt(n) * 2)))
+    if pq_dim is None:
+        pq_dim = max(8, (base.shape[1] // 2 // 8) * 8 or 8)
+    if probe_sweep is None:
+        probe_sweep = [1, 2, 5, 10, 20, 50, 100]
+    if itopk_sweep is None:
+        itopk_sweep = [32, 64, 128, 256]
     cases = {}
     for a in algos:
         if a == "raft_brute_force":
             cases[a] = (_bf_case(base, metric), "")
         elif a == "raft_ivf_flat":
             cases[a] = (_ivf_flat_case(base, metric, n_lists,
-                                       [1, 2, 5, 10, 20, 50, 100]),
+                                       list(probe_sweep)),
                         f"nlist{n_lists}")
         elif a == "raft_ivf_pq":
             cases[a] = (_ivf_pq_case(base, metric, n_lists, pq_dim,
-                                     [1, 2, 5, 10, 20, 50, 100]),
+                                     list(probe_sweep)),
                         f"nlist{n_lists}.pq{pq_dim}")
         elif a == "raft_cagra":
-            cases[a] = (_cagra_case(base, metric, 32,
-                                    [32, 64, 128, 256]),
-                        "degree32")
+            cases[a] = (_cagra_case(base, metric, cagra_degree,
+                                    list(itopk_sweep)),
+                        f"degree{cagra_degree}")
         else:
             expects(False, "unknown algo %r", a)
     return cases

@@ -30,7 +30,8 @@ from ..distance.pairwise import _ELEMENTWISE, _elementwise_tile, _haversine
 from ..matrix.select_k import select_k
 from ..utils import hdot, round_up_to
 
-__all__ = ["Index", "build", "search", "knn", "knn_merge_parts", "save", "load"]
+__all__ = ["Index", "build", "search", "knn", "knn_merge_parts", "save",
+           "load", "tune_search"]
 
 _SERIAL_VERSION = 1
 
@@ -104,19 +105,104 @@ _PALLAS_METRICS = {
 }
 
 
+def _penalty_row(index: Index, filter, valid_rows):
+    """(n,) additive min-space penalty: +inf on excluded rows, else 0."""
+    if filter is None and valid_rows is None:
+        return None
+    n = index.size
+    pen = jnp.zeros((n,), jnp.float32)
+    if filter is not None:
+        pen = jnp.where(filter.to_mask(), pen, jnp.inf)
+    if valid_rows is not None:
+        pen = jnp.where(jnp.arange(n) < valid_rows, pen, jnp.inf)
+    return pen
+
+
+def _search_matmul(index: Index, q, k, filter, valid_rows, precision):
+    """One-shot GEMM + top_k engine, query-chunked to a workspace budget.
+
+    On backends where XLA's fused GEMM→top_k pipeline outruns the Pallas
+    kernel (dispatch-dominated regimes; measured via ops.autotune), this is
+    the fastest exact path. Expanded metrics only — the distance block for
+    a query chunk is one MXU GEMM plus row/col norm terms.
+    """
+    import os
+
+    mt = index.metric
+    n, m = index.size, q.shape[0]
+    prec = jax.lax.Precision(precision)
+    pen = _penalty_row(index, filter, valid_rows)
+
+    budget = int(os.environ.get("RAFT_TPU_MATMUL_WORKSPACE_MB", "1024")) << 20
+    chunk = int(max(8, min(m, budget // max(n * 4, 1))))
+    m_pad = round_up_to(m, chunk)
+    qp = jnp.pad(q, ((0, m_pad - m), (0, 0)))
+    dn = index.norms
+    dns = None if dn is None else (
+        jnp.sqrt(jnp.maximum(dn, 1e-30)) if mt is DistanceType.CosineExpanded
+        else dn)
+
+    def one(qc):
+        dot = jax.lax.dot_general(qc, index.dataset, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32,
+                                  precision=prec)
+        if mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
+            qn = jnp.sum(qc * qc, axis=1, keepdims=True)
+            s = jnp.maximum(qn + dns[None, :] - 2.0 * dot, 0.0)
+        elif mt is DistanceType.CosineExpanded:
+            qn = jnp.sqrt(jnp.maximum(jnp.sum(qc * qc, axis=1, keepdims=True),
+                                      1e-30))
+            s = 1.0 - dot / (qn * dns[None, :])
+        else:                                   # InnerProduct: min-space -dot
+            s = -dot
+        if pen is not None:
+            s = s + pen[None, :]
+        negv, idx = jax.lax.top_k(-s, k)
+        return -negv, idx
+
+    if m_pad == chunk:
+        vals, idxs = one(qp)
+    else:
+        vals, idxs = jax.lax.map(one, qp.reshape(m_pad // chunk, chunk, -1))
+        vals = vals.reshape(m_pad, k)
+        idxs = idxs.reshape(m_pad, k)
+    vals, idxs = vals[:m], idxs[:m]
+    idxs = jnp.where(jnp.isfinite(vals), idxs, -1)
+    if mt is DistanceType.L2SqrtExpanded:
+        vals = jnp.sqrt(jnp.maximum(vals, 0.0))
+    elif mt is DistanceType.InnerProduct:
+        vals = jnp.where(jnp.isfinite(vals), -vals, -jnp.inf)
+    return vals, idxs
+
+
+def tune_search(index: Index, queries, k: int, reps: int = 5):
+    """Measure the search engines on-device for this shape class and cache
+    the winner (consulted by ``algo="auto"``). Returns (winner, timings).
+
+    Call eagerly (not under jit) — e.g. once at serving start, or from the
+    bench harness before measuring.
+    """
+    from ..ops import autotune
+
+    q = jnp.asarray(queries, jnp.float32)
+    key = autotune.shape_bucket("bf_search", n=index.size, m=q.shape[0],
+                                d=index.dim, k=k)
+    cands = {
+        "matmul": jax.jit(lambda qq: search(index, qq, k, algo="matmul")),
+        "scan": jax.jit(lambda qq: search(index, qq, k, algo="scan")),
+    }
+    if index.metric in _PALLAS_METRICS and jax.default_backend() == "tpu":
+        cands["pallas"] = jax.jit(
+            lambda qq: search(index, qq, k, algo="pallas"))
+    return autotune.tune_best(key, cands, q, reps=reps, force=True)
+
+
 def _search_pallas(index: Index, q, k, filter, valid_rows, precision):
     """Fused Pallas distance+top-k path (the perf path on TPU)."""
     from ..ops import fused_knn
 
-    n = index.size
     mt = index.metric
-    pen = None
-    if filter is not None or valid_rows is not None:
-        pen = jnp.zeros((n,), jnp.float32)
-        if filter is not None:
-            pen = jnp.where(filter.to_mask(), pen, jnp.inf)
-        if valid_rows is not None:
-            pen = jnp.where(jnp.arange(n) < valid_rows, pen, jnp.inf)
+    pen = _penalty_row(index, filter, valid_rows)
     vals, idxs = fused_knn(q, index.dataset, k, metric=_PALLAS_METRICS[mt],
                            data_norms=index.norms, penalty=pen,
                            precision=precision)
@@ -146,11 +232,14 @@ def search(
     ``valid_rows``: optional traced scalar; rows at index >= valid_rows are
     excluded. Used by the sharded path where the per-shard row count is only
     known inside shard_map (padding shards).
-    ``algo``: "pallas" (fused distance+top-k kernel — the TPU perf path,
-    role of detail/knn_brute_force.cuh:61 + select_warpsort), "scan"
-    (composed-XLA streaming fallback, any metric), or "auto" (pallas on TPU
-    for L2/cosine/IP, scan otherwise).
-    ``precision``: MXU precision for the pallas GEMM ("highest"/"default").
+    ``algo``: "pallas" (fused distance+top-k kernel: the VMEM-resident
+    running-k path, role of detail/knn_brute_force.cuh:61 + select_warpsort),
+    "matmul" (one-shot GEMM + top_k, query-chunked to a workspace budget),
+    "scan" (composed-XLA streaming fallback, any metric), or "auto"
+    (consults the ops.autotune measurement cache — populate it with
+    ``tune_search`` — falling back to matmul/scan by metric; see
+    ops/autotune.py for why dispatch is measured, not hard-coded).
+    ``precision``: MXU precision for the distance GEMM ("highest"/"default").
     """
     q = jnp.asarray(queries, jnp.float32)
     expects(q.ndim == 2 and q.shape[1] == index.dim,
@@ -159,14 +248,38 @@ def search(
     expects(0 < k <= n, "k=%d out of range for index of size %d", k, n)
     mt = index.metric
     select_min = is_min_close(mt)
+    expanded = mt in _PALLAS_METRICS
 
-    use_pallas = (algo == "pallas" or
-                  (algo == "auto" and mt in _PALLAS_METRICS and
-                   jax.default_backend() == "tpu"))
-    if use_pallas:
+    if algo == "auto":
+        import os
+
+        from ..ops import autotune
+
+        hit = autotune.lookup(autotune.shape_bucket(
+            "bf_search", n=n, m=q.shape[0], d=index.dim, k=k))
+        if hit in ("pallas", "matmul", "scan") and (
+                expanded or hit == "scan"):
+            algo = hit
+        elif not expanded:
+            algo = "scan"
+        else:
+            # untuned heuristic: matmul only while a >=128-row query chunk
+            # fits the workspace budget (large indexes stream instead)
+            budget = int(os.environ.get("RAFT_TPU_MATMUL_WORKSPACE_MB",
+                                        "1024")) << 20
+            if budget // max(n * 4, 1) >= 128:
+                algo = "matmul"
+            else:
+                algo = ("pallas" if jax.default_backend() == "tpu"
+                        else "scan")
+    if algo == "pallas":
         expects(mt in _PALLAS_METRICS,
                 "algo='pallas' supports L2/cosine/IP, got %s", mt.name)
         return _search_pallas(index, q, k, filter, valid_rows, precision)
+    if algo == "matmul":
+        expects(expanded,
+                "algo='matmul' supports L2/cosine/IP, got %s", mt.name)
+        return _search_matmul(index, q, k, filter, valid_rows, precision)
 
     tile = min(tile_size, round_up_to(n, 128))
     n_pad = round_up_to(n, tile)

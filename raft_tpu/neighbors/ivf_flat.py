@@ -223,7 +223,19 @@ _PALLAS_METRICS = {
 }
 
 
-def _search_pallas(index, q, k, n_probes, offsets_j, sizes_j, precision):
+def _scan_penalty(index, mask_bits, lmax: int):
+    """Sample filter → in-kernel penalty row in sorted row order, padded to
+    the scan DMA window (built once per search call, not per query chunk)."""
+    from ..ops.ivf_scan import scan_window
+
+    if mask_bits is None:
+        return None
+    return jnp.pad(jnp.where(mask_bits[index.source_ids], 0.0, jnp.inf),
+                   (0, scan_window(lmax)))
+
+
+def _search_pallas(index, q, k, n_probes, offsets_j, sizes_j, precision,
+                   pen_p=None):
     """Fused query-grouped list scan (the TPU perf path; ops/ivf_scan.py)."""
     from ..ops import fused_knn
     from ..ops.ivf_scan import _ivf_flat_scan_jit, pad_for_scan
@@ -242,7 +254,7 @@ def _search_pallas(index, q, k, n_probes, offsets_j, sizes_j, precision):
         cache = (lmax, *pad_for_scan(index.data, index.data_norms, lmax))
         index._scan_pad = cache
     interpret = jax.default_backend() != "tpu"
-    vals, rows = _ivf_flat_scan_jit(cache[1], cache[2], probed,
+    vals, rows = _ivf_flat_scan_jit(cache[1], cache[2], pen_p, probed,
                                     offsets_j, sizes_j, q, k, lmax,
                                     _PALLAS_METRICS[mt], interpret,
                                     precision)
@@ -272,8 +284,9 @@ def search(
     their members → (distances (m, k), indices (m, k)) with original ids.
 
     ``algo``: "pallas" (fused query-grouped list scan — the TPU perf path,
-    role of the interleaved-scan kernel), "xla" (gather-based composed-XLA
-    path; required for ``filter``), "auto" (pallas on TPU when no filter).
+    role of the interleaved-scan kernel; ``filter`` rides in-kernel as a
+    penalty row), "xla" (gather-based composed-XLA path), "auto" (pallas
+    on TPU).
     """
     p = params or SearchParams()
     q = jnp.asarray(queries, jnp.float32)
@@ -287,13 +300,14 @@ def search(
     sizes_j = jnp.asarray(sizes_np, jnp.int32)
 
     use_pallas = (algo == "pallas" or
-                  (algo == "auto" and filter is None and
-                   mt in _PALLAS_METRICS and
+                  (algo == "auto" and mt in _PALLAS_METRICS and
                    jax.default_backend() == "tpu"))
     if use_pallas:
-        expects(filter is None, "algo='pallas' does not take a filter")
         expects(mt in _PALLAS_METRICS, "metric %s unsupported by pallas",
                 mt.name)
+        pen_p = _scan_penalty(
+            index, filter.to_mask() if filter is not None else None,
+            int(index.list_sizes.max()))
         dim_pad = -(-index.dim // 128) * 128
         if query_chunk <= 0:
             # bound the (pairs × dim) query blocks to ~256 MB
@@ -304,7 +318,7 @@ def search(
         for c0 in range(0, q.shape[0], query_chunk):
             d_c, i_c = _search_pallas(index, q[c0 : c0 + query_chunk], k,
                                       n_probes, offsets_j, sizes_j,
-                                      precision)
+                                      precision, pen_p)
             outs_d.append(d_c)
             outs_i.append(i_c)
         if len(outs_d) == 1:

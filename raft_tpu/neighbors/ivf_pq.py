@@ -370,7 +370,19 @@ def extend(index: Index, new_vectors, new_ids=None,
                  index.pq_bits, index.codebook_kind)
 
 
-def _search_pallas(index: Index, q, k, n_probes, lut_dtype, precision):
+def _scan_penalty(index, mask_bits, lmax: int):
+    """Sample filter → in-kernel penalty row in sorted row order, padded to
+    the scan DMA window (built once per search call, not per query chunk)."""
+    from ..ops.ivf_scan import scan_window
+
+    if mask_bits is None:
+        return None
+    return jnp.pad(jnp.where(mask_bits[index.source_ids], 0.0, jnp.inf),
+                   (0, scan_window(lmax)))
+
+
+def _search_pallas(index: Index, q, k, n_probes, lut_dtype, precision,
+                   pen_p=None):
     """Fused query-grouped PQ scan (ops/ivf_pq_scan.py) — the TPU perf
     path (expanded-form LUT + one-hot GEMM scoring)."""
     from ..ops import fused_knn
@@ -398,7 +410,7 @@ def _search_pallas(index: Index, q, k, n_probes, lut_dtype, precision):
     lut_bf16 = jnp.dtype(lut_dtype) != jnp.float32
     interpret = jax.default_backend() != "tpu"
     vals, rows = _ivf_pq_scan_jit(
-        cache["codes_p"], cache["norms_p"], index.centers_rot,
+        cache["codes_p"], cache["norms_p"], pen_p, index.centers_rot,
         cache["cbm"], probed,
         jnp.asarray(index.list_offsets[:-1], jnp.int32),
         jnp.asarray(index.list_sizes, jnp.int32), q_rot, k, lmax,
@@ -430,8 +442,8 @@ def search(
     """LUT-based approximate top-k (detail/ivf_pq_search.cuh:731).
 
     ``algo``: "pallas" (fused query-grouped PQ scan — the TPU perf path;
-    PER_SUBSPACE codebooks, no filter), "xla" (gather path, any config),
-    "auto" (pallas on TPU when eligible).
+    PER_SUBSPACE codebooks; ``filter`` rides in-kernel as a penalty row),
+    "xla" (gather path, any config), "auto" (pallas on TPU when eligible).
     """
     p = params or SearchParams()
     q = jnp.asarray(queries, jnp.float32)
@@ -446,17 +458,19 @@ def search(
     wide_needs_bf16 = (index.pq_dim * index.pq_book_size >= 8192 and
                        jnp.dtype(p.lut_dtype) == jnp.float32)
     use_pallas = (algo == "pallas" or
-                  (algo == "auto" and filter is None and
+                  (algo == "auto" and
                    index.codebook_kind is CodebookGen.PER_SUBSPACE and
                    not wide_needs_bf16 and
                    jax.default_backend() == "tpu"))
     if use_pallas:
-        expects(filter is None, "algo='pallas' does not take a filter")
         expects(index.codebook_kind is CodebookGen.PER_SUBSPACE,
                 "algo='pallas' needs PER_SUBSPACE codebooks")
         expects(not wide_needs_bf16,
                 "algo='pallas' with pq_dim*2^pq_bits >= 8192 requires the "
                 "bf16 LUT mode (SearchParams.lut_dtype=jnp.bfloat16)")
+        pen_p = _scan_penalty(
+            index, filter.to_mask() if filter is not None else None,
+            int(index.list_sizes.max()))
         if query_chunk <= 0:
             per_q = n_probes * index.rot_dim * 4 * 2
             query_chunk = max(1, min(q.shape[0],
@@ -464,7 +478,8 @@ def search(
         outs_d, outs_i = [], []
         for c0 in range(0, q.shape[0], query_chunk):
             d_c, i_c = _search_pallas(index, q[c0 : c0 + query_chunk], k,
-                                      n_probes, p.lut_dtype, precision)
+                                      n_probes, p.lut_dtype, precision,
+                                      pen_p)
             outs_d.append(d_c)
             outs_i.append(i_c)
         if len(outs_d) == 1:

@@ -1,0 +1,147 @@
+"""Measurement-driven engine/tile selection.
+
+The reference hard-codes per-arch dispatch heuristics (e.g.
+``choose_select_k_algorithm``, matrix/detail/select_k-inl.cuh:48-72, and the
+ivf_pq kernel-variant table, detail/ivf_pq_search.cuh:615-676) tuned offline
+per GPU generation. A TPU deployment sees far more variance — chip
+generation, VMEM size, and (under remote tunnels) effective dispatch cost
+all move the crossovers — so raft_tpu picks engines by *measuring them on
+the device actually in use* and caching the winner.
+
+Methodology note: each candidate is timed with a ``block_until_ready`` per
+call (some backends elide dead dispatches, so blocking once after N calls
+under-reports by orders of magnitude) and the median of several calls is
+used. Winners are cached in-process and, when ``RAFT_TPU_AUTOTUNE_CACHE``
+names a JSON file (or the default per-user cache path is writable), across
+processes.
+
+Nothing autotunes implicitly under ``jit`` tracing: callers consult
+``lookup`` (cache-only, never measures) on traced values and expose an
+explicit ``tune``/warmup entry point for eager callers and the bench.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, Mapping, Optional, Sequence, Tuple
+
+import jax
+
+from ..core import logging as rlog
+
+__all__ = ["shape_bucket", "lookup", "record", "measure", "tune_best",
+           "cache_path", "load_cache", "save_cache"]
+
+_MEM_CACHE: Dict[str, str] = {}
+_DISK_LOADED = False
+
+
+def cache_path() -> Optional[str]:
+    """Resolve the on-disk cache location (None disables persistence)."""
+    p = os.environ.get("RAFT_TPU_AUTOTUNE_CACHE")
+    if p == "":
+        return None
+    if p:
+        return p
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "raft_tpu", "autotune.json")
+
+
+def load_cache() -> None:
+    global _DISK_LOADED
+    if _DISK_LOADED:
+        return
+    _DISK_LOADED = True
+    p = cache_path()
+    if not p or not os.path.exists(p):
+        return
+    try:
+        with open(p) as f:
+            disk = json.load(f)
+        for k, v in disk.items():
+            _MEM_CACHE.setdefault(k, v)
+    except (OSError, ValueError) as e:
+        rlog.log_warn("autotune cache %s unreadable: %s", p, e)
+
+
+def save_cache() -> None:
+    p = cache_path()
+    if not p:
+        return
+    try:
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(_MEM_CACHE, f, indent=1, sort_keys=True)
+        os.replace(tmp, p)
+    except OSError as e:
+        rlog.log_warn("autotune cache %s unwritable: %s", p, e)
+
+
+def _log2_bucket(x: int) -> int:
+    return max(0, int(x - 1).bit_length())
+
+
+def shape_bucket(family: str, **dims: int) -> str:
+    """Cache key: backend + device kind + family + log2-bucketed dims."""
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", dev.platform).replace(" ", "_")
+    parts = [dev.platform, kind, family]
+    parts += [f"{name}{_log2_bucket(v)}" for name, v in sorted(dims.items())]
+    return ":".join(parts)
+
+
+def lookup(key: str) -> Optional[str]:
+    """Cache-only lookup; safe to call from trace time. Never measures."""
+    load_cache()
+    return _MEM_CACHE.get(key)
+
+
+def record(key: str, choice: str) -> None:
+    load_cache()
+    _MEM_CACHE[key] = choice
+    save_cache()
+
+
+def measure(fn: Callable, *args, reps: int = 5) -> float:
+    """Median seconds per call, one blocking sync per call (see module
+    docstring for why per-call blocking is load-bearing)."""
+    out = fn(*args)
+    jax.block_until_ready(out)      # compile + warm
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def tune_best(key: str, candidates: Mapping[str, Callable], *args,
+              reps: int = 5,
+              force: bool = False) -> Tuple[str, Dict[str, float]]:
+    """Measure every candidate on device, record + return the winner.
+
+    Returns (winner name, {name: median seconds}). Failures (e.g. a kernel
+    whose constraints reject the shape) disqualify that candidate.
+    """
+    if not force:
+        hit = lookup(key)
+        if hit in candidates:
+            return hit, {}
+    timings: Dict[str, float] = {}
+    for name, fn in candidates.items():
+        try:
+            timings[name] = measure(fn, *args, reps=reps)
+        except Exception as e:  # noqa: BLE001 - any engine failure = skip
+            rlog.log_warn("autotune %s: candidate %s failed: %s", key, name, e)
+    if not timings:
+        raise RuntimeError(f"autotune {key}: every candidate failed")
+    winner = min(timings, key=timings.get)
+    record(key, winner)
+    rlog.log_info("autotune %s -> %s (%s)", key, winner,
+              {n: f"{t*1e3:.1f}ms" for n, t in timings.items()})
+    return winner, timings

@@ -28,7 +28,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..utils import cdiv, round_up_to
-from .ivf_scan import _INT_BIG, _QG, merge_pairs, pack_pairs
+from .ivf_scan import _INT_BIG, _QG, merge_pairs, pack_pairs, scan_window
 
 __all__ = ["ivf_pq_scan", "make_cb_matrix", "decoded_row_norms"]
 
@@ -68,10 +68,10 @@ def decoded_row_norms(codes, centers_rot, codebooks, list_offsets
     return jnp.sum(c * c, axis=1) + cross + dec2
 
 
-def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, cent_ref, cb_ref,
-            codes_ref, ov_ref, oi_ref, codes_vmem, sem,
+def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, pen_ref, cent_ref,
+            cb_ref, codes_ref, ov_ref, oi_ref, codes_vmem, sem,
             *, k: int, kp: int, lmax: int, pq_dim: int, book: int,
-            metric: str, precision: str):
+            metric: str, precision: str, has_pen: bool):
     g = pl.program_id(0)
     off = offs_ref[g]
     size = sizes_ref[g]
@@ -121,6 +121,10 @@ def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, cent_ref, cb_ref,
         dist = jnp.maximum(qn + dn_ref[0, 0] - 2.0 * qc + pq_term, 0.0)
     else:                                            # "ip": min-order score
         dist = -qc + pq_term
+    if has_pen:
+        # in-kernel bitset filter as an additive penalty row (role of
+        # detail/ivf_pq_search.cuh:795-797)
+        dist = dist + pen_ref[0, 0]
 
     col = jax.lax.broadcasted_iota(jnp.int32, (_QG, lmax), 1)
     dist = jnp.where((col >= extra) & (col < extra + size), dist, jnp.inf)
@@ -151,14 +155,17 @@ def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, cent_ref, cb_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("k", "lmax", "n_groups", "pq_dim", "book", "metric",
-                     "lut_bf16", "interpret", "precision"))
-def _scan_groups(qblocks, qnorms, dn_slices, gcenters, cb_matrix, codes,
-                 goffs, gsizes, k, lmax, n_groups, pq_dim, book, metric,
-                 lut_bf16, interpret, precision):
+                     "lut_bf16", "interpret", "precision", "has_pen"))
+def _scan_groups(qblocks, qnorms, dn_slices, pen_slices, gcenters, cb_matrix,
+                 codes, goffs, gsizes, k, lmax, n_groups, pq_dim, book,
+                 metric, lut_bf16, interpret, precision, has_pen):
     kp = round_up_to(k, 128)
     rot_pad = qblocks.shape[2]
     kern = functools.partial(_kernel, k=k, kp=kp, lmax=lmax, pq_dim=pq_dim,
-                             book=book, metric=metric, precision=precision)
+                             book=book, metric=metric, precision=precision,
+                             has_pen=has_pen)
+    pen_map = (lambda g, o, s: (g, 0, 0)) if has_pen else (
+        lambda g, o, s: (0, 0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(n_groups,),
@@ -169,6 +176,7 @@ def _scan_groups(qblocks, qnorms, dn_slices, gcenters, cb_matrix, codes,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, lmax), lambda g, o, s: (g, 0, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, lmax), pen_map, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, rot_pad), lambda g, o, s: (g, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),     # CB matrix (whole)
@@ -193,7 +201,8 @@ def _scan_groups(qblocks, qnorms, dn_slices, gcenters, cb_matrix, codes,
             jax.ShapeDtypeStruct((n_groups, _QG, kp), jnp.int32),
         ],
         interpret=interpret,
-    )(goffs, gsizes, qblocks, qnorms, dn_slices, gcenters, cb_matrix, codes)
+    )(goffs, gsizes, qblocks, qnorms, dn_slices, pen_slices, gcenters,
+      cb_matrix, codes)
 
 
 def ivf_pq_scan(
@@ -213,12 +222,18 @@ def ivf_pq_scan(
     lut_bf16: bool = True,
     interpret: Optional[bool] = None,
     precision: str = "highest",
+    penalty: Optional[jax.Array] = None,   # (n,) f32: +inf excludes a row
 ) -> Tuple[jax.Array, jax.Array]:
-    """Scan probed PQ lists → per-query k best (approx values, ROW ids)."""
+    """Scan probed PQ lists → per-query k best (approx values, ROW ids).
+    ``penalty`` is indexed in the sorted row order of ``codes``."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     codes_p, norms_p = pad_codes_for_scan(codes, row_norms2, lmax, pq_dim)
-    return _ivf_pq_scan_jit(codes_p, norms_p, centers_rot, cb_matrix,
+    pen_p = None
+    if penalty is not None:
+        pen_p = jnp.pad(jnp.asarray(penalty, jnp.float32),
+                        (0, scan_window(lmax)))
+    return _ivf_pq_scan_jit(codes_p, norms_p, pen_p, centers_rot, cb_matrix,
                             probed, offsets, sizes, q_rot, k, lmax, pq_dim,
                             book, metric, lut_bf16, interpret, precision)
 
@@ -227,7 +242,7 @@ def ivf_pq_scan(
 def pad_codes_for_scan(codes, row_norms2, lmax: int, pq_dim: int):
     """Pad codes/norms for the aligned DMA windows — a full copy of the
     compressed dataset; callers cache per index."""
-    lmax_pad = round_up_to(lmax + 8, 128)
+    lmax_pad = scan_window(lmax)
     code_pad = round_up_to(pq_dim, 128)
     codes_p = jnp.pad(jnp.asarray(codes, jnp.uint8),
                       ((0, lmax_pad), (0, code_pad - pq_dim)))
@@ -239,14 +254,14 @@ def pad_codes_for_scan(codes, row_norms2, lmax: int, pq_dim: int):
     jax.jit,
     static_argnames=("k", "lmax", "pq_dim", "book", "metric", "lut_bf16",
                      "interpret", "precision"))
-def _ivf_pq_scan_jit(codes_p, norms_p, centers_rot, cb_matrix, probed,
+def _ivf_pq_scan_jit(codes_p, norms_p, pen_p, centers_rot, cb_matrix, probed,
                      offsets, sizes, q_rot, k, lmax, pq_dim, book, metric,
                      lut_bf16, interpret, precision):
     m, p = probed.shape
     n_lists = offsets.shape[0]
     rot_dim = q_rot.shape[1]
     rot_pad = cb_matrix.shape[0]
-    lmax_pad = round_up_to(lmax + 8, 128)
+    lmax_pad = scan_window(lmax)
     if lut_bf16:
         # fp16-LUT mode: cast here so the kernel's operand dtypes match
         cb_matrix = cb_matrix.astype(jnp.bfloat16)
@@ -265,9 +280,14 @@ def _ivf_pq_scan_jit(codes_p, norms_p, centers_rot, cb_matrix, probed,
     goffs_al = (goffs // 8) * 8
     dn = jax.vmap(lambda o: jax.lax.dynamic_slice(
         norms_p, (o,), (lmax_pad,)))(goffs_al)[:, None, :]
+    if pen_p is None:
+        pen = jnp.zeros((1, 1, lmax_pad), jnp.float32)
+    else:
+        pen = jax.vmap(lambda o: jax.lax.dynamic_slice(
+            pen_p, (o,), (lmax_pad,)))(goffs_al)[:, None, :]
 
-    gv, gi = _scan_groups(qblocks, qn, dn, gcenters, cb_matrix, codes_p,
+    gv, gi = _scan_groups(qblocks, qn, dn, pen, gcenters, cb_matrix, codes_p,
                           goffs, gsizes, k, lmax_pad, int(n_groups),
                           pq_dim, book, metric, lut_bf16, interpret,
-                          precision)
+                          precision, pen_p is not None)
     return merge_pairs(gv, gi, flat, order, m, p, k)

@@ -80,9 +80,10 @@ def merge_pairs(gv, gi, flat, order, m: int, p: int, k: int):
     return out_v, jnp.where(jnp.isfinite(out_v), out_i, -1)
 
 
-def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, data_ref,
+def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, pen_ref, data_ref,
             ov_ref, oi_ref, rows_vmem, sem,
-            *, k: int, kp: int, lmax: int, metric: str, precision: str):
+            *, k: int, kp: int, lmax: int, metric: str, precision: str,
+            has_pen: bool):
     g = pl.program_id(0)
     off = offs_ref[g]
     size = sizes_ref[g]
@@ -107,6 +108,12 @@ def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, data_ref,
         dist = 1.0 - dot / jnp.maximum(qn * dn_ref[0, 0], 1e-30)
     else:                                           # "ip"
         dist = -dot
+    if has_pen:
+        # bitset sample filter folded in as an additive penalty row
+        # (+inf on excluded rows) — the fused_knn penalty mechanism
+        # applied to the list scan; role of the in-kernel filter at
+        # detail/ivf_pq_search.cuh:795-797
+        dist = dist + pen_ref[0, 0]
     col = jax.lax.broadcasted_iota(jnp.int32, (_QG, lmax), 1)
     dist = jnp.where((col >= extra) & (col < extra + size), dist, jnp.inf)
 
@@ -137,14 +144,17 @@ def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, data_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("k", "lmax", "n_groups", "metric", "interpret",
-                     "precision"))
-def _scan_groups(qblocks, qnorms, dnorm_slices, data, goffs, gsizes,
-                 k: int, lmax: int, n_groups: int, metric: str,
-                 interpret: bool, precision: str):
+                     "precision", "has_pen"))
+def _scan_groups(qblocks, qnorms, dnorm_slices, pen_slices, data, goffs,
+                 gsizes, k: int, lmax: int, n_groups: int, metric: str,
+                 interpret: bool, precision: str, has_pen: bool):
     kp = round_up_to(k, 128)
     dim_pad = qblocks.shape[2]
     kern = functools.partial(_kernel, k=k, kp=kp, lmax=lmax,
-                             metric=metric, precision=precision)
+                             metric=metric, precision=precision,
+                             has_pen=has_pen)
+    pen_map = (lambda g, o, s: (g, 0, 0)) if has_pen else (
+        lambda g, o, s: (0, 0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(n_groups,),
@@ -155,6 +165,7 @@ def _scan_groups(qblocks, qnorms, dnorm_slices, data, goffs, gsizes,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, lmax), lambda g, o, s: (g, 0, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, lmax), pen_map, memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),      # data stays in HBM
         ],
         out_specs=[
@@ -176,7 +187,7 @@ def _scan_groups(qblocks, qnorms, dnorm_slices, data, goffs, gsizes,
             jax.ShapeDtypeStruct((n_groups, _QG, kp), jnp.int32),
         ],
         interpret=interpret,
-    )(goffs, gsizes, qblocks, qnorms, dnorm_slices, data)
+    )(goffs, gsizes, qblocks, qnorms, dnorm_slices, pen_slices, data)
 
 
 def ivf_flat_scan(
@@ -191,15 +202,21 @@ def ivf_flat_scan(
     metric: str = "l2",
     interpret: Optional[bool] = None,
     precision: str = "highest",
+    penalty: Optional[jax.Array] = None,   # (n,) f32: +inf excludes a row
 ) -> Tuple[jax.Array, jax.Array]:
     """Scan probed lists → per-query k best (values, ROW ids into ``data``'s
     sorted order, -1 when fewer than k candidates); caller maps row ids to
-    source ids and applies metric postprocessing.
+    source ids and applies metric postprocessing. ``penalty`` is indexed in
+    the same sorted row order as ``data`` (sample filters in-kernel).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     data_p, norms_p = pad_for_scan(data, data_norms, lmax)
-    return _ivf_flat_scan_jit(data_p, norms_p, probed, offsets, sizes,
+    pen_p = None
+    if penalty is not None:
+        pen_p = jnp.pad(jnp.asarray(penalty, jnp.float32),
+                        (0, scan_window(lmax)))
+    return _ivf_flat_scan_jit(data_p, norms_p, pen_p, probed, offsets, sizes,
                               queries, k, lmax, metric, interpret, precision)
 
 
@@ -226,9 +243,9 @@ def pad_for_scan(data, data_norms, lmax: int):
 @functools.partial(
     jax.jit,
     static_argnames=("k", "lmax", "metric", "interpret", "precision"))
-def _ivf_flat_scan_jit(data_p, norms_p, probed, offsets, sizes, queries,
-                       k: int, lmax: int, metric: str, interpret: bool,
-                       precision: str):
+def _ivf_flat_scan_jit(data_p, norms_p, pen_p, probed, offsets, sizes,
+                       queries, k: int, lmax: int, metric: str,
+                       interpret: bool, precision: str):
     # one jit over grouping + kernel + merge: the grouping chain is ~20
     # gather/sort ops over ~100 MB intermediates, far too hot to dispatch
     # eagerly per op
@@ -257,9 +274,14 @@ def _ivf_flat_scan_jit(data_p, norms_p, probed, offsets, sizes, queries,
         dn = jnp.sqrt(jnp.maximum(dn, 1e-30))
     dn = dn[:, None, :]                             # (G, 1, L): TPU block
                                                     # rule wants full minors
+    if pen_p is None:
+        pen = jnp.zeros((1, 1, lmax_pad), jnp.float32)
+    else:
+        pen = jax.vmap(lambda o: jax.lax.dynamic_slice(
+            pen_p, (o,), (lmax_pad,)))(goffs_al)[:, None, :]
 
-    gv, gi = _scan_groups(qblocks, qn, dn, data_p, goffs, gsizes, k,
+    gv, gi = _scan_groups(qblocks, qn, dn, pen, data_p, goffs, gsizes, k,
                           lmax_pad, int(n_groups), metric, interpret,
-                          precision)
+                          precision, pen_p is not None)
 
     return merge_pairs(gv, gi, flat, order, m, p, k)

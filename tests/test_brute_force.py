@@ -95,6 +95,63 @@ class TestBruteForce:
         _, want_idx = naive_knn(data, q, 8)
         assert calc_recall(np.asarray(idx), want_idx) > 0.999
 
+    @pytest.mark.parametrize("metric", ["sqeuclidean", "euclidean",
+                                        "inner_product", "cosine"])
+    def test_matmul_engine_vs_oracle(self, rng, metric):
+        data, q = _data(rng, n=3000, m=32)
+        index = brute_force.build(data, metric=metric)
+        dist, idx = brute_force.search(index, q, k=10, algo="matmul")
+        want_dist, want_idx = naive_knn(data, q, 10, metric)
+        assert calc_recall(np.asarray(idx), want_idx) > 0.999
+        np.testing.assert_allclose(np.asarray(dist), want_dist,
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_matmul_engine_chunked(self, rng, monkeypatch):
+        # budget forcing multiple query chunks through lax.map
+        monkeypatch.setenv("RAFT_TPU_MATMUL_WORKSPACE_MB", "1")
+        data, q = _data(rng, n=2000, m=400)
+        dist, idx = brute_force.search(brute_force.build(data), q, k=5,
+                                       algo="matmul")
+        _, want_idx = naive_knn(data, q, 5)
+        assert calc_recall(np.asarray(idx), want_idx) > 0.999
+
+    def test_matmul_engine_filter_and_valid_rows(self, rng):
+        data, q = _data(rng, n=1000, m=16)
+        _, base_idx = naive_knn(data, q, 2)
+        mask = np.ones(1000, bool)
+        mask[base_idx[:, 0]] = False
+        filt = Bitset.from_mask(jnp.asarray(mask))
+        _, idx = brute_force.search(brute_force.build(data), q, k=1,
+                                    algo="matmul", filter=filt)
+        got = np.asarray(idx)[:, 0]
+        for i in range(16):
+            if mask[base_idx[i, 1]]:
+                assert got[i] == base_idx[i, 1]
+        # valid_rows: restrict to the first 100 rows
+        d2, i2 = brute_force.search(brute_force.build(data), q, k=3,
+                                    algo="matmul",
+                                    valid_rows=jnp.asarray(100))
+        _, want = naive_knn(data[:100], q, 3)
+        assert calc_recall(np.asarray(i2), want) > 0.999
+
+    def test_tune_search_records_winner(self, rng, tmp_path, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "tune.json"))
+        from raft_tpu.ops import autotune
+        monkeypatch.setattr(autotune, "_MEM_CACHE", {})
+        monkeypatch.setattr(autotune, "_DISK_LOADED", False)
+        data, q = _data(rng, n=600, m=16)
+        index = brute_force.build(data)
+        winner, timings = brute_force.tune_search(index, q, k=5, reps=2)
+        assert winner in ("matmul", "scan")
+        assert set(timings) >= {"matmul", "scan"}
+        key = autotune.shape_bucket("bf_search", n=600, m=16, d=32, k=5)
+        assert autotune.lookup(key) == winner
+        # auto now dispatches the cached winner without error
+        d, i = brute_force.search(index, q, k=5, algo="auto")
+        _, want_idx = naive_knn(data, q, 5)
+        assert calc_recall(np.asarray(i), want_idx) > 0.999
+
     def test_bad_query_dim(self, rng):
         from raft_tpu.core import RaftError
         data, _ = _data(rng, n=100)

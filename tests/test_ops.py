@@ -140,6 +140,45 @@ class TestIvfScanParity:
                            for r in range(len(q))])
         assert overlap > 0.85
 
+    def test_ivf_flat_pallas_filter_matches_xla(self):
+        from raft_tpu.core.bitset import Bitset
+        from raft_tpu.neighbors import ivf_flat
+
+        rng = np.random.default_rng(31)
+        data = rng.standard_normal((1500, 24), dtype=np.float32)
+        q = rng.standard_normal((20, 24), dtype=np.float32)
+        keep = rng.random(1500) > 0.4
+        filt = Bitset.from_mask(keep)
+        index = ivf_flat.build(data, ivf_flat.IndexParams(n_lists=12, seed=0))
+        sp = ivf_flat.SearchParams(n_probes=12)
+        dx, ix = ivf_flat.search(index, q, 8, sp, algo="xla", filter=filt)
+        dp, ip = ivf_flat.search(index, q, 8, sp, algo="pallas", filter=filt)
+        ip_np = np.asarray(ip)
+        assert keep[ip_np[ip_np >= 0]].all()
+        assert np.mean(ip_np == np.asarray(ix)) > 0.99
+        np.testing.assert_allclose(np.asarray(dp), np.asarray(dx),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_ivf_pq_pallas_filter_excludes(self):
+        import jax.numpy as jnp
+
+        from raft_tpu.core.bitset import Bitset
+        from raft_tpu.neighbors import ivf_pq
+
+        rng = np.random.default_rng(32)
+        data = rng.standard_normal((1500, 32), dtype=np.float32)
+        q = rng.standard_normal((15, 32), dtype=np.float32)
+        keep = rng.random(1500) > 0.5
+        filt = Bitset.from_mask(keep)
+        index = ivf_pq.build(data, ivf_pq.IndexParams(n_lists=12, pq_dim=8,
+                                                      seed=0))
+        sp = ivf_pq.SearchParams(n_probes=12, lut_dtype=jnp.float32)
+        dx, ix = ivf_pq.search(index, q, 8, sp, algo="xla", filter=filt)
+        dp, ip = ivf_pq.search(index, q, 8, sp, algo="pallas", filter=filt)
+        ip_np = np.asarray(ip)
+        assert keep[ip_np[ip_np >= 0]].all()
+        assert np.mean(ip_np == np.asarray(ix)) > 0.95
+
     def test_ivf_flat_pallas_small_k_and_tail_lists(self):
         """k larger than some list sizes + uneven lists: sentinel handling."""
         from raft_tpu.neighbors import ivf_flat
