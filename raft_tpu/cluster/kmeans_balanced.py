@@ -143,25 +143,33 @@ def fit(x, n_clusters: int, params: BalancedKMeansParams | None = None) -> jax.A
         alloc[i] -= 1
 
     # level 2: seed fine centers per mesocluster from a random sample of its
-    # own points (host-side — a jitted per-meso kmeans++ would recompile for
-    # every distinct (|meso|, alloc) shape, which dominated build time; the
-    # joint _balanced_lloyd polish + adjust_centers rounds below do the
-    # quality work, as in build_hierarchical)
-    fine_list = []
+    # own points. Only O(n_meso) counts ever reach the host: the dataset and
+    # its meso labels stay on device (a meso-sorted row *order* plus one
+    # n_clusters-row gather replaces the old per-meso host loop, whose
+    # np.asarray(x) was a full-dataset device→host transfer). A jitted
+    # per-meso kmeans++ would recompile per (|meso|, alloc) shape; the joint
+    # _balanced_lloyd polish below does the quality work, as in
+    # build_hierarchical.
+    order = jnp.argsort(meso_labels)                  # meso-sorted row ids
+    starts = np.concatenate([[0], np.cumsum(counts.astype(np.int64))[:-1]])
     seed_rng = np.random.default_rng(p.seed ^ 0x9E3779B9)
-    labels_np = np.asarray(meso_labels)
-    x_np = np.asarray(x)
+    pos = np.zeros(n_clusters, np.int64)              # slot → sorted row
+    slot_meso = np.repeat(np.arange(n_meso), alloc)
+    valid = np.zeros(n_clusters, bool)
+    s = 0
     for m in range(n_meso):
-        pts = x_np[labels_np == m]
-        km = int(alloc[m])
-        if len(pts) == 0:
-            fine_list.append(np.asarray(meso_centers)[m : m + 1].repeat(km, 0))
-        elif len(pts) <= km:
-            fine_list.append(np.resize(pts, (km, d)))
-        else:
-            picks = seed_rng.choice(len(pts), km, replace=False)
-            fine_list.append(pts[picks])
-    centers0 = jnp.asarray(np.concatenate(fine_list, axis=0))
+        km, cm = int(alloc[m]), int(counts[m])
+        if cm > 0:
+            if cm > km:
+                local = seed_rng.choice(cm, km, replace=False)
+            else:
+                local = np.arange(km) % cm            # cycle the members
+            pos[s : s + km] = starts[m] + local
+            valid[s : s + km] = True
+        s += km
+    picks = jnp.take(order, jnp.asarray(pos))         # device gather
+    centers0 = jnp.where(jnp.asarray(valid)[:, None], x[picks],
+                         meso_centers[jnp.asarray(slot_meso)])
 
     key_bal = jax.random.key(p.seed + 17)
     return _balanced_lloyd(x, centers0, p.n_iters, p.balancing_rounds,

@@ -56,11 +56,11 @@ def _connect_components(x, ms, md, mw, n):
     """Bridge a disconnected kNN forest: per round, every component adds its
     minimum cross-component edge (detail/connectivities.cuh
     connect_components / FixConnectivitiesRedOp role), Boruvka-style until
-    one tree remains. Cross edges carry true L2 distances."""
-    from ..core.bitset import Bitset
-    from ..neighbors import brute_force
+    one tree remains. Cross edges carry true L2 distances. The per-round
+    engine is one vectorized cross_component_nn scan (all components at
+    once), not a search per component."""
+    from ..sparse.neighbors import cross_component_nn
 
-    index = brute_force.build(x, metric="sqeuclidean")
     ms, md, mw = list(ms), list(md), list(mw)
     for _ in range(64):
         parent = np.arange(n)
@@ -76,18 +76,15 @@ def _connect_components(x, ms, md, mw, n):
             if ra != rb:
                 parent[max(ra, rb)] = min(ra, rb)
         comp = np.array([find(i) for i in range(n)])
-        comps = np.unique(comp)
+        comps, comp_dense = np.unique(comp, return_inverse=True)
         if len(comps) == 1:
             break
-        for cid in comps:
-            mask = comp != cid                    # candidates outside
-            members = np.nonzero(comp == cid)[0]
-            d, i = brute_force.search(index, x[members], 1,
-                                      filter=Bitset.from_mask(mask))
-            d = np.asarray(d)[:, 0]
-            i = np.asarray(i)[:, 0]
-            best = int(np.argmin(d))
-            ms.append(int(members[best]))
+        d, i = cross_component_nn(x, jnp.asarray(comp_dense))
+        d, i = np.asarray(d), np.asarray(i)
+        for c in range(len(comps)):               # min edge per component
+            members = np.nonzero(comp_dense == c)[0]
+            best = members[np.argmin(d[members])]
+            ms.append(int(best))
             md.append(int(i[best]))
             mw.append(float(np.sqrt(max(d[best], 0.0))))
     # the added bridges may include duplicates across components; the

@@ -118,10 +118,14 @@ class AxisComms:
                                   axis_index_groups=self.groups)
 
     def allgatherv(self, x, counts: Sequence[int]) -> Tuple[jax.Array, jax.Array]:
-        """Variable-count allgather (comms_t::allgatherv): ranks contribute
-        ``counts[r]`` valid rows out of a common padded buffer. Returns
-        (stacked (size, max_rows, …), counts array) — the ragged result the
-        reference writes at displacements, in padded-dense TPU form."""
+        """Variable-count allgather (role of comms_t::allgatherv): ranks
+        contribute ``counts[r]`` valid rows out of a common padded buffer.
+        Returns (stacked (size, max_rows, …), counts array).
+
+        Contract difference from the reference: comms_t::allgatherv writes
+        ragged results at displacements; the TPU idiom is padded-dense, so
+        slot (r, i) for i >= counts[r] is PADDING and the caller must mask
+        by ``counts`` before reducing over the gathered axis."""
         g = self.allgather(x)
         return g, jnp.asarray(counts, jnp.int32)
 

@@ -1,9 +1,10 @@
 """Kernel gram matrices: analog of ``raft::distance::kernels``.
 
 Reference: raft/distance/kernels.cuh + detail/kernels/ (GramMatrix classes
-with KernelParams{type, degree, gamma, coef0}; dense and CSR inputs). Dense
-path here; the CSR path lives in raft_tpu.sparse once sparse containers land.
-All four kernels ride one MXU GEMM plus a fused epilogue.
+with KernelParams{type, degree, gamma, coef0}; dense and CSR inputs).
+CSR inputs are densified in row tiles before the GEMM — on TPU sparse
+inputs buy memory, not FLOPs (see sparse/distance.py), and the gram
+output is dense regardless.
 """
 from __future__ import annotations
 
@@ -37,8 +38,26 @@ class KernelParams:
     coef0: float = 0.0
 
 
-def gram_matrix(x: jax.Array, y: jax.Array, params: KernelParams) -> jax.Array:
-    """Gram matrix K (m, n) between rows of x and y for the given kernel."""
+def gram_matrix(x, y, params: KernelParams,
+                tile_rows: int = 4096) -> jax.Array:
+    """Gram matrix K (m, n) between rows of x and y for the given kernel.
+
+    ``x``/``y`` may be dense arrays or ``sparse.CSR`` (the reference's
+    CSR GramMatrix overloads, detail/kernels/gram_matrix.cuh); CSR x is
+    densified ``tile_rows`` rows at a time.
+    """
+    from ..sparse.csr import CSR
+
+    if isinstance(y, CSR):
+        y = y.to_dense()
+    if isinstance(x, CSR):
+        m = x.shape[0]
+        if m > tile_rows:
+            return jnp.concatenate(
+                [gram_matrix(x.slice_rows(r, min(r + tile_rows, m)), y,
+                             params, tile_rows)
+                 for r in range(0, m, tile_rows)], axis=0)
+        x = x.to_dense()
     expects(x.shape[1] == y.shape[1], "dim mismatch %s %s", x.shape, y.shape)
     x = jnp.asarray(x, jnp.float32)
     y = jnp.asarray(y, jnp.float32)

@@ -1,0 +1,179 @@
+"""Dense linear algebra façade: analog of ``raft/linalg/*.cuh``.
+
+The reference's layer-3 linalg surface is cuBLAS/cuSOLVER wrappers plus
+element-wise/reduction kernel templates (SURVEY.md §2.6). On TPU nearly
+all of it is XLA built-ins, so this module is deliberately thin: it
+collects the reference's API surface in one place (gemm/gemv/axpy/dot,
+eig/eigh/qr/svd/lstsq, norms, reductions, transpose) and implements the
+few pieces XLA does not ship — randomized SVD (``rsvd``, raft/linalg/
+rsvd.cuh) and the rank-1 Cholesky update (``cholesky_rank_one_update``,
+raft/linalg/cholesky_r1_update.cuh).
+
+All matmuls default to f32-accurate MXU precision (utils.hdot rationale).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import expects
+from ..utils import hdot
+
+__all__ = [
+    "gemm", "gemv", "dot", "axpy", "add", "subtract", "multiply", "divide",
+    "power", "sqrt", "map_reduce", "matrix_vector_op", "norm", "normalize",
+    "reduce_rows", "reduce_cols", "reduce_rows_by_key", "transpose",
+    "eig", "eigh", "qr", "svd", "rsvd", "lstsq",
+    "cholesky", "cholesky_rank_one_update",
+]
+
+# ---- BLAS-like (raft/linalg/gemm.cuh, gemv.cuh, axpy.cuh, dot.cuh) ------
+
+def gemm(a, b, alpha: float = 1.0, beta: float = 0.0, c=None) -> jax.Array:
+    """alpha·a@b (+ beta·c) — cublasLt gemm's role, on the MXU."""
+    out = alpha * hdot(a, b)
+    return out if c is None or beta == 0.0 else out + beta * c
+
+
+def gemv(a, x, alpha: float = 1.0, beta: float = 0.0, y=None) -> jax.Array:
+    out = alpha * hdot(a, x[:, None])[:, 0]
+    return out if y is None or beta == 0.0 else out + beta * y
+
+
+def dot(x, y) -> jax.Array:
+    return jnp.vdot(x, y)
+
+
+def axpy(alpha: float, x, y) -> jax.Array:
+    return alpha * x + y
+
+
+# ---- element-wise (raft/linalg/add.cuh … sqrt.cuh) ----------------------
+
+add = jnp.add
+subtract = jnp.subtract
+multiply = jnp.multiply
+divide = jnp.divide
+power = jnp.power
+sqrt = jnp.sqrt
+
+
+def map_reduce(x, map_op, reduce_op=jnp.add, axis=None, init=0.0):
+    """map then tree-reduce (raft/linalg/map_then_reduce.cuh)."""
+    mapped = map_op(x)
+    return jax.lax.reduce(mapped, jnp.asarray(init, mapped.dtype),
+                          reduce_op,
+                          tuple(range(mapped.ndim)) if axis is None
+                          else (axis,))
+
+
+def matrix_vector_op(m, v, op=jnp.add, along_rows: bool = True) -> jax.Array:
+    """Broadcast a vector op over rows/cols (raft/linalg/matrix_vector_op.cuh)."""
+    return op(m, v[None, :] if along_rows else v[:, None])
+
+
+# ---- reductions / norms (raft/linalg/norm.cuh, reduce.cuh) --------------
+
+def norm(x, ord: int = 2, axis: Optional[int] = None) -> jax.Array:
+    """Row/col/global L1/L2 norms (raft/linalg/norm.cuh L1Norm/L2Norm —
+    note the reference's L2Norm is the *squared* sum; use ord=2 for the
+    true norm, ord=-2 for the reference's squared convention)."""
+    if ord == -2:
+        return jnp.sum(x * x, axis=axis)
+    return jnp.linalg.norm(x, ord=ord, axis=axis)
+
+
+def normalize(x, axis: int = 1, eps: float = 1e-30) -> jax.Array:
+    """Row-normalize (raft/linalg/normalize.cuh)."""
+    n = jnp.linalg.norm(x, axis=axis, keepdims=True)
+    return x / jnp.maximum(n, eps)
+
+
+def reduce_rows(x, op=jnp.sum) -> jax.Array:
+    return op(x, axis=0)
+
+
+def reduce_cols(x, op=jnp.sum) -> jax.Array:
+    return op(x, axis=1)
+
+
+def reduce_rows_by_key(x, keys, n_keys: int) -> jax.Array:
+    """Segment-sum rows by key (raft/linalg/reduce_rows_by_key.cuh)."""
+    return jax.ops.segment_sum(x, keys, num_segments=n_keys)
+
+
+def transpose(x) -> jax.Array:
+    return jnp.swapaxes(x, -1, -2)
+
+
+# ---- factorizations (raft/linalg/eig.cuh, qr.cuh, svd.cuh, lstsq.cuh) ---
+
+def eig(a) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric eigendecomposition (eig.cuh eigDC) → (vals, vecs)."""
+    return jnp.linalg.eigh(a)
+
+
+eigh = eig
+
+
+def qr(a) -> Tuple[jax.Array, jax.Array]:
+    return jnp.linalg.qr(a)
+
+
+def svd(a, full_matrices: bool = False):
+    return jnp.linalg.svd(a, full_matrices=full_matrices)
+
+
+def rsvd(key, a, k: int, p: int = 10, n_iter: int = 2):
+    """Randomized SVD (raft/linalg/rsvd.cuh): range-finder with ``p``
+    oversampling columns and ``n_iter`` power iterations, then exact SVD
+    of the small projection. Returns (u (m, k), s (k,), vT (k, n))."""
+    m, n = a.shape
+    expects(0 < k <= min(m, n), "bad rsvd rank %d for %s", k, a.shape)
+    l = min(k + p, n)
+    omega = jax.random.normal(key, (n, l), a.dtype)
+    y = hdot(a, omega)                       # (m, l)
+    q, _ = jnp.linalg.qr(y)
+    for _ in range(n_iter):                  # power iterations sharpen the
+        q, _ = jnp.linalg.qr(hdot(a.T, q))   # spectrum separation
+        q, _ = jnp.linalg.qr(hdot(a, q))
+    b = hdot(q.T, a)                         # (l, n)
+    ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    return hdot(q, ub)[:, :k], s[:k], vt[:k]
+
+
+def lstsq(a, b):
+    """Least squares via economy QR (raft/linalg/lstsq.cuh lstsqQR)."""
+    q, r = jnp.linalg.qr(a)
+    return jax.scipy.linalg.solve_triangular(r, hdot(q.T, b), lower=False)
+
+
+def cholesky(a, lower: bool = True) -> jax.Array:
+    return jax.scipy.linalg.cholesky(a, lower=lower)
+
+
+def cholesky_rank_one_update(l, x, alpha: float = 1.0) -> jax.Array:
+    """L' with L'L'ᵀ = LLᵀ + alpha·xxᵀ (raft/linalg/cholesky_r1_update.cuh).
+
+    Classic hyperbolic-rotation update, expressed as a lax.scan over
+    columns (sequential by nature; n is small in every reference use —
+    incremental kernel matrices)."""
+    n = l.shape[0]
+    x = jnp.sqrt(jnp.asarray(alpha, l.dtype)) * x
+
+    def col(carry, j):
+        l, x = carry
+        ljj = l[j, j]
+        r = jnp.sqrt(ljj * ljj + x[j] * x[j])
+        c, s = r / ljj, x[j] / ljj
+        colj = l[:, j]
+        mask = jnp.arange(n) > j
+        new_col = jnp.where(mask, (colj + s * x) / c, colj)
+        new_col = new_col.at[j].set(r)
+        x = jnp.where(mask, c * x - s * new_col, x)
+        return (l.at[:, j].set(new_col), x), None
+
+    (l, _), _ = jax.lax.scan(col, (l, x), jnp.arange(n))
+    return l

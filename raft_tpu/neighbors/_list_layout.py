@@ -1,0 +1,130 @@
+"""Device-side inverted-list layout shared by IVF-Flat and IVF-PQ.
+
+Lists are contiguous row ranges of dense device arrays (the TPU-friendly
+replacement for the reference's grouped-interleaved lists,
+detail/ivf_flat_build.cuh:87-158), optionally with per-list *capacity
+slack* so that `extend` is an O(batch) device scatter instead of a full
+repack (role of the reference's in-place list packing,
+detail/ivf_pq_build.cuh:1550). Rows in [offset + size, offset + capacity)
+are slack: scan kernels and the XLA gather path mask by true size, so
+slack contents are never read.
+
+Everything large stays on device: the only host traffic is O(n_lists)
+size counts. Offsets/sizes live as host numpy so downstream shapes stay
+static under jit.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["count_sizes", "plan_offsets", "scatter_build", "scatter_extend",
+           "gather_dense"]
+
+_ALIGN = 8   # sublane multiple: keeps list starts DMA-friendly
+
+
+def count_sizes(labels: jax.Array, n_lists: int) -> np.ndarray:
+    """Per-list row counts; the single O(n_lists) device→host transfer."""
+    counts = jax.ops.segment_sum(
+        jnp.ones((labels.shape[0],), jnp.int32), labels,
+        num_segments=n_lists)
+    return np.asarray(counts, np.int64)
+
+
+def plan_offsets(sizes: np.ndarray, growth: float = 1.0) -> np.ndarray:
+    """(n_lists+1,) offsets with capacity = align(ceil(size * growth)).
+
+    growth=1.0 → capacities equal aligned sizes (near-dense); growth>1
+    leaves slack so subsequent extends amortize to O(batch).
+    """
+    caps = np.maximum(sizes, np.ceil(sizes * growth)).astype(np.int64)
+    caps = (caps + _ALIGN - 1) // _ALIGN * _ALIGN
+    offsets = np.zeros(len(sizes) + 1, np.int64)
+    np.cumsum(caps, out=offsets[1:])
+    return offsets
+
+
+def _dest_rows(labels: jax.Array, sizes: np.ndarray, offsets: np.ndarray,
+               base_sizes: np.ndarray | None = None) -> jax.Array:
+    """Destination row per input row: offset[l] + base[l] + rank-within-l."""
+    order = jnp.argsort(labels, stable=True)
+    lsort = jnp.take(labels, order)
+    starts = np.zeros(len(sizes), np.int64)
+    if len(sizes) > 1:
+        np.cumsum(sizes[:-1], out=starts[1:])
+    rank = jnp.arange(labels.shape[0], dtype=jnp.int64) - jnp.take(
+        jnp.asarray(starts), lsort)
+    base = offsets[:-1] if base_sizes is None else offsets[:-1] + base_sizes
+    dest_sorted = jnp.take(jnp.asarray(base), lsort) + rank
+    return order, dest_sorted
+
+
+def scatter_build(labels: jax.Array, arrays: Sequence[jax.Array],
+                  fills: Sequence, n_lists: int, growth: float = 1.0
+                  ) -> Tuple[list, np.ndarray, np.ndarray]:
+    """Cluster-sort ``arrays`` into a fresh capacity layout (all on device).
+
+    Returns ([scattered arrays (cap_total, ...)], offsets (n_lists+1,),
+    sizes (n_lists,)).
+    """
+    sizes = count_sizes(labels, n_lists)
+    offsets = plan_offsets(sizes, growth)
+    order, dest = _dest_rows(labels, sizes, offsets)
+    cap_total = int(offsets[-1])
+    out = []
+    for arr, fill in zip(arrays, fills):
+        shape = (cap_total,) + tuple(arr.shape[1:])
+        buf = jnp.full(shape, fill, arr.dtype)
+        out.append(buf.at[dest].set(jnp.take(arr, order, axis=0)))
+    return out, offsets, sizes
+
+
+def scatter_extend(labels: jax.Array, new_arrays: Sequence[jax.Array],
+                   old_arrays: Sequence[jax.Array], fills: Sequence,
+                   offsets: np.ndarray, old_sizes: np.ndarray,
+                   growth: float = 1.0
+                   ) -> Tuple[list, np.ndarray, np.ndarray]:
+    """Append a batch into an existing layout.
+
+    Fits entirely in slack → one O(batch) device scatter per array (the
+    amortized fast path). Any list overflowing its capacity → gather the
+    valid rows dense and rebuild the layout with ``growth`` slack
+    (amortized out when growth > 1).
+    """
+    n_lists = len(old_sizes)
+    add = count_sizes(labels, n_lists)
+    caps = np.diff(offsets)
+    if (old_sizes + add <= caps).all():
+        order, dest = _dest_rows(labels, add, offsets, base_sizes=old_sizes)
+        out = [old.at[dest].set(jnp.take(new, order, axis=0))
+               for old, new in zip(old_arrays, new_arrays)]
+        return out, offsets, old_sizes + add
+
+    # overflow: densify old rows + labels on device, then rebuild
+    old_dense, old_labels = gather_dense(old_arrays, offsets, old_sizes)
+    merged = [jnp.concatenate([o, n]) for o, n in zip(old_dense, new_arrays)]
+    all_labels = jnp.concatenate([old_labels, labels])
+    return scatter_build(all_labels, merged, fills, n_lists, growth)
+
+
+def gather_dense(arrays: Sequence[jax.Array], offsets: np.ndarray,
+                 sizes: np.ndarray) -> Tuple[list, jax.Array]:
+    """Valid rows of a capacity layout, dense and list-ordered (on device).
+
+    Returns ([dense arrays (n, ...)], labels (n,)) — the inverse of
+    scatter_build, used by repacks and serialization.
+    """
+    n = int(sizes.sum())
+    starts = np.zeros(len(sizes), np.int64)
+    if len(sizes) > 1:
+        np.cumsum(sizes[:-1], out=starts[1:])
+    pos = jnp.arange(n, dtype=jnp.int64)
+    list_of = jnp.searchsorted(jnp.asarray(np.cumsum(sizes)), pos,
+                               side="right")
+    rows = (jnp.take(jnp.asarray(offsets[:-1]), list_of)
+            + (pos - jnp.take(jnp.asarray(starts), list_of)))
+    return [jnp.take(a, rows, axis=0) for a in arrays], list_of.astype(jnp.int32)

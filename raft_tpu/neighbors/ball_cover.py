@@ -82,13 +82,16 @@ def build(dataset, n_landmarks: int = 0, metric="sqeuclidean",
 
     idx = ivf_flat.build(dataset, ivf_flat.IndexParams(
         n_lists=n_landmarks, metric=DistanceType.L2Expanded, seed=seed))
-    # per-landmark radius: max member distance (exact, for rigorous bounds)
-    labels = np.repeat(np.arange(idx.n_lists), idx.list_sizes)
+    # per-landmark radius: max member distance (exact, for rigorous
+    # bounds). Physical rows span list *capacities*; slack rows
+    # (source_id -1) are masked out of the max.
+    labels = np.repeat(np.arange(idx.n_lists), np.diff(idx.list_offsets))
     member_d = np.sqrt(np.maximum(np.asarray(
         jnp.sum((idx.data - idx.centers[jnp.asarray(labels)]) ** 2, axis=1)),
         0.0))
+    valid = np.asarray(idx.source_ids) >= 0
     radii = np.zeros(idx.n_lists, np.float32)
-    np.maximum.at(radii, labels, member_d)
+    np.maximum.at(radii, labels[valid], member_d[valid])
     return BallCoverIndex(idx, jnp.asarray(radii), mt)
 
 
@@ -105,9 +108,13 @@ def knn(index: BallCoverIndex, queries, k: int, n_probes: int = 0
     """
     q = jnp.asarray(queries, jnp.float32)
     if n_probes <= 0:
+        from ..core.bitset import Bitset
+
         bf = brute_force.Index(index.ivf.data, index.ivf.data_norms,
                                index.metric)
-        d, loc = brute_force.search(bf, q, k)
+        # capacity-slack rows (source_id -1) must not act as candidates
+        filt = Bitset.from_mask(index.ivf.source_ids >= 0)
+        d, loc = brute_force.search(bf, q, k, filter=filt)
         ids = jnp.where(loc >= 0,
                         jnp.take(index.ivf.source_ids, jnp.maximum(loc, 0)),
                         -1)
@@ -131,20 +138,24 @@ def eps_nn(index: BallCoverIndex, queries, eps: float
     q = jnp.asarray(queries, jnp.float32)
     m = q.shape[0]
     n = index.size
+    n_phys = index.ivf.data.shape[0]     # includes capacity slack
     # group-level prune (vectorized over (m, landmarks))
     dql = jnp.sqrt(jnp.maximum(pairwise_distance(
         q, index.ivf.centers, "sqeuclidean"), 0.0))
     alive = dql <= (eps + index.radii)[None, :]          # (m, L)
-    # exact distances for members of surviving groups
+    # exact distances for members of surviving groups (physical rows span
+    # list capacities; slack rows masked by source_id)
     labels = jnp.asarray(np.repeat(np.arange(index.ivf.n_lists),
-                                   index.ivf.list_sizes))
+                                   np.diff(index.ivf.list_offsets)))
     row_alive = jnp.take_along_axis(
-        alive, jnp.broadcast_to(labels[None, :], (m, n)), axis=1)
+        alive, jnp.broadcast_to(labels[None, :], (m, n_phys)), axis=1)
     d2 = pairwise_distance(q, index.ivf.data, "sqeuclidean")
-    inside = row_alive & (d2 <= eps * eps)
-    # scatter back to original row order
+    inside = row_alive & (d2 <= eps * eps) & \
+        (index.ivf.source_ids >= 0)[None, :]
+    # scatter back to original row order (OR-scatter: slack rows aim at
+    # column 0 with inside=False and must never clobber a real True)
     adj = jnp.zeros((m, n), bool)
-    adj = adj.at[:, index.ivf.source_ids].set(inside)
+    adj = adj.at[:, jnp.maximum(index.ivf.source_ids, 0)].max(inside)
     return adj, jnp.sum(inside, axis=1).astype(jnp.int32)
 
 

@@ -74,12 +74,20 @@ class IndexParams:
 
 @dataclasses.dataclass
 class SearchParams:
-    """Mirror of cagra::search_params (cagra_types.hpp:113)."""
+    """Mirror of cagra::search_params (cagra_types.hpp:113).
+
+    ``candidate_dtype``: dtype for candidate scoring during traversal —
+    bf16 halves the gather bandwidth of the hot loop (the returned top-k
+    is always re-scored exactly in f32); "float32" scores exactly
+    throughout. ``seed``: RNG seed for the random seed-node init
+    (rand_xor_mask's role, search_plan.cuh)."""
 
     itopk_size: int = 64
     search_width: int = 1          # parents expanded per iteration
     max_iterations: int = 0        # 0 → auto
     num_random_samplings: int = 1  # random seed nodes multiplier
+    candidate_dtype: str = "bfloat16"   # "bfloat16" | "float32"
+    seed: int = 0x5EED
 
 
 @jax.tree_util.register_pytree_node_class
@@ -130,24 +138,31 @@ def build_knn_graph(dataset, k: int, metric=DistanceType.L2Expanded,
     gpu_k = min(n, k * 2 + 1)  # refine_rate=2 + room for the self match
 
     graph = np.zeros((n, k), np.int32)
+    drop_self = jax.jit(partial(_drop_self_pad, k=k, n=n))
     for b0 in range(0, n, batch):
         qb = dataset[b0 : b0 + batch]
         _, cand = ivf_pq_mod.search(index, qb, gpu_k,
                                     ivf_pq_mod.SearchParams(n_probes))
         _, ref = refine_mod.refine(dataset, qb, cand, k + 1, mt)
-        ref = np.asarray(ref)
-        # drop the self column (usually rank 0; fall back to dropping last)
-        rows = np.arange(b0, min(b0 + batch, n))
-        out = np.empty((len(rows), k), np.int32)
-        for r, row in enumerate(rows):
-            # drop self and the -1 padding refine emits when it runs out of
-            # finite candidates; pad by cycling the valid neighbors
-            nb = ref[r][(ref[r] != row) & (ref[r] >= 0)]
-            if len(nb) == 0:
-                nb = np.array([(row + 1) % n], np.int32)
-            out[r] = np.resize(nb, k)
-        graph[rows] = out
+        rows = jnp.arange(b0, min(b0 + batch, n), dtype=jnp.int32)
+        graph[b0 : b0 + batch] = np.asarray(drop_self(ref, rows))
     return graph
+
+
+def _drop_self_pad(ref, rows, *, k: int, n: int):
+    """Per row: first k entries of ``ref`` that are valid and not the row
+    itself, cycling valid neighbors to fill a shortfall ((n+1)%n fallback
+    when empty). Vectorized replacement for the old per-row host loop."""
+    w = ref.shape[1]
+    valid = (ref >= 0) & (ref != rows[:, None])
+    pos = jnp.arange(w, dtype=jnp.int32)
+    order = jnp.argsort(jnp.where(valid, pos, w + pos), axis=1)
+    ref_s = jnp.take_along_axis(ref, order, axis=1)
+    ok_s = jnp.take_along_axis(valid, order, axis=1)
+    n_ok = jnp.sum(ok_s, axis=1, keepdims=True)             # (b, 1)
+    idx = jnp.where(n_ok > 0, pos[None, :k] % jnp.maximum(n_ok, 1), 0)
+    out = jnp.take_along_axis(ref_s, idx, axis=1)
+    return jnp.where(n_ok > 0, out, (rows[:, None] + 1) % n).astype(jnp.int32)
 
 
 def _detour_counts(graph_j, batch_nodes):
@@ -199,6 +214,9 @@ def optimize(knn_graph: np.ndarray, graph_degree: int,
             graph_degree, d0)
     graph_j = jnp.asarray(knn_graph)
 
+    # the detour adjacency intermediate is (B, d0, d0, d0) bools: bound it
+    # to ~1 GB so large intermediate degrees don't blow device memory
+    batch = max(32, min(batch, (1 << 30) // max(d0 ** 3, 1)))
     detours = np.zeros((n, d0), np.int32)
     count_fn = jax.jit(_detour_counts)
     for b0 in range(0, n, batch):
@@ -264,7 +282,9 @@ def build(dataset, params: IndexParams | None = None) -> Index:
 
 
 def _query_dists(qc, vecs, mt):
-    """(m, c, d) candidate vectors → (m, c) distances to qc (m, d)."""
+    """(m, c, d) candidate vectors → (m, c) distances to qc (m, d).
+    bf16 ``vecs`` (the bandwidth-saving traversal mode) accumulate in f32."""
+    vecs = vecs.astype(jnp.float32)
     ip = jnp.einsum("mcd,md->mc", vecs, qc, precision="highest")
     if mt is DistanceType.InnerProduct:
         return -ip
@@ -275,8 +295,11 @@ def _query_dists(qc, vecs, mt):
 
 @partial(jax.jit, static_argnames=("itopk", "width", "max_iter", "k",
                                    "n_seeds", "mt_val"))
-def _search_jit(dataset, graph, qc, mask_bits, seed_key, itopk, width,
-                max_iter, k, n_seeds, mt_val):
+def _search_jit(dataset, dataset_score, graph, qc, mask_bits, seed_key,
+                itopk, width, max_iter, k, n_seeds, mt_val):
+    """``dataset_score`` feeds the traversal's candidate gathers (bf16 in
+    the default bandwidth-saving mode); ``dataset`` (f32) re-scores the
+    final top-k exactly, so returned distances are exact regardless."""
     mt = DistanceType(mt_val)
     m, dim = qc.shape
     n = dataset.shape[0]
@@ -285,7 +308,7 @@ def _search_jit(dataset, graph, qc, mask_bits, seed_key, itopk, width,
     # seed the itopk buffer with random nodes (random_seed init,
     # search_plan.cuh) — score them, fill the rest with +inf
     seeds = jax.random.randint(seed_key, (m, n_seeds), 0, n)
-    seed_vecs = dataset[seeds]
+    seed_vecs = dataset_score[seeds]
     seed_d = _query_dists(qc, seed_vecs, mt)
     if mask_bits is not None:
         seed_d = jnp.where(mask_bits[seeds], seed_d, jnp.inf)
@@ -328,7 +351,7 @@ def _search_jit(dataset, graph, qc, mask_bits, seed_key, itopk, width,
         # dedup within the candidate block (mark later occurrences)
         dup = jnp.tril(cand[:, :, None] == cand[:, None, :], k=-1).any(axis=2)
         cand_ok = cand_ok & ~in_buf & ~dup
-        cvecs = dataset[cand]
+        cvecs = dataset_score[cand]
         cd = _query_dists(qc, cvecs, mt)
         if mask_bits is not None:
             cand_ok = cand_ok & mask_bits[cand]
@@ -347,12 +370,20 @@ def _search_jit(dataset, graph, qc, mask_bits, seed_key, itopk, width,
     state = (buf_i, buf_d, explored, jnp.int32(0))
     buf_i, buf_d, explored, _ = jax.lax.while_loop(cond, body, state)
 
-    out_d, out_i = buf_d[:, :k], buf_i[:, :k]
+    # exact f32 re-score + re-rank of the returned k (fixes any bf16
+    # traversal rounding; one (m, k, d) gather)
+    out_i = buf_i[:, :k]
+    finite = jnp.isfinite(buf_d[:, :k])
+    exact = _query_dists(qc, dataset[jnp.maximum(out_i, 0)], mt)
+    exact = jnp.where(finite, exact, jnp.inf)
+    out_d, order = select_k(exact, k, select_min=True)
+    out_i = jnp.take_along_axis(out_i, order, axis=1)
     if mt is DistanceType.L2SqrtExpanded:
         out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
     elif mt is DistanceType.InnerProduct:
         out_d = jnp.where(jnp.isfinite(out_d), -out_d, -jnp.inf)
-    out_i = jnp.where(jnp.isfinite(buf_d[:, :k]), out_i, -1)
+    out_i = jnp.where(jnp.isfinite(out_d) if mt is not DistanceType.InnerProduct
+                      else out_d > -jnp.inf, out_i, -1)
     return out_d, out_i
 
 
@@ -375,8 +406,16 @@ def search(
     n_seeds = min(itopk, max(width * index.graph_degree // 2,
                              16 * p.num_random_samplings))
     mask_bits = filter.to_mask() if filter is not None else None
-    key = jax.random.key(0x5EED)
-    return _search_jit(index.dataset, index.graph, q, mask_bits, key,
+    key = jax.random.key(p.seed)
+    if p.candidate_dtype in ("bfloat16", "bf16"):
+        # cache the bf16 traversal copy per index object (one cast pass)
+        score = getattr(index, "_score_bf16", None)
+        if score is None:
+            score = index.dataset.astype(jnp.bfloat16)
+            index._score_bf16 = score
+    else:
+        score = index.dataset
+    return _search_jit(index.dataset, score, index.graph, q, mask_bits, key,
                        itopk, width, int(max_iter), k, n_seeds,
                        index.metric.value)
 

@@ -43,7 +43,12 @@ _SERIAL_VERSION = 1
 
 @dataclasses.dataclass
 class IndexParams:
-    """Mirror of ivf_flat::index_params (ivf_flat_types.hpp)."""
+    """Mirror of ivf_flat::index_params (ivf_flat_types.hpp).
+
+    ``list_growth``: per-list capacity slack factor. 1.0 packs lists
+    (aligned) densely; >1 reserves slack so ``extend`` is an O(batch)
+    in-place device scatter until a list overflows (the reference grows
+    lists via conservative_memory_allocation, ivf_flat_types.hpp)."""
 
     n_lists: int = 1024
     metric: DistanceType | str = DistanceType.L2Expanded
@@ -51,6 +56,7 @@ class IndexParams:
     kmeans_trainset_fraction: float = 0.5
     add_data_on_build: bool = True
     seed: int = 0
+    list_growth: float = 1.0
 
 
 @dataclasses.dataclass
@@ -65,9 +71,12 @@ class SearchParams:
 class Index:
     """Cluster-sorted IVF-Flat index.
 
-    ``data``: (n, d) rows sorted by list; ``source_ids``: (n,) original ids;
-    ``list_offsets``: (n_lists+1,) row offsets (host numpy — static under
-    jit); ``centers``: (n_lists, d).
+    ``data``: (cap_total, d) rows sorted by list, with per-list capacity
+    slack (rows in [offset+size, offset+cap) are unread padding);
+    ``source_ids``: (cap_total,) original ids (-1 on slack);
+    ``list_offsets``: (n_lists+1,) capacity offsets (host numpy — static
+    under jit); ``list_sizes_arr``: (n_lists,) true sizes; ``centers``:
+    (n_lists, d).
     """
 
     data: jax.Array
@@ -78,10 +87,13 @@ class Index:
     list_offsets: np.ndarray       # host-side, static
     metric: DistanceType
     conservative_memory: bool = False
+    list_sizes_arr: Optional[np.ndarray] = None  # None → dense (old files)
+    list_growth: float = 1.0
 
     @property
     def size(self) -> int:
-        return self.data.shape[0]
+        """Number of indexed vectors (excludes capacity slack)."""
+        return int(self.list_sizes.sum())
 
     @property
     def dim(self) -> int:
@@ -93,38 +105,40 @@ class Index:
 
     @property
     def list_sizes(self) -> np.ndarray:
+        if self.list_sizes_arr is not None:
+            return self.list_sizes_arr
         return np.diff(self.list_offsets)
 
     def tree_flatten(self):
         leaves = (self.data, self.data_norms, self.source_ids,
                   self.centers, self.center_norms)
         aux = (tuple(self.list_offsets.tolist()), self.metric,
-               self.conservative_memory)
+               self.conservative_memory,
+               None if self.list_sizes_arr is None
+               else tuple(self.list_sizes_arr.tolist()),
+               self.list_growth)
         return leaves, aux
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        offsets, metric, conservative = aux
-        return cls(*leaves, np.asarray(offsets, np.int64), metric, conservative)
-
-
-def _sort_by_list(dataset, labels, source_ids, n_lists):
-    """Cluster-sort rows; returns (data, ids, offsets)."""
-    order = np.argsort(labels, kind="stable")
-    data = dataset[order]
-    ids = source_ids[order]
-    sizes = np.bincount(labels, minlength=n_lists)
-    offsets = np.zeros(n_lists + 1, np.int64)
-    np.cumsum(sizes, out=offsets[1:])
-    return data, ids, offsets
+        offsets, metric, conservative, sizes, growth = aux
+        return cls(*leaves, np.asarray(offsets, np.int64), metric,
+                   conservative,
+                   None if sizes is None else np.asarray(sizes, np.int64),
+                   growth)
 
 
 @tracing.annotate("raft_tpu::ivf_flat::build")
 def build(dataset, params: IndexParams | None = None) -> Index:
     """Train the coarse quantizer on a subsample and fill the lists
-    (detail/ivf_flat_build.cuh:123)."""
+    (detail/ivf_flat_build.cuh:123).
+
+    Device-resident end to end: the dataset never round-trips through the
+    host (only O(n_lists) list sizes do) — the TPU analog of the
+    reference's bounded-batch device build (ivf_pq_build.cuh:1550).
+    """
     p = params or IndexParams()
-    dataset = np.asarray(dataset, np.float32)
+    dataset = jnp.asarray(dataset, jnp.float32)
     n, d = dataset.shape
     mt = canonical_metric(p.metric)
     expects(mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
@@ -139,47 +153,54 @@ def build(dataset, params: IndexParams | None = None) -> Index:
 
     bparams = kmeans_balanced.BalancedKMeansParams(
         n_iters=p.kmeans_n_iters, seed=p.seed)
-    centers = kmeans_balanced.fit(jnp.asarray(trainset), p.n_lists, bparams)
+    centers = kmeans_balanced.fit(trainset, p.n_lists, bparams)
 
-    if not p.add_data_on_build:
-        empty = np.zeros((0, d), np.float32)
-        return Index(
-            jnp.asarray(empty), jnp.zeros((0,), jnp.float32),
-            jnp.zeros((0,), jnp.int32), centers,
-            jnp.sum(centers * centers, axis=1),
-            np.zeros(p.n_lists + 1, np.int64), mt)
-
-    labels, _ = kmeans_balanced.predict(jnp.asarray(dataset), centers)
-    data, ids, offsets = _sort_by_list(
-        dataset, np.asarray(labels), np.arange(n, dtype=np.int32), p.n_lists)
-    data_j = jnp.asarray(data)
-    return Index(
-        data_j, jnp.sum(data_j * data_j, axis=1), jnp.asarray(ids),
-        centers, jnp.sum(centers * centers, axis=1), offsets, mt)
+    index = Index(
+        jnp.zeros((0, d), jnp.float32), jnp.zeros((0,), jnp.float32),
+        jnp.zeros((0,), jnp.int32), centers,
+        jnp.sum(centers * centers, axis=1),
+        np.zeros(p.n_lists + 1, np.int64), mt,
+        list_sizes_arr=np.zeros(p.n_lists, np.int64),
+        list_growth=p.list_growth)
+    if p.add_data_on_build:
+        index = extend(index, dataset)
+    return index
 
 
 @tracing.annotate("raft_tpu::ivf_flat::extend")
 def extend(index: Index, new_vectors, new_ids=None) -> Index:
-    """Add vectors to an existing index (detail/ivf_flat_build.cuh:extend)."""
-    new_vectors = np.asarray(new_vectors, np.float32)
+    """Add vectors to an existing index (detail/ivf_flat_build.cuh:extend).
+
+    O(batch) device scatter while lists have capacity slack; a list
+    overflow triggers a device-side repack with ``list_growth`` slack
+    (no host copies of the dataset either way).
+    """
+    from ._list_layout import scatter_build, scatter_extend
+
+    new_vectors = jnp.asarray(new_vectors, jnp.float32)
     expects(new_vectors.shape[1] == index.dim, "dim mismatch")
+    n_new = new_vectors.shape[0]
     if new_ids is None:
         base = int(index.source_ids.max()) + 1 if index.size else 0
-        new_ids = np.arange(base, base + len(new_vectors), dtype=np.int32)
-    labels, _ = kmeans_balanced.predict(jnp.asarray(new_vectors), index.centers)
+        new_ids = jnp.arange(base, base + n_new, dtype=jnp.int32)
+    else:
+        new_ids = jnp.asarray(new_ids, jnp.int32)
+    labels, _ = kmeans_balanced.predict(new_vectors, index.centers)
+    norms = jnp.sum(new_vectors * new_vectors, axis=1)
 
-    # merge old + new, re-sort (stable: old rows stay ordered within lists)
-    old_data = np.asarray(index.data)
-    old_ids = np.asarray(index.source_ids)
-    old_labels = np.repeat(np.arange(index.n_lists), index.list_sizes)
-    all_data = np.concatenate([old_data, new_vectors])
-    all_ids = np.concatenate([old_ids, np.asarray(new_ids, np.int32)])
-    all_labels = np.concatenate([old_labels, np.asarray(labels)])
-    data, ids, offsets = _sort_by_list(all_data, all_labels, all_ids,
-                                       index.n_lists)
-    data_j = jnp.asarray(data)
-    return Index(data_j, jnp.sum(data_j * data_j, axis=1), jnp.asarray(ids),
-                 index.centers, index.center_norms, offsets, index.metric)
+    fills = (0.0, 0.0, -1)
+    if index.size == 0:
+        (data, dnorms, ids), offsets, sizes = scatter_build(
+            labels, (new_vectors, norms, new_ids), fills, index.n_lists,
+            index.list_growth)
+    else:
+        (data, dnorms, ids), offsets, sizes = scatter_extend(
+            labels, (new_vectors, norms, new_ids),
+            (index.data, index.data_norms, index.source_ids), fills,
+            index.list_offsets, index.list_sizes, index.list_growth)
+    return Index(data, dnorms, ids, index.centers, index.center_norms,
+                 offsets, index.metric, index.conservative_memory,
+                 sizes, index.list_growth)
 
 
 def _probe_budget(list_sizes: np.ndarray, n_probes: int) -> int:
@@ -406,15 +427,27 @@ def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
 
 
 def save(index: Index, path) -> None:
-    """Serialize (analog of ivf_flat_serialize.cuh)."""
+    """Serialize (analog of ivf_flat_serialize.cuh). Capacity slack is
+    stripped: the file holds densely-packed valid rows (v1 layout), so
+    files are slack-free and old readers stay compatible."""
+    from ._list_layout import gather_dense
+
+    sizes = index.list_sizes
+    if index.list_sizes_arr is not None:
+        (data, ids), _ = gather_dense(
+            (index.data, index.source_ids), index.list_offsets, sizes)
+    else:
+        data, ids = index.data, index.source_ids
+    dense_offsets = np.zeros(index.n_lists + 1, np.int64)
+    np.cumsum(sizes, out=dense_offsets[1:])
     save_arrays(
         path, "ivf_flat", _SERIAL_VERSION,
         {"metric": index.metric.value, "n_lists": index.n_lists},
         {
-            "data": index.data,
-            "source_ids": index.source_ids,
+            "data": data,
+            "source_ids": ids,
             "centers": index.centers,
-            "list_offsets": index.list_offsets,
+            "list_offsets": dense_offsets,
         })
 
 
@@ -423,8 +456,9 @@ def load(path) -> Index:
     expects(version == _SERIAL_VERSION, "unsupported version %d", version)
     data = jnp.asarray(arrs["data"])
     centers = jnp.asarray(arrs["centers"])
+    offsets = np.asarray(arrs["list_offsets"], np.int64)
     return Index(
         data, jnp.sum(data * data, axis=1), jnp.asarray(arrs["source_ids"]),
-        centers, jnp.sum(centers * centers, axis=1),
-        np.asarray(arrs["list_offsets"], np.int64),
-        DistanceType(meta["metric"]))
+        centers, jnp.sum(centers * centers, axis=1), offsets,
+        DistanceType(meta["metric"]),
+        list_sizes_arr=np.diff(offsets))

@@ -49,7 +49,7 @@ from ..cluster import kmeans_balanced
 from ..distance.distance_types import DistanceType, canonical_metric
 from ..matrix.select_k import select_k
 from ..utils import cdiv, hdot
-from .ivf_flat import _candidate_rows, _probe_budget, _sort_by_list
+from .ivf_flat import _candidate_rows, _probe_budget
 
 __all__ = ["CodebookGen", "IndexParams", "SearchParams", "Index", "build",
            "extend", "search", "save", "load", "pack_codes", "unpack_codes",
@@ -79,6 +79,9 @@ class IndexParams:
     force_random_rotation: bool = False
     add_data_on_build: bool = True
     seed: int = 0
+    # per-list capacity slack factor: >1 makes extend an O(batch) in-place
+    # device scatter until a list overflows (see neighbors/_list_layout.py)
+    list_growth: float = 1.0
 
 
 @dataclasses.dataclass
@@ -109,14 +112,17 @@ class Index:
     centers_rot: jax.Array
     codebooks: jax.Array
     rotation: jax.Array
-    list_offsets: np.ndarray        # host-side, static
+    list_offsets: np.ndarray        # host-side, static (capacity offsets)
     metric: DistanceType
     pq_bits: int
     codebook_kind: CodebookGen
+    list_sizes_arr: Optional[np.ndarray] = None  # None → dense (old files)
+    list_growth: float = 1.0
 
     @property
     def size(self) -> int:
-        return self.codes.shape[0]
+        """Number of indexed vectors (excludes capacity slack)."""
+        return int(self.list_sizes.sum())
 
     @property
     def dim(self) -> int:
@@ -144,19 +150,27 @@ class Index:
 
     @property
     def list_sizes(self) -> np.ndarray:
+        if self.list_sizes_arr is not None:
+            return self.list_sizes_arr
         return np.diff(self.list_offsets)
 
     def tree_flatten(self):
         leaves = (self.codes, self.source_ids, self.centers_rot,
                   self.codebooks, self.rotation)
         aux = (tuple(self.list_offsets.tolist()), self.metric, self.pq_bits,
-               self.codebook_kind)
+               self.codebook_kind,
+               None if self.list_sizes_arr is None
+               else tuple(self.list_sizes_arr.tolist()),
+               self.list_growth)
         return leaves, aux
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        offsets, metric, pq_bits, kind = aux
-        return cls(*leaves, np.asarray(offsets, np.int64), metric, pq_bits, kind)
+        offsets, metric, pq_bits, kind, sizes, growth = aux
+        return cls(*leaves, np.asarray(offsets, np.int64), metric, pq_bits,
+                   kind,
+                   None if sizes is None else np.asarray(sizes, np.int64),
+                   growth)
 
 
 def _default_pq_dim(dim: int) -> int:
@@ -281,7 +295,7 @@ def build(dataset, params: IndexParams | None = None) -> Index:
     """Train coarse quantizer + rotation + codebooks, then pack the dataset
     (detail/ivf_pq_build.cuh:1729)."""
     p = params or IndexParams()
-    dataset = np.asarray(dataset, np.float32)
+    dataset = jnp.asarray(dataset, jnp.float32)   # device-resident build
     n, dim = dataset.shape
     mt = canonical_metric(p.metric)
     expects(mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
@@ -299,7 +313,7 @@ def build(dataset, params: IndexParams | None = None) -> Index:
     # coarse quantizer on a subsample (ivf_pq_build.cuh:1760-1830)
     n_train = max(p.n_lists, min(n, int(n * p.kmeans_trainset_fraction)))
     stride = max(1, n // n_train)
-    trainset = jnp.asarray(dataset[::stride])
+    trainset = dataset[::stride]
     bparams = kmeans_balanced.BalancedKMeansParams(
         n_iters=p.kmeans_n_iters, seed=p.seed)
     centers = kmeans_balanced.fit(trainset, p.n_lists, bparams)
@@ -324,7 +338,9 @@ def build(dataset, params: IndexParams | None = None) -> Index:
     index = Index(
         jnp.zeros((0, pq_dim), jnp.uint8), jnp.zeros((0,), jnp.int32),
         centers_rot, codebooks, rotation,
-        np.zeros(p.n_lists + 1, np.int64), mt, p.pq_bits, p.codebook_kind)
+        np.zeros(p.n_lists + 1, np.int64), mt, p.pq_bits, p.codebook_kind,
+        list_sizes_arr=np.zeros(p.n_lists, np.int64),
+        list_growth=p.list_growth)
     if p.add_data_on_build:
         index = extend(index, dataset)
     return index
@@ -333,18 +349,28 @@ def build(dataset, params: IndexParams | None = None) -> Index:
 @tracing.annotate("raft_tpu::ivf_pq::extend")
 def extend(index: Index, new_vectors, new_ids=None,
            batch_size: int = 1 << 17) -> Index:
-    """Assign, encode and merge new vectors (ivf_pq_build.cuh:1550)."""
-    new_vectors = np.asarray(new_vectors, np.float32)
+    """Assign, encode and merge new vectors (ivf_pq_build.cuh:1550).
+
+    Device-resident: encoding runs in bounded device batches (host memory
+    stays O(batch)), and the merge is an O(batch) in-place scatter while
+    lists have capacity slack (``IndexParams.list_growth``), else a
+    device-side repack.
+    """
+    from ._list_layout import scatter_build, scatter_extend
+
+    new_vectors = jnp.asarray(new_vectors, jnp.float32)
     expects(new_vectors.shape[1] == index.dim, "dim mismatch")
-    n_new = len(new_vectors)
+    n_new = new_vectors.shape[0]
     if new_ids is None:
         base = int(index.source_ids.max()) + 1 if index.size else 0
-        new_ids = np.arange(base, base + n_new, dtype=np.int32)
+        new_ids = jnp.arange(base, base + n_new, dtype=jnp.int32)
+    else:
+        new_ids = jnp.asarray(new_ids, jnp.int32)
 
     per_cluster = index.codebook_kind is CodebookGen.PER_CLUSTER
     labels_parts, codes_parts = [], []
     for b0 in range(0, n_new, batch_size):
-        xb = jnp.asarray(new_vectors[b0 : b0 + batch_size])
+        xb = new_vectors[b0 : b0 + batch_size]
         xb_rot = hdot(xb, index.rotation.T)
         # nearest rotated center == nearest center (orthogonal rotation)
         d2 = (jnp.sum(xb_rot * xb_rot, axis=1, keepdims=True)
@@ -352,22 +378,26 @@ def extend(index: Index, new_vectors, new_ids=None,
               + jnp.sum(index.centers_rot * index.centers_rot, axis=1)[None, :])
         lb = jnp.argmin(d2, axis=1)
         resid = xb_rot - index.centers_rot[lb]
-        cb = _encode(resid, index.codebooks, lb, per_cluster)
-        labels_parts.append(np.asarray(lb))
-        codes_parts.append(np.asarray(cb))
-    labels = np.concatenate(labels_parts)
-    new_codes = np.concatenate(codes_parts)
+        codes_parts.append(_encode(resid, index.codebooks, lb, per_cluster))
+        labels_parts.append(lb.astype(jnp.int32))
+    labels = (labels_parts[0] if len(labels_parts) == 1
+              else jnp.concatenate(labels_parts))
+    new_codes = (codes_parts[0] if len(codes_parts) == 1
+                 else jnp.concatenate(codes_parts))
 
-    old_labels = np.repeat(np.arange(index.n_lists), index.list_sizes)
-    all_codes = np.concatenate([np.asarray(index.codes), new_codes])
-    all_ids = np.concatenate([np.asarray(index.source_ids),
-                              np.asarray(new_ids, np.int32)])
-    all_labels = np.concatenate([old_labels, labels])
-    codes, ids, offsets = _sort_by_list(all_codes, all_labels, all_ids,
-                                        index.n_lists)
-    return Index(jnp.asarray(codes), jnp.asarray(ids), index.centers_rot,
-                 index.codebooks, index.rotation, offsets, index.metric,
-                 index.pq_bits, index.codebook_kind)
+    fills = (0, -1)
+    if index.size == 0:
+        (codes, ids), offsets, sizes = scatter_build(
+            labels, (new_codes, new_ids), fills, index.n_lists,
+            index.list_growth)
+    else:
+        (codes, ids), offsets, sizes = scatter_extend(
+            labels, (new_codes, new_ids),
+            (index.codes, index.source_ids), fills,
+            index.list_offsets, index.list_sizes, index.list_growth)
+    return Index(codes, ids, index.centers_rot, index.codebooks,
+                 index.rotation, offsets, index.metric, index.pq_bits,
+                 index.codebook_kind, sizes, index.list_growth)
 
 
 def _scan_penalty(index, mask_bits, lmax: int):
@@ -590,8 +620,10 @@ def reconstruct(index: Index, row_ids) -> jax.Array:
     """Decode rows back to (approximate) input-space vectors
     (ivf_pq helpers reconstruct_list_data, detail/ivf_pq_build.cuh)."""
     row_ids = jnp.asarray(row_ids, jnp.int32)
+    # physical row → list id via *capacity* spans (slack-aware)
     labels = jnp.asarray(
-        np.repeat(np.arange(index.n_lists), index.list_sizes))[row_ids]
+        np.repeat(np.arange(index.n_lists),
+                  np.diff(index.list_offsets)))[row_ids]
     codes = index.codes[row_ids].astype(jnp.int32)      # (r, pq_dim)
     if index.codebook_kind is CodebookGen.PER_CLUSTER:
         books = index.codebooks[labels]                 # (r, book, pq_len)
@@ -627,19 +659,30 @@ def unpack_codes(packed: np.ndarray, pq_dim: int, pq_bits: int) -> np.ndarray:
 
 
 def save(index: Index, path) -> None:
-    """Serialize (analog of detail/ivf_pq_serialize.cuh)."""
+    """Serialize (analog of detail/ivf_pq_serialize.cuh). Capacity slack is
+    stripped: files hold densely-packed valid rows only."""
+    from ._list_layout import gather_dense
+
+    sizes = index.list_sizes
+    if index.list_sizes_arr is not None:
+        (codes, ids), _ = gather_dense(
+            (index.codes, index.source_ids), index.list_offsets, sizes)
+    else:
+        codes, ids = index.codes, index.source_ids
+    dense_offsets = np.zeros(index.n_lists + 1, np.int64)
+    np.cumsum(sizes, out=dense_offsets[1:])
     save_arrays(
         path, "ivf_pq", _SERIAL_VERSION,
         {"metric": index.metric.value, "pq_bits": index.pq_bits,
          "codebook_kind": index.codebook_kind.value,
          "pq_dim": index.pq_dim},
         {
-            "codes": pack_codes(np.asarray(index.codes), index.pq_bits),
-            "source_ids": index.source_ids,
+            "codes": pack_codes(np.asarray(codes), index.pq_bits),
+            "source_ids": ids,
             "centers_rot": index.centers_rot,
             "codebooks": index.codebooks,
             "rotation": index.rotation,
-            "list_offsets": index.list_offsets,
+            "list_offsets": dense_offsets,
         })
 
 
@@ -647,10 +690,11 @@ def load(path) -> Index:
     _, version, meta, arrs = load_arrays(path, "ivf_pq")
     expects(version == _SERIAL_VERSION, "unsupported version %d", version)
     codes = unpack_codes(arrs["codes"], meta["pq_dim"], meta["pq_bits"])
+    offsets = np.asarray(arrs["list_offsets"], np.int64)
     return Index(
         jnp.asarray(codes), jnp.asarray(arrs["source_ids"]),
         jnp.asarray(arrs["centers_rot"]), jnp.asarray(arrs["codebooks"]),
-        jnp.asarray(arrs["rotation"]),
-        np.asarray(arrs["list_offsets"], np.int64),
+        jnp.asarray(arrs["rotation"]), offsets,
         DistanceType(meta["metric"]), meta["pq_bits"],
-        CodebookGen(meta["codebook_kind"]))
+        CodebookGen(meta["codebook_kind"]),
+        list_sizes_arr=np.diff(offsets))

@@ -114,7 +114,9 @@ def build_ivf_flat(dataset, mesh: Mesh,
     norms = _stack_pad([np.asarray(s.data_norms) for s in shards])
     # rebase local ids to global row numbers
     gids = _stack_pad(
-        [np.asarray(s.source_ids) + parts[i][0] for i, s in enumerate(shards)],
+        [np.where(np.asarray(s.source_ids) >= 0,
+                   np.asarray(s.source_ids) + parts[i][0], -1)
+         for i, s in enumerate(shards)],
         pad_value=-1)
     centers = np.stack([np.asarray(s.centers) for s in shards])
     cnorms = np.stack([np.asarray(s.center_norms) for s in shards])
@@ -230,7 +232,8 @@ def search_cagra(index: ShardedCagra, queries, k: int,
         # random seeding can't surface them
         valid = jnp.arange(data.shape[1], dtype=jnp.int32) < count[0]
         d, i = cagra._search_jit(
-            data[0], graph[0], qq, valid, jax.random.key(0x5EED), itopk,
+            data[0], data[0], graph[0], qq, valid,
+            jax.random.key(sp.seed), itopk,
             width, int(max_iter), k, n_seeds, mt.value)
         gi = jnp.where(i >= 0, i + base[0], -1)
         bad = jnp.inf if select_min else -jnp.inf
@@ -297,7 +300,9 @@ def build_ivf_pq(dataset, mesh: Mesh,
 
     codes = _stack_pad([np.asarray(s.codes) for s in shards])
     gids = _stack_pad(
-        [np.asarray(s.source_ids) + parts[i][0] for i, s in enumerate(shards)],
+        [np.where(np.asarray(s.source_ids) >= 0,
+                   np.asarray(s.source_ids) + parts[i][0], -1)
+         for i, s in enumerate(shards)],
         pad_value=-1)
     centers = np.stack([np.asarray(s.centers_rot) for s in shards])
     books = np.stack([np.asarray(s.codebooks) for s in shards])

@@ -14,14 +14,14 @@ here is the API surface + the dataset generators the bench harness and
 tests consume.
 """
 from .rng import (RngState, bernoulli, discrete, exponential, gumbel,
-                  laplace, lognormal, logistic, normal, permute, rayleigh,
-                  sample_without_replacement, scaled_bernoulli, uniform,
-                  uniform_int)
+                  laplace, lognormal, logistic, multivariable_gaussian,
+                  normal, permute, rayleigh, sample_without_replacement,
+                  scaled_bernoulli, uniform, uniform_int)
 from .datagen import make_blobs, make_regression, rmat_rectangular_generator
 
 __all__ = [
     "RngState", "uniform", "uniform_int", "normal", "bernoulli",
     "scaled_bernoulli", "gumbel", "lognormal", "logistic", "exponential",
     "rayleigh", "laplace", "discrete", "sample_without_replacement",
-    "permute", "make_blobs", "make_regression", "rmat_rectangular_generator",
+    "permute", "multivariable_gaussian", "make_blobs", "make_regression", "rmat_rectangular_generator",
 ]

@@ -12,7 +12,7 @@ __all__ = [
     "RngState", "uniform", "uniform_int", "normal", "bernoulli",
     "scaled_bernoulli", "gumbel", "lognormal", "logistic", "exponential",
     "rayleigh", "laplace", "discrete", "sample_without_replacement",
-    "permute",
+    "permute", "multivariable_gaussian",
 ]
 
 
@@ -131,3 +131,16 @@ def sample_without_replacement(
 def permute(rng, n: int) -> jax.Array:
     """Random permutation of [0, n) (permute.cuh)."""
     return jax.random.permutation(_key_of(rng), n).astype(jnp.int32)
+
+
+def multivariable_gaussian(rng, n_samples: int, mean, cov) -> jax.Array:
+    """(n_samples, d) draws from N(mean, cov)
+    (random/multi_variable_gaussian.cuh — the reference factors cov with
+    cuSOLVER and multiplies; here the same via jax.random's internal
+    Cholesky path)."""
+    mean = jnp.asarray(mean, jnp.float32)
+    cov = jnp.asarray(cov, jnp.float32)
+    expects(mean.ndim == 1 and cov.shape == (mean.shape[0], mean.shape[0]),
+            "bad mean/cov shapes %s %s", mean.shape, cov.shape)
+    return jax.random.multivariate_normal(
+        _key_of(rng), mean, cov, shape=(n_samples,), dtype=jnp.float32)

@@ -19,11 +19,13 @@ from .csr import CSR
 from .linalg import degree, row_norm, sddmm, spmm, symmetrize, transpose
 from .distance import pairwise_distance as sparse_pairwise_distance
 from .neighbors import brute_force_knn as sparse_brute_force_knn
-from .neighbors import knn_graph
+from .neighbors import cross_component_nn, knn_graph
+from .op import coalesce, filter_entries, remove_zeros, row_op, sort_coo
 from .solver import lanczos_smallest, mst
 
 __all__ = [
     "COO", "CSR", "degree", "row_norm", "spmm", "sddmm", "symmetrize",
     "transpose", "sparse_pairwise_distance", "sparse_brute_force_knn",
-    "knn_graph", "mst", "lanczos_smallest",
+    "knn_graph", "cross_component_nn", "mst", "lanczos_smallest",
+    "filter_entries", "remove_zeros", "coalesce", "row_op", "sort_coo",
 ]

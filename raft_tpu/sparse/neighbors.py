@@ -15,7 +15,7 @@ from .coo import COO
 from .csr import CSR
 from .distance import pairwise_distance
 
-__all__ = ["brute_force_knn", "knn_graph"]
+__all__ = ["brute_force_knn", "knn_graph", "cross_component_nn"]
 
 
 def brute_force_knn(x: CSR, y: CSR, k: int, metric="sqeuclidean",
@@ -33,6 +33,52 @@ def brute_force_knn(x: CSR, y: CSR, k: int, metric="sqeuclidean",
         outs_d.append(dv)
         outs_i.append(di)
     return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+
+
+def cross_component_nn(x, labels, tile_rows: int = 4096):
+    """Nearest neighbor of every point in a *different* component
+    (role of sparse/neighbors/cross_component_nn.cuh, the
+    FixConnectivitiesRedOp engine behind connect_components): one masked
+    tiled L2 scan instead of a per-component search loop.
+
+    ``x``: (n, d) dense rows or a CSR (densified up front — the left
+    operand of every tile's matmul needs all rows; ``tile_rows`` bounds
+    only the (n, tile) distance block);
+    ``labels``: (n,) component id per point (any integer coloring).
+    Returns (dists (n,) squared L2, idx (n,)) — idx = -1 when a point's
+    component spans the whole set.
+    """
+    dense = x.to_dense() if isinstance(x, CSR) else jnp.asarray(x, jnp.float32)
+    labels = jnp.asarray(labels)
+    n = dense.shape[0]
+    norms = jnp.sum(dense * dense, axis=1)
+    n_pad = -(-n // tile_rows) * tile_rows
+    xp = jnp.pad(dense, ((0, n_pad - n), (0, 0)))
+    np_norms = jnp.pad(norms, (0, n_pad - n))
+    lp = jnp.pad(labels, (0, n_pad - n), constant_values=-1)
+    tiles = n_pad // tile_rows
+
+    def step(carry, inp):
+        best_d, best_i = carry
+        xt, nt, lt, base = inp
+        cross = jnp.matmul(dense, xt.T, precision="highest")
+        d = jnp.maximum(norms[:, None] + nt[None, :] - 2.0 * cross, 0.0)
+        bad = (labels[:, None] == lt[None, :]) | (lt[None, :] < 0)
+        d = jnp.where(bad, jnp.inf, d)
+        tmin = jnp.min(d, axis=1)
+        targ = jnp.argmin(d, axis=1) + base
+        better = tmin < best_d
+        return (jnp.where(better, tmin, best_d),
+                jnp.where(better, targ, best_i)), None
+
+    init = (jnp.full((n,), jnp.inf, jnp.float32),
+            jnp.full((n,), -1, jnp.int32))
+    xs = (xp.reshape(tiles, tile_rows, -1),
+          np_norms.reshape(tiles, tile_rows),
+          lp.reshape(tiles, tile_rows),
+          jnp.arange(tiles, dtype=jnp.int32) * tile_rows)
+    (d, i), _ = jax.lax.scan(step, init, xs)
+    return d, i
 
 
 def knn_graph(x: CSR, k: int, metric="sqeuclidean") -> COO:

@@ -1,0 +1,78 @@
+"""Sparse element/structure ops: analog of ``raft/sparse/op/``.
+
+Reference surface: filter (drop entries, sparse/op/filter.cuh), reduce
+(coalesce duplicate coordinates, sparse/op/reduce.cuh), row_op (per-row
+transform, sparse/op/row_op.cuh), sort (canonical row-major entry order,
+sparse/op/sort.cuh), slice (sparse/op/slice.cuh — lives as
+``CSR.slice_rows``).
+
+TPU design note: entry lists are dense 1-D arrays, so every op here is a
+sort/segment/mask composition — no scalar loops. Ops that change nnz
+(``filter_entries``, ``coalesce``) return host-sized results and are
+host-eager (nnz is a *shape*, necessarily static under jit); callers
+inside jit should filter by writing explicit zeros instead.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .coo import COO
+
+__all__ = ["filter_entries", "remove_zeros", "coalesce", "row_op", "sort_coo"]
+
+
+def filter_entries(m: COO, keep: Callable[[jax.Array, jax.Array, jax.Array],
+                                          jax.Array]) -> COO:
+    """Keep entries where ``keep(rows, cols, vals)`` is True
+    (sparse/op/filter.cuh). Changes nnz → host-eager."""
+    mask = np.asarray(keep(m.rows, m.cols, m.vals))
+    rows = np.asarray(m.rows)[mask]
+    cols = np.asarray(m.cols)[mask]
+    vals = np.asarray(m.vals)[mask]
+    return COO(jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(vals),
+               m.shape)
+
+
+def remove_zeros(m: COO, eps: float = 0.0) -> COO:
+    """Drop |val| <= eps entries (the reference's remove_zeros filter)."""
+    return filter_entries(m, lambda r, c, v: jnp.abs(v) > eps)
+
+
+def coalesce(m: COO, op: str = "add") -> COO:
+    """Merge duplicate (row, col) entries (sparse/op/reduce.cuh
+    max_duplicates): sort by coordinate, segment-reduce runs.
+    op: "add" | "max" | "min"."""
+    key = np.asarray(m.rows).astype(np.int64) * m.shape[1] + np.asarray(m.cols)
+    order = np.argsort(key, kind="stable")
+    key_s = key[order]
+    uniq, seg = np.unique(key_s, return_inverse=True)
+    vals_s = jnp.take(m.vals, jnp.asarray(order))
+    seg_j = jnp.asarray(seg)
+    if op == "add":
+        vals = jax.ops.segment_sum(vals_s, seg_j, num_segments=len(uniq))
+    elif op == "max":
+        vals = jax.ops.segment_max(vals_s, seg_j, num_segments=len(uniq))
+    elif op == "min":
+        vals = jax.ops.segment_min(vals_s, seg_j, num_segments=len(uniq))
+    else:
+        raise ValueError(f"unknown coalesce op {op!r}")
+    rows = jnp.asarray((uniq // m.shape[1]).astype(np.int32))
+    cols = jnp.asarray((uniq % m.shape[1]).astype(np.int32))
+    return COO(rows, cols, vals, m.shape)
+
+
+def row_op(m: COO, fn: Callable[[jax.Array, jax.Array], jax.Array]) -> COO:
+    """Apply ``fn(vals, row_ids)`` per entry with its row id available
+    (sparse/op/row_op.cuh — e.g. row scaling/softmax-style transforms).
+    jit-safe: nnz unchanged."""
+    return COO(m.rows, m.cols, fn(m.vals, m.rows), m.shape)
+
+
+def sort_coo(m: COO) -> COO:
+    """Canonical row-major entry order (sparse/op/sort.cuh). Two stable
+    argsorts, not an n*r+c key — int64 keys truncate with x64 disabled."""
+    return m.sorted_by_row()

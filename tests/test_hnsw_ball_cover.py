@@ -91,7 +91,9 @@ class TestBallCover:
     def test_radii_cover_members(self, dataset):
         index = ball_cover.build(dataset, n_landmarks=16)
         labels = np.repeat(np.arange(index.ivf.n_lists),
-                           index.ivf.list_sizes)
+                           np.diff(index.ivf.list_offsets))
         d = np.sqrt(((np.asarray(index.ivf.data) -
                       np.asarray(index.ivf.centers)[labels]) ** 2).sum(1))
+        valid = np.asarray(index.ivf.source_ids) >= 0
+        labels, d = labels[valid], d[valid]
         assert (d <= np.asarray(index.radii)[labels] + 1e-4).all()

@@ -33,9 +33,14 @@ class TestIvfFlat:
         sizes = built_index.list_sizes
         assert sizes.sum() == len(dataset)
         assert sizes.min() > 0
-        # every source id appears exactly once
-        ids = np.sort(np.asarray(built_index.source_ids))
-        np.testing.assert_array_equal(ids, np.arange(len(dataset)))
+        # every source id appears exactly once on valid rows; capacity
+        # slack rows carry the -1 sentinel
+        ids = np.asarray(built_index.source_ids)
+        valid = ids[ids >= 0]
+        np.testing.assert_array_equal(np.sort(valid),
+                                      np.arange(len(dataset)))
+        caps = np.diff(built_index.list_offsets)
+        assert (caps >= sizes).all()
 
     # NOTE: thresholds calibrated on unstructured gaussian data, where probing
     # 8/64 lists gives ~0.56 *upper-bound* recall (partition-limited, verified
@@ -83,6 +88,50 @@ class TestIvfFlat:
                                  params=ivf_flat.SearchParams(n_probes=16))
         _, want = naive_knn(dataset, queries, 10)
         assert calc_recall(np.asarray(idx), want) > 0.9
+
+    def test_extend_in_place_with_growth_slack(self, dataset, queries):
+        # growth=2: the second half fits in slack, so extend keeps the SAME
+        # offsets (the O(batch) in-place scatter path)
+        p = ivf_flat.IndexParams(n_lists=32, seed=0, list_growth=2.0)
+        index = ivf_flat.build(dataset[:10_000], p)
+        off0 = index.list_offsets.copy()
+        index2 = ivf_flat.extend(index, dataset[10_000:13_000],
+                                 np.arange(10_000, 13_000, dtype=np.int32))
+        np.testing.assert_array_equal(index2.list_offsets, off0)
+        assert index2.size == 13_000
+        _, idx = ivf_flat.search(index2, queries, k=10,
+                                 params=ivf_flat.SearchParams(n_probes=16))
+        _, want = naive_knn(dataset[:13_000], queries, 10)
+        assert calc_recall(np.asarray(idx), want) > 0.85
+
+    def test_extend_overflow_repacks(self, dataset, queries):
+        # growth=1: slack is only alignment, so a large extend overflows
+        # and triggers the device-side repack; results stay correct
+        index = ivf_flat.build(dataset[:10_000],
+                               ivf_flat.IndexParams(n_lists=32, seed=0))
+        index2 = ivf_flat.extend(index, dataset[10_000:],
+                                 np.arange(10_000, 20_000, dtype=np.int32))
+        assert index2.size == 20_000
+        ids = np.asarray(index2.source_ids)
+        np.testing.assert_array_equal(np.sort(ids[ids >= 0]),
+                                      np.arange(20_000))
+        _, idx = ivf_flat.search(index2, queries, k=10,
+                                 params=ivf_flat.SearchParams(n_probes=16))
+        _, want = naive_knn(dataset, queries, 10)
+        assert calc_recall(np.asarray(idx), want) > 0.9
+
+    def test_save_strips_slack(self, dataset, tmp_path, queries):
+        p = ivf_flat.IndexParams(n_lists=32, seed=0, list_growth=2.0)
+        index = ivf_flat.build(dataset[:5000], p)
+        ivf_flat.save(index, tmp_path / "slack.raft")
+        loaded = ivf_flat.load(tmp_path / "slack.raft")
+        assert loaded.size == 5000
+        assert loaded.data.shape[0] == 5000    # dense file, no slack
+        d1, i1 = ivf_flat.search(index, queries, 5,
+                                 ivf_flat.SearchParams(n_probes=32))
+        d2, i2 = ivf_flat.search(loaded, queries, 5,
+                                 ivf_flat.SearchParams(n_probes=32))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
     def test_build_empty_then_extend(self, dataset, queries):
         p = ivf_flat.IndexParams(n_lists=32, add_data_on_build=False, seed=0)

@@ -36,8 +36,9 @@ class TestIvfPq:
         assert built_index.pq_len == 4
         assert built_index.rot_dim == 32
         assert built_index.list_sizes.sum() == len(dataset)
-        ids = np.sort(np.asarray(built_index.source_ids))
-        np.testing.assert_array_equal(ids, np.arange(len(dataset)))
+        ids = np.asarray(built_index.source_ids)
+        np.testing.assert_array_equal(np.sort(ids[ids >= 0]),
+                                      np.arange(len(dataset)))
         # rotation has orthonormal columns
         r = np.asarray(built_index.rotation)
         np.testing.assert_allclose(r.T @ r, np.eye(32), atol=1e-5)
@@ -129,6 +130,21 @@ class TestIvfPq:
         _, idx = ivf_pq.search(index, queries, k=10,
                                params=ivf_pq.SearchParams(32))
         _, want = naive_knn(dataset, queries, 10)
+        assert calc_recall(np.asarray(idx), want) >= 0.45
+
+    def test_extend_in_place_with_growth_slack(self, dataset, queries):
+        p = ivf_pq.IndexParams(n_lists=32, pq_dim=8, seed=0,
+                               list_growth=2.0)
+        index = ivf_pq.build(dataset[:10_000], p)
+        off0 = index.list_offsets.copy()
+        index2 = ivf_pq.extend(index, dataset[10_000:13_000],
+                               np.arange(10_000, 13_000, dtype=np.int32))
+        # fits in slack: same offsets, O(batch) in-place scatter
+        np.testing.assert_array_equal(index2.list_offsets, off0)
+        assert index2.size == 13_000
+        _, idx = ivf_pq.search(index2, queries, k=10,
+                               params=ivf_pq.SearchParams(32))
+        _, want = naive_knn(dataset[:13_000], queries, 10)
         assert calc_recall(np.asarray(idx), want) >= 0.45
 
     def test_filter(self, built_index, dataset, queries):

@@ -1,0 +1,177 @@
+"""Tests for the linalg façade (SURVEY §2.6), sparse op module, sparse
+cross-component NN (§2.8) and the random long tail (§2.9)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import linalg
+from raft_tpu.sparse import (COO, CSR, coalesce, cross_component_nn,
+                             filter_entries, remove_zeros, row_op, sort_coo)
+
+
+class TestLinalg:
+    def test_gemm_gemv_axpy(self, rng):
+        a = rng.standard_normal((16, 8)).astype(np.float32)
+        b = rng.standard_normal((8, 4)).astype(np.float32)
+        c = rng.standard_normal((16, 4)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(linalg.gemm(a, b, 2.0, 3.0, c)),
+                                   2.0 * a @ b + 3.0 * c, rtol=1e-5)
+        x = rng.standard_normal(8).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(linalg.gemv(a, x)), a @ x,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(linalg.axpy(2.0, x, x)), 3 * x,
+                                   rtol=1e-6)
+
+    def test_factorizations(self, rng):
+        a = rng.standard_normal((12, 12)).astype(np.float32)
+        sym = a @ a.T + 12 * np.eye(12, dtype=np.float32)
+        w, v = linalg.eig(sym)
+        np.testing.assert_allclose(np.asarray(v @ jnp.diag(w) @ v.T), sym,
+                                   rtol=1e-3, atol=1e-3)
+        q, r = linalg.qr(a)
+        np.testing.assert_allclose(np.asarray(q @ r), a, rtol=1e-4, atol=1e-4)
+        u, s, vt = linalg.svd(a)
+        np.testing.assert_allclose(np.asarray(u * s @ vt), a, rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_rsvd_matches_svd_spectrum(self, rng):
+        # low-rank + noise: rsvd top-k singular values track exact SVD
+        u = rng.standard_normal((100, 5)).astype(np.float32)
+        v = rng.standard_normal((5, 60)).astype(np.float32)
+        a = u @ v + 0.01 * rng.standard_normal((100, 60)).astype(np.float32)
+        _, s_exact, _ = np.linalg.svd(a, full_matrices=False)
+        ur, sr, vtr = linalg.rsvd(jax.random.PRNGKey(0), jnp.asarray(a), k=5)
+        np.testing.assert_allclose(np.asarray(sr), s_exact[:5], rtol=1e-2)
+        approx = np.asarray(ur * sr @ vtr)
+        assert np.linalg.norm(approx - a) / np.linalg.norm(a) < 0.05
+
+    def test_lstsq(self, rng):
+        a = rng.standard_normal((50, 8)).astype(np.float32)
+        x_true = rng.standard_normal(8).astype(np.float32)
+        b = a @ x_true
+        x = np.asarray(linalg.lstsq(jnp.asarray(a), jnp.asarray(b)))
+        np.testing.assert_allclose(x, x_true, rtol=1e-3, atol=1e-3)
+
+    def test_cholesky_rank_one_update(self, rng):
+        a = rng.standard_normal((6, 6)).astype(np.float32)
+        sym = a @ a.T + 6 * np.eye(6, dtype=np.float32)
+        x = rng.standard_normal(6).astype(np.float32)
+        l = np.linalg.cholesky(sym)
+        l2 = np.asarray(linalg.cholesky_rank_one_update(
+            jnp.asarray(l), jnp.asarray(x), alpha=0.5))
+        np.testing.assert_allclose(l2 @ l2.T, sym + 0.5 * np.outer(x, x),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_norms_and_reductions(self, rng):
+        a = rng.standard_normal((10, 4)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(linalg.norm(a, -2, axis=1)),
+                                   (a * a).sum(1), rtol=1e-5)
+        nrm = np.asarray(linalg.normalize(a))
+        np.testing.assert_allclose(np.linalg.norm(nrm, axis=1),
+                                   np.ones(10), rtol=1e-5)
+        keys = jnp.asarray([0, 1, 0, 1, 2, 2, 0, 1, 2, 0])
+        out = np.asarray(linalg.reduce_rows_by_key(jnp.asarray(a), keys, 3))
+        want = np.stack([a[np.asarray(keys) == i].sum(0) for i in range(3)])
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+class TestSparseOps:
+    def _coo(self, rng, shape=(20, 30), nnz=80):
+        r = rng.integers(0, shape[0], nnz).astype(np.int32)
+        c = rng.integers(0, shape[1], nnz).astype(np.int32)
+        v = rng.standard_normal(nnz).astype(np.float32)
+        return COO(jnp.asarray(r), jnp.asarray(c), jnp.asarray(v), shape)
+
+    def test_filter_and_remove_zeros(self, rng):
+        m = self._coo(rng)
+        vals = np.asarray(m.vals).copy()
+        vals[::3] = 0.0
+        m = COO(m.rows, m.cols, jnp.asarray(vals), m.shape)
+        out = remove_zeros(m)
+        assert out.nnz == int((vals != 0).sum())
+        pos = filter_entries(m, lambda r, c, v: v > 0)
+        assert (np.asarray(pos.vals) > 0).all()
+
+    def test_coalesce_matches_scipy(self, rng):
+        import scipy.sparse as sps
+
+        m = self._coo(rng, nnz=200)  # dense dupes at 20x30
+        ref = sps.coo_matrix(
+            (np.asarray(m.vals), (np.asarray(m.rows), np.asarray(m.cols))),
+            shape=m.shape)
+        out = coalesce(m, op="add")
+        np.testing.assert_allclose(np.asarray(out.to_dense()),
+                                   ref.toarray(), rtol=1e-5, atol=1e-6)
+
+    def test_row_op_and_sort(self, rng):
+        m = self._coo(rng)
+        doubled = row_op(m, lambda v, r: v * 2.0)
+        np.testing.assert_allclose(np.asarray(doubled.vals),
+                                   np.asarray(m.vals) * 2, rtol=1e-6)
+        s = sort_coo(m)
+        key = np.asarray(s.rows).astype(np.int64) * m.shape[1] + np.asarray(s.cols)
+        assert (np.diff(key) >= 0).all()
+
+
+class TestCrossComponentNN:
+    def test_nearest_other_component(self, rng):
+        # two well-separated blobs: every point's cross-component NN must be
+        # in the other blob, and the component-min edge bridges the gap
+        a = rng.standard_normal((40, 8)).astype(np.float32)
+        b = rng.standard_normal((30, 8)).astype(np.float32) + 50.0
+        x = np.concatenate([a, b])
+        labels = np.array([0] * 40 + [1] * 30)
+        d, i = cross_component_nn(jnp.asarray(x), jnp.asarray(labels))
+        i = np.asarray(i)
+        assert (i[:40] >= 40).all() and (i[40:] < 40).all()
+        # distances are true squared L2 to the reported neighbor
+        d = np.asarray(d)
+        row = 3
+        np.testing.assert_allclose(d[row], ((x[row] - x[i[row]]) ** 2).sum(),
+                                   rtol=1e-3)
+
+    def test_csr_input(self, rng):
+        x = rng.standard_normal((30, 6)).astype(np.float32)
+        labels = np.arange(30) % 3
+        d1, i1 = cross_component_nn(jnp.asarray(x), jnp.asarray(labels))
+        d2, i2 = cross_component_nn(CSR.from_dense(x), jnp.asarray(labels))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_single_component_returns_sentinel(self, rng):
+        x = rng.standard_normal((10, 4)).astype(np.float32)
+        d, i = cross_component_nn(jnp.asarray(x), jnp.zeros(10, np.int32))
+        assert (np.asarray(i) == -1).all()
+        assert np.isinf(np.asarray(d)).all()
+
+
+class TestKernelGramCSR:
+    def test_csr_matches_dense(self, rng):
+        from raft_tpu.distance.kernels import KernelParams, KernelType, gram_matrix
+
+        x = rng.standard_normal((25, 10)).astype(np.float32)
+        x[rng.random((25, 10)) < 0.6] = 0.0
+        y = rng.standard_normal((15, 10)).astype(np.float32)
+        for kt in KernelType:
+            p = KernelParams(kernel=kt, gamma=0.3, coef0=0.5, degree=2)
+            kd = np.asarray(gram_matrix(jnp.asarray(x), jnp.asarray(y), p))
+            ks = np.asarray(gram_matrix(CSR.from_dense(x), jnp.asarray(y), p))
+            np.testing.assert_allclose(ks, kd, rtol=1e-4, atol=1e-5)
+        # tiled CSR path
+        p = KernelParams(kernel=KernelType.RBF, gamma=0.3)
+        kt_ = np.asarray(gram_matrix(CSR.from_dense(x), jnp.asarray(y), p,
+                                     tile_rows=8))
+        kd = np.asarray(gram_matrix(jnp.asarray(x), jnp.asarray(y), p))
+        np.testing.assert_allclose(kt_, kd, rtol=1e-4, atol=1e-5)
+
+
+class TestMultivariableGaussian:
+    def test_moments(self):
+        from raft_tpu.random import RngState, multivariable_gaussian
+
+        mean = np.array([1.0, -2.0, 0.5], np.float32)
+        a = np.array([[2.0, 0.3, 0.0], [0.3, 1.0, 0.2], [0.0, 0.2, 0.5]],
+                     np.float32)
+        draws = np.asarray(multivariable_gaussian(RngState(0), 20000, mean, a))
+        np.testing.assert_allclose(draws.mean(0), mean, atol=0.05)
+        np.testing.assert_allclose(np.cov(draws.T), a, atol=0.1)
