@@ -1,11 +1,27 @@
 #!/usr/bin/env python
 """Headline benchmark: prints ONE JSON line for the driver.
 
-Metric: brute-force kNN QPS on a SIFT-like synthetic workload (L2, k=10),
-the first BASELINE.md config. Will widen to IVF/CAGRA QPS@recall as those
-land. vs_baseline compares against a fixed reference throughput target.
+Measures QPS at recall@10 for the BASELINE.md configs on a SIFT-like
+synthetic corpus (clustered gaussian mixture, 1M x 128 by default —
+IVF probing is partition-limited on *unclustered* gaussian noise, which
+real ANN corpora are not), plus brute-force QPS and an on-device roofline
+probe so kernel throughput is reported against the measured peak of the
+chip actually in use.
+
+Methodology (see raft_tpu/ops/autotune.py): every timing is a median of
+per-call-blocked runs — some backends elide never-awaited dispatches, so
+block-once-after-N under-reports by orders of magnitude. All data is
+generated ON DEVICE (host<->device transfers through remote tunnels are
+slow and would pollute build/search timings); recall is computed on
+device against exact ground truth and only scalars leave the chip.
+
+vs_baseline: reference numbers are *derived A100 estimates* (RAFT 24.02
+publishes Pareto plots, not tables — BASELINE.md): each entry's
+`baseline_qps` carries its derivation in the source below.
 """
 import json
+import os
+import sys
 import time
 
 import jax
@@ -13,49 +29,198 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+# --- derived reference baselines (QPS @ recall@10 = 0.95, batch 10k) -----
+# brute force:  A100 TF32 GEMM ~156 TFLOP/s; 2*n*d = 256 MFLOP/query at
+#               1M x 128 -> ~600k QPS roofline; tiled select_k overhead
+#               ~2x -> 300k.
+# ivf_flat:     probing ~6% of a 1M corpus reads ~30 MB/query; A100 HBM
+#               1.55 TB/s -> ~50k QPS.
+# ivf_pq+refine: same probe fraction over 64B codes = 3.75 MB/query ->
+#               ~400k QPS roofline; LUT + refine overhead ~2x -> 200k.
+# cagra:        published H100 plots put graph search at ~500k-1M QPS
+#               @0.95 for million-scale corpora; use 500k.
+BASELINE_QPS = {
+    "raft_brute_force": 300_000.0,
+    "raft_ivf_flat": 50_000.0,
+    "raft_ivf_pq": 200_000.0,
+    "raft_cagra": 500_000.0,
+}
+
+
+def median_time(fn, *args, reps=5):
+    from raft_tpu.ops.autotune import measure
+
+    return measure(fn, *args, reps=reps)
+
+
+def make_corpus(n, d, nq, n_clusters=2000, seed=0):
+    """Clustered gaussian mixture + queries perturbed from corpus points
+    (the structure real ANN corpora have; all on device)."""
+    kc, kx, ka, kq, kp = jax.random.split(jax.random.PRNGKey(seed), 5)
+    centers = jax.random.normal(kc, (n_clusters, d), jnp.float32) * 4.0
+    assign = jax.random.randint(ka, (n,), 0, n_clusters)
+    data = centers[assign] + jax.random.normal(kx, (n, d), jnp.float32)
+    qrows = jax.random.randint(kq, (nq,), 0, n)
+    queries = data[qrows] + 0.1 * jax.random.normal(kp, (nq, d), jnp.float32)
+    return jax.block_until_ready(data), jax.block_until_ready(queries)
+
+
+def device_recall(ids, gt):
+    """Mean recall@k, computed on device; one scalar leaves the chip."""
+    hit = jnp.any(ids[:, :, None] == gt[:, None, :], axis=2) & (gt >= 0)
+    return float(jnp.sum(hit) / jnp.sum(gt >= 0))
+
+
 def main():
-    from raft_tpu.neighbors import brute_force
+    t_start = time.perf_counter()
+    budget_s = float(os.environ.get("RAFT_TPU_BENCH_BUDGET_S", "2400"))
+    scale = os.environ.get("RAFT_TPU_BENCH_SCALE", "full")
+    n = 1_000_000 if scale == "full" else 100_000
+    d, nq, k = 128, 10_000, 10
 
-    n, d, nq, k = 100_000, 128, 10_000, 10
-    rng = np.random.default_rng(0)
-    dataset = jnp.asarray(rng.standard_normal((n, d), dtype=np.float32))
-    queries = jnp.asarray(rng.standard_normal((nq, d), dtype=np.float32))
+    from raft_tpu.bench import roofline
+    from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq, refine
 
-    index = brute_force.build(dataset, metric="sqeuclidean")
-    # warmup/compile at the measured shape
-    dist, idx = brute_force.search(index, queries, k)
-    jax.block_until_ready((dist, idx))
+    log(f"# corpus: {n}x{d}, {nq} queries, k={k}")
+    data, queries = make_corpus(n, d, nq)
 
+    # ground truth: exact search, f32-accurate GEMM
+    bf = brute_force.build(data, metric="sqeuclidean")
+    gt_fn = jax.jit(lambda q: brute_force.search(bf, q, k, algo="matmul"))
+    _, gt = gt_fn(queries)
+    gt = jax.block_until_ready(gt)
+    log("# ground truth done")
+
+    entries = []
+
+    def add_entry(algo, name, qps, recall, build_s, extra=None):
+        e = {"algo": algo, "name": name, "qps": round(qps, 1),
+             "recall": round(recall, 4), "build_s": round(build_s, 1),
+             "vs_baseline": round(qps / BASELINE_QPS[algo], 3)}
+        if extra:
+            e.update(extra)
+        entries.append(e)
+        log(f"#   {name}: qps={qps:,.0f} recall={recall:.4f}")
+
+    # --- brute force (BASELINE config 1): measured-best engine ----------
+    winner, timings = brute_force.tune_search(bf, queries, k, reps=3)
+    sfn = jax.jit(lambda q: brute_force.search(bf, q, k, algo=winner))
+    dt = median_time(sfn, queries)
+    add_entry("raft_brute_force", f"raft_brute_force.{winner}", nq / dt, 1.0,
+              0.0, {"engine_timings_ms":
+                    {kk: round(v * 1e3, 1) for kk, v in timings.items()}})
+
+    # --- ivf_flat (config 2: n_lists=1024, probe sweep) -----------------
     t0 = time.perf_counter()
-    reps = 10
-    for _ in range(reps):
-        dist, idx = brute_force.search(index, queries, k)
-        jax.block_until_ready((dist, idx))
-    dt = (time.perf_counter() - t0) / reps
-    qps = nq / dt
+    fi = ivf_flat.build(data, ivf_flat.IndexParams(n_lists=1024, seed=0))
+    jax.block_until_ready(jax.tree.leaves(fi))
+    flat_build = time.perf_counter() - t0
+    ivf_flat.prepare_scan(fi)   # scan prep out of the timed search graph
+    log(f"# ivf_flat built in {flat_build:.0f}s")
+    best = None
+    for probes in (20, 50, 100):
+        sp = ivf_flat.SearchParams(n_probes=probes)
+        fn = jax.jit(lambda q, s=sp: ivf_flat.search(fi, q, k, s))
+        dt = median_time(fn, queries)
+        rec = device_recall(fn(queries)[1], gt)
+        add_entry("raft_ivf_flat", f"raft_ivf_flat.nlist1024.nprobe{probes}",
+                  nq / dt, rec, flat_build)
+        if rec >= 0.95 and (best is None or nq / dt > best[0]):
+            best = (nq / dt, rec, f"nprobe{probes}")
+        if rec >= 0.995:
+            break
+    flat_best = best
 
-    # Reference point: RAFT brute-force on A100 is ~O(10k) QPS at this shape;
-    # use 10k QPS as the provisional baseline until the harness regenerates it.
-    baseline_qps = 10_000.0
+    # --- ivf_pq (config 3: pq_dim=64) + refine --------------------------
+    t0 = time.perf_counter()
+    pi = ivf_pq.build(data, ivf_pq.IndexParams(n_lists=1024, pq_dim=64,
+                                               seed=0))
+    jax.block_until_ready(jax.tree.leaves(pi))
+    pq_build = time.perf_counter() - t0
+    ivf_pq.prepare_scan(pi)     # scan prep out of the timed search graph
+    log(f"# ivf_pq built in {pq_build:.0f}s")
+    for probes in (20, 50):
+        sp = ivf_pq.SearchParams(n_probes=probes)
+
+        def pq_refined(q, s=sp):
+            _, cand = ivf_pq.search(pi, q, 2 * k, s)
+            return refine.refine(data, q, cand, k)
+
+        fn = jax.jit(pq_refined)
+        dt = median_time(fn, queries)
+        rec = device_recall(fn(queries)[1], gt)
+        add_entry("raft_ivf_pq",
+                  f"raft_ivf_pq.nlist1024.pq64.nprobe{probes}.refine2",
+                  nq / dt, rec, pq_build)
+        if rec >= 0.995:
+            break
+
+    # --- cagra (config 4: graph_degree=64) ------------------------------
+    elapsed = time.perf_counter() - t_start
+    cagra_n = n if (budget_s - elapsed) > 1200 and scale == "full" else \
+        min(n, 100_000)
+    cagra_env = os.environ.get("RAFT_TPU_BENCH_CAGRA_N")
+    if cagra_env:
+        cagra_n = int(cagra_env)
+    cdata = data[:cagra_n]
+    if cagra_n != n:
+        cgt_fn = jax.jit(lambda q: brute_force.search(
+            brute_force.build(cdata), q, k, algo="matmul"))
+        _, cgt = cgt_fn(queries)
+    else:
+        cgt = gt
+    t0 = time.perf_counter()
+    ci = cagra.build(cdata, cagra.IndexParams(
+        graph_degree=64, intermediate_graph_degree=96, seed=0))
+    jax.block_until_ready(jax.tree.leaves(ci))
+    cagra_build = time.perf_counter() - t0
+    log(f"# cagra built ({cagra_n} rows) in {cagra_build:.0f}s")
+    for itopk in (64, 128):
+        sp = cagra.SearchParams(itopk_size=itopk)
+        fn = jax.jit(lambda q, s=sp: cagra.search(ci, q, k, s))
+        dt = median_time(fn, queries, reps=3)
+        rec = device_recall(fn(queries)[1], cgt)
+        add_entry("raft_cagra", f"raft_cagra.degree64.itopk{itopk}",
+                  nq / dt, rec, cagra_build, {"corpus_n": cagra_n})
+        if rec >= 0.995:
+            break
+
+    # --- roofline: report utilization against the measured chip peak ----
+    log("# probing roofline")
+    peaks = roofline.probe(quick=True)
+    bf_entry = entries[0]
+    gemm_tflops = 2.0 * nq * n * d / (nq / bf_entry["qps"]) / 1e12
+    util = gemm_tflops / max(peaks["matmul_f32_tflops"], 1e-9)
+
+    # headline: BASELINE config 2 (ivf_flat QPS @ recall>=0.95)
+    if flat_best is not None:
+        value, rec, tag = flat_best
+        met = True
+    else:
+        flat_entries = [e for e in entries if e["algo"] == "raft_ivf_flat"]
+        top = max(flat_entries, key=lambda e: e["recall"])
+        value, rec, tag = top["qps"], top["recall"], top["name"]
+        met = False
     out = {
-        "metric": "brute_force_knn_qps_100k_d128_k10",
-        "value": round(qps, 2),
+        "metric": f"ivf_flat_qps_at_recall095_synth1M" if n >= 1_000_000
+        else "ivf_flat_qps_at_recall095_synth100k",
+        "value": round(value, 1),
         "unit": "queries/s",
-        "vs_baseline": round(qps / baseline_qps, 3),
+        "vs_baseline": round(value / BASELINE_QPS["raft_ivf_flat"], 3),
+        "recall": round(rec, 4),
+        "recall_target_met": met,
+        "corpus": {"n": n, "d": d, "nq": nq, "k": k,
+                   "kind": "clustered-gaussian-synthetic"},
+        "entries": entries,
+        "roofline": peaks,
+        "bf_gemm_utilization_of_measured_peak": round(util, 4),
+        "baseline_note": "derived A100 estimates (see bench.py); RAFT "
+                         "24.02 publishes plots, not tables",
     }
-    if jax.default_backend() == "tpu":
-        # roofline accounting for the fused kernel (the path auto-dispatch
-        # takes on TPU; off-TPU the scan fallback ran and these numbers
-        # would describe a kernel that never executed): GEMM flops and one
-        # full dataset HBM read per query tile, tile size from the kernel's
-        # own heuristic
-        import importlib
-        import math
-        _pick = importlib.import_module("raft_tpu.ops.fused_knn")._pick_tiles
-        tm, _ = _pick(d, k)
-        n_qtiles = math.ceil(nq / tm)
-        out["achieved_gflops"] = round(2.0 * nq * n * d / dt / 1e9, 1)
-        out["hbm_read_gbps"] = round(n_qtiles * n * d * 4 / dt / 1e9, 1)
     print(json.dumps(out))
 
 

@@ -6,11 +6,13 @@ select_warpsort.cuh (bitonic warp queues), with a heuristic auto-choice
 
 TPU design: the workhorse is XLA's `lax.top_k`, which lowers to an optimized
 TPU sort network — the role the warpsort family plays on GPU. For the shapes
-where a two-pass approach wins (huge rows, small k), `select_k` can take a
-`algo="radix"` hint that bucket-filters candidates first (the AIR-top-k idea)
-before running top_k on the survivors; the default `algo="auto"` currently
-maps everything to top_k and exists so callers and benchmarks can exercise
-the dispatch the way the reference does.
+where a two-pass approach wins (huge rows, small k), `algo="radix"`
+bucket-filters candidates first (the AIR-top-k idea) before running top_k on
+the survivors. `algo="auto"` consults the on-device measurement cache
+(populate with ``tune_select_k`` — the measured analog of the reference's
+per-arch ``choose_select_k_algorithm`` table, select_k-inl.cuh:48-72),
+falling back to a heuristic recorded from an on-chip sweep: radix wins for
+very wide rows with small k (see ``_AUTO_RADIX``).
 """
 from __future__ import annotations
 
@@ -23,7 +25,7 @@ import jax.numpy as jnp
 from ..core.errors import expects
 from ..core import tracing
 
-__all__ = ["SelectAlgo", "select_k"]
+__all__ = ["SelectAlgo", "select_k", "tune_select_k"]
 
 
 class SelectAlgo(enum.Enum):
@@ -68,6 +70,37 @@ def _radix_two_pass(values: jax.Array, k: int, select_min: bool):
     return (-vals if select_min else vals), idxs
 
 
+def _auto_choice(n: int, k: int) -> "SelectAlgo":
+    """auto = the cached on-device measurement for this (n, k) class, else
+    topk. The untuned fallback is deliberately NOT radix: on TPU the
+    bucket pre-filter masks values but cannot shrink lax.top_k's input
+    (its cost is shape-dependent), so radix only wins where a recorded
+    measurement says the masked sort is cheaper on that hardware — run
+    ``tune_select_k`` (the bench does) to populate the cache; the sweep
+    results ship in bench/select_k_sweep.json."""
+    from ..ops import autotune
+
+    hit = autotune.lookup(autotune.shape_bucket("select_k", n=n, k=k))
+    if hit in ("topk", "radix"):
+        return SelectAlgo(hit)
+    return SelectAlgo.TOPK
+
+
+def tune_select_k(rows: int, n: int, k: int, select_min: bool = True,
+                  reps: int = 5):
+    """Measure topk vs radix for this shape class on the current device and
+    cache the winner for ``algo="auto"`` (call eagerly, not under jit)."""
+    from ..ops import autotune
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (rows, n), jnp.float32)
+    key = autotune.shape_bucket("select_k", n=n, k=k)
+    cands = {
+        "topk": jax.jit(lambda v: _topk_smallest(v, k, select_min)),
+        "radix": jax.jit(lambda v: _radix_two_pass(v, k, select_min)),
+    }
+    return autotune.tune_best(key, cands, x, reps=reps, force=True)
+
+
 @tracing.annotate("raft_tpu::matrix::select_k")
 def select_k(
     values: jax.Array,
@@ -85,6 +118,8 @@ def select_k(
     algo = SelectAlgo(algo) if not isinstance(algo, SelectAlgo) else algo
     n = values.shape[-1]
     expects(0 < k <= n, "k=%d out of range for row length %d", k, n)
+    if algo is SelectAlgo.AUTO:
+        algo = _auto_choice(n, k)
     if algo is SelectAlgo.RADIX and k < n:
         vals, idxs = _radix_two_pass(values, k, select_min)
     else:

@@ -33,12 +33,14 @@ from ..core.serialize import load_arrays, save_arrays
 from ..cluster import kmeans_balanced
 from ..distance.distance_types import DistanceType, canonical_metric, is_min_close
 from ..matrix.select_k import select_k
-from ..utils import cdiv, hdot
+from ..utils import cdiv, hdot, in_jax_trace
 
 __all__ = ["IndexParams", "SearchParams", "Index", "build", "extend", "search",
            "save", "load"]
 
-_SERIAL_VERSION = 1
+# v2: store_dtype meta + uint16-framed bf16 rows + int8 scales; v1 files
+# (dense f32) remain readable
+_SERIAL_VERSION = 2
 
 
 @dataclasses.dataclass
@@ -57,6 +59,10 @@ class IndexParams:
     add_data_on_build: bool = True
     seed: int = 0
     list_growth: float = 1.0
+    # dataset storage dtype: float32 | bfloat16 (half the scan HBM
+    # traffic) | int8 (quarter, per-row scales) — role of the per-dtype
+    # loadAndComputeDist variants (ivf_flat_interleaved_scan-inl.cuh:99)
+    dtype: str = "float32"
 
 
 @dataclasses.dataclass
@@ -79,8 +85,8 @@ class Index:
     (n_lists, d).
     """
 
-    data: jax.Array
-    data_norms: jax.Array
+    data: jax.Array                # (cap_total, d) f32 | bf16 | int8
+    data_norms: jax.Array          # (cap_total,) exact f32 (of stored rep)
     source_ids: jax.Array
     centers: jax.Array
     center_norms: jax.Array
@@ -89,6 +95,7 @@ class Index:
     conservative_memory: bool = False
     list_sizes_arr: Optional[np.ndarray] = None  # None → dense (old files)
     list_growth: float = 1.0
+    scales: Optional[jax.Array] = None  # (cap_total,) f32, int8 mode only
 
     @property
     def size(self) -> int:
@@ -111,7 +118,7 @@ class Index:
 
     def tree_flatten(self):
         leaves = (self.data, self.data_norms, self.source_ids,
-                  self.centers, self.center_norms)
+                  self.centers, self.center_norms, self.scales)
         aux = (tuple(self.list_offsets.tolist()), self.metric,
                self.conservative_memory,
                None if self.list_sizes_arr is None
@@ -122,10 +129,10 @@ class Index:
     @classmethod
     def tree_unflatten(cls, aux, leaves):
         offsets, metric, conservative, sizes, growth = aux
-        return cls(*leaves, np.asarray(offsets, np.int64), metric,
+        return cls(*leaves[:5], np.asarray(offsets, np.int64), metric,
                    conservative,
                    None if sizes is None else np.asarray(sizes, np.int64),
-                   growth)
+                   growth, leaves[5])
 
 
 @tracing.annotate("raft_tpu::ivf_flat::build")
@@ -155,13 +162,15 @@ def build(dataset, params: IndexParams | None = None) -> Index:
         n_iters=p.kmeans_n_iters, seed=p.seed)
     centers = kmeans_balanced.fit(trainset, p.n_lists, bparams)
 
+    store_t = jnp.dtype(p.dtype)
     index = Index(
-        jnp.zeros((0, d), jnp.float32), jnp.zeros((0,), jnp.float32),
+        jnp.zeros((0, d), store_t), jnp.zeros((0,), jnp.float32),
         jnp.zeros((0,), jnp.int32), centers,
         jnp.sum(centers * centers, axis=1),
         np.zeros(p.n_lists + 1, np.int64), mt,
         list_sizes_arr=np.zeros(p.n_lists, np.int64),
-        list_growth=p.list_growth)
+        list_growth=p.list_growth,
+        scales=jnp.zeros((0,), jnp.float32) if store_t == jnp.int8 else None)
     if p.add_data_on_build:
         index = extend(index, dataset)
     return index
@@ -176,6 +185,7 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
     (no host copies of the dataset either way).
     """
     from ._list_layout import scatter_build, scatter_extend
+    from .brute_force import dequantize_rows, quantize_rows
 
     new_vectors = jnp.asarray(new_vectors, jnp.float32)
     expects(new_vectors.shape[1] == index.dim, "dim mismatch")
@@ -186,21 +196,29 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
     else:
         new_ids = jnp.asarray(new_ids, jnp.int32)
     labels, _ = kmeans_balanced.predict(new_vectors, index.centers)
-    norms = jnp.sum(new_vectors * new_vectors, axis=1)
 
-    fills = (0.0, 0.0, -1)
+    stored, new_scales = quantize_rows(new_vectors, index.data.dtype)
+    deq = dequantize_rows(stored, new_scales)
+    norms = jnp.sum(deq * deq, axis=1)   # exact norms of the stored rep
+
+    new_arrays = [stored, norms, new_ids]
+    old_arrays = [index.data, index.data_norms, index.source_ids]
+    fills = [0, 0.0, -1]
+    if new_scales is not None:
+        new_arrays.append(new_scales)
+        old_arrays.append(index.scales)
+        fills.append(1.0)
     if index.size == 0:
-        (data, dnorms, ids), offsets, sizes = scatter_build(
-            labels, (new_vectors, norms, new_ids), fills, index.n_lists,
-            index.list_growth)
+        out, offsets, sizes = scatter_build(
+            labels, new_arrays, fills, index.n_lists, index.list_growth)
     else:
-        (data, dnorms, ids), offsets, sizes = scatter_extend(
-            labels, (new_vectors, norms, new_ids),
-            (index.data, index.data_norms, index.source_ids), fills,
+        out, offsets, sizes = scatter_extend(
+            labels, new_arrays, old_arrays, fills,
             index.list_offsets, index.list_sizes, index.list_growth)
-    return Index(data, dnorms, ids, index.centers, index.center_norms,
+    scales = out[3] if new_scales is not None else None
+    return Index(out[0], out[1], out[2], index.centers, index.center_norms,
                  offsets, index.metric, index.conservative_memory,
-                 sizes, index.list_growth)
+                 sizes, index.list_growth, scales)
 
 
 def _probe_budget(list_sizes: np.ndarray, n_probes: int) -> int:
@@ -255,6 +273,21 @@ def _scan_penalty(index, mask_bits, lmax: int):
                    (0, scan_window(lmax)))
 
 
+def prepare_scan(index: Index) -> None:
+    """Eagerly build the pallas scan's aligned-DMA padded copy and attach
+    it to the index (a full-dataset pad pass). Called automatically on the
+    first *eager* search; jit users should call it once before tracing —
+    caches are never written under a trace (storing tracers corrupts
+    them), so an unprepared index pays the pad inside every jitted call."""
+    lmax = int(index.list_sizes.max())
+    cache = getattr(index, "_scan_pad", None)
+    if cache is None or cache[0] != lmax:
+        from ..ops.ivf_scan import pad_for_scan
+
+        index._scan_pad = (lmax,
+                           *pad_for_scan(index.data, index.data_norms, lmax))
+
+
 def _search_pallas(index, q, k, n_probes, offsets_j, sizes_j, precision,
                    pen_p=None):
     """Fused query-grouped list scan (the TPU perf path; ops/ivf_scan.py)."""
@@ -269,11 +302,16 @@ def _search_pallas(index, q, k, n_probes, offsets_j, sizes_j, precision,
                           data_norms=index.center_norms,
                           precision=precision)
     lmax = int(index.list_sizes.max())
-    # the aligned-DMA padding copies the dataset: do it once per index
+    # the aligned-DMA padding copies the dataset: cached once per index,
+    # but NEVER stored from inside a trace (leaked tracers)
     cache = getattr(index, "_scan_pad", None)
     if cache is None or cache[0] != lmax:
-        cache = (lmax, *pad_for_scan(index.data, index.data_norms, lmax))
-        index._scan_pad = cache
+        if in_jax_trace():
+            # traced: compute inline, never store (leaked tracers)
+            cache = (lmax, *pad_for_scan(index.data, index.data_norms, lmax))
+        else:
+            prepare_scan(index)
+            cache = index._scan_pad
     interpret = jax.default_backend() != "tpu"
     vals, rows = _ivf_flat_scan_jit(cache[1], cache[2], pen_p, probed,
                                     offsets_j, sizes_j, q, k, lmax,
@@ -320,9 +358,15 @@ def search(
     sizes_np = index.list_sizes
     sizes_j = jnp.asarray(sizes_np, jnp.int32)
 
-    use_pallas = (algo == "pallas" or
-                  (algo == "auto" and mt in _PALLAS_METRICS and
-                   jax.default_backend() == "tpu"))
+    # int8 storage rides the XLA gather path (fused dequant); the pallas
+    # scan covers f32/bf16 rows
+    expects(not (algo == "pallas" and index.data.dtype == jnp.int8),
+            "algo='pallas' supports f32/bf16 storage; int8 uses the xla "
+            "gather path")
+    use_pallas = (index.data.dtype != jnp.int8 and
+                  (algo == "pallas" or
+                   (algo == "auto" and mt in _PALLAS_METRICS and
+                    jax.default_backend() == "tpu")))
     if use_pallas:
         expects(mt in _PALLAS_METRICS, "metric %s unsupported by pallas",
                 mt.name)
@@ -368,10 +412,13 @@ def search(
 
 def search_arrays(data, data_norms, source_ids, centers, center_norms,
                   offsets_j, sizes_j, qc, k, n_probes, max_rows, mt,
-                  mask_bits=None):
+                  mask_bits=None, scales=None):
     """Pure-array IVF-Flat search core — everything traced, so it runs under
     jit, vmap and shard_map alike (the multi-chip path stacks per-shard
-    arrays and calls this per shard)."""
+    arrays and calls this per shard). ``data`` may be stored low-precision
+    (bf16/int8 + per-row ``scales``); gathers dequantize on the fly."""
+    from .brute_force import dequantize_rows
+
     select_min = is_min_close(mt)
     # stage 1: coarse probe selection (ivf_flat_search-inl.cuh:38)
     cross = hdot(qc, centers.T)
@@ -388,7 +435,8 @@ def search_arrays(data, data_norms, source_ids, centers, center_norms,
 
     # stage 2: gather candidates and score (the fused-scan analog)
     rows, valid, _ = _candidate_rows(probed, offsets_j, sizes_j, max_rows)
-    cand = data[rows]                            # (m, S, d)
+    cand = dequantize_rows(data[rows],
+                           None if scales is None else scales[rows])
     if mt is DistanceType.InnerProduct:
         dist = jnp.einsum("msd,md->ms", cand, qc, precision="highest")
     elif mt is DistanceType.CosineExpanded:
@@ -423,42 +471,60 @@ def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
                   mask_bits, mt):
     return search_arrays(index.data, index.data_norms, index.source_ids,
                          index.centers, index.center_norms, offsets_j,
-                         sizes_j, qc, k, n_probes, max_rows, mt, mask_bits)
+                         sizes_j, qc, k, n_probes, max_rows, mt, mask_bits,
+                         index.scales)
 
 
 def save(index: Index, path) -> None:
     """Serialize (analog of ivf_flat_serialize.cuh). Capacity slack is
     stripped: the file holds densely-packed valid rows (v1 layout), so
-    files are slack-free and old readers stay compatible."""
+    files are slack-free and old readers stay compatible. bf16 rows are
+    framed as uint16 (npy has no bfloat16) with the dtype in the header."""
     from ._list_layout import gather_dense
 
     sizes = index.list_sizes
+    arrays = [index.data, index.source_ids]
+    if index.scales is not None:
+        arrays.append(index.scales)
     if index.list_sizes_arr is not None:
-        (data, ids), _ = gather_dense(
-            (index.data, index.source_ids), index.list_offsets, sizes)
-    else:
-        data, ids = index.data, index.source_ids
+        arrays, _ = gather_dense(arrays, index.list_offsets, sizes)
+    data, ids = arrays[0], arrays[1]
     dense_offsets = np.zeros(index.n_lists + 1, np.int64)
     np.cumsum(sizes, out=dense_offsets[1:])
+    if data.dtype == jnp.bfloat16:
+        data = np.asarray(jax.device_get(data)).view(np.uint16)
+    out = {
+        "data": data,
+        "source_ids": ids,
+        "centers": index.centers,
+        "list_offsets": dense_offsets,
+    }
+    if index.scales is not None:
+        out["scales"] = arrays[2]
     save_arrays(
         path, "ivf_flat", _SERIAL_VERSION,
-        {"metric": index.metric.value, "n_lists": index.n_lists},
-        {
-            "data": data,
-            "source_ids": ids,
-            "centers": index.centers,
-            "list_offsets": dense_offsets,
-        })
+        {"metric": index.metric.value, "n_lists": index.n_lists,
+         "store_dtype": str(index.data.dtype)},
+        out)
 
 
 def load(path) -> Index:
+    import ml_dtypes
+
+    from .brute_force import dequantize_rows
+
     _, version, meta, arrs = load_arrays(path, "ivf_flat")
-    expects(version == _SERIAL_VERSION, "unsupported version %d", version)
-    data = jnp.asarray(arrs["data"])
+    expects(version in (1, 2), "unsupported version %d", version)
+    data_np = np.asarray(arrs["data"])
+    if meta.get("store_dtype") == "bfloat16":
+        data_np = data_np.view(ml_dtypes.bfloat16)
+    data = jnp.asarray(data_np)
+    scales = jnp.asarray(arrs["scales"]) if "scales" in arrs else None
+    deq = dequantize_rows(data, scales)
     centers = jnp.asarray(arrs["centers"])
     offsets = np.asarray(arrs["list_offsets"], np.int64)
     return Index(
-        data, jnp.sum(data * data, axis=1), jnp.asarray(arrs["source_ids"]),
+        data, jnp.sum(deq * deq, axis=1), jnp.asarray(arrs["source_ids"]),
         centers, jnp.sum(centers * centers, axis=1), offsets,
         DistanceType(meta["metric"]),
-        list_sizes_arr=np.diff(offsets))
+        list_sizes_arr=np.diff(offsets), scales=scales)

@@ -48,7 +48,7 @@ from ..core.serialize import load_arrays, save_arrays
 from ..cluster import kmeans_balanced
 from ..distance.distance_types import DistanceType, canonical_metric
 from ..matrix.select_k import select_k
-from ..utils import cdiv, hdot
+from ..utils import cdiv, hdot, in_jax_trace
 from .ivf_flat import _candidate_rows, _probe_budget
 
 __all__ = ["CodebookGen", "IndexParams", "SearchParams", "Index", "build",
@@ -411,27 +411,46 @@ def _scan_penalty(index, mask_bits, lmax: int):
                    (0, scan_window(lmax)))
 
 
+def _scan_prep(index: Index, lmax: int) -> dict:
+    """Row norms + CB matrix + aligned-DMA padding for the pallas scan —
+    full passes over the compressed dataset."""
+    from ..ops.ivf_pq_scan import (decoded_row_norms, make_cb_matrix,
+                                   pad_codes_for_scan)
+
+    rn = decoded_row_norms(index.codes, index.centers_rot,
+                           index.codebooks, index.list_offsets)
+    codes_p, norms_p = pad_codes_for_scan(index.codes, rn, lmax,
+                                          index.pq_dim)
+    return {"n": index.size, "lmax": lmax, "codes_p": codes_p,
+            "norms_p": norms_p, "cbm": make_cb_matrix(index.codebooks)}
+
+
+def prepare_scan(index: Index) -> None:
+    """Eagerly attach the pallas scan's per-index prep (see
+    ivf_flat.prepare_scan for the caching contract: never written under a
+    trace; jit users call this once before tracing)."""
+    lmax = int(index.list_sizes.max())
+    cache = getattr(index, "_scan_cache", None)
+    if cache is None or cache["n"] != index.size or cache["lmax"] != lmax:
+        index._scan_cache = _scan_prep(index, lmax)
+
+
 def _search_pallas(index: Index, q, k, n_probes, lut_dtype, precision,
                    pen_p=None):
     """Fused query-grouped PQ scan (ops/ivf_pq_scan.py) — the TPU perf
     path (expanded-form LUT + one-hot GEMM scoring)."""
     from ..ops import fused_knn
-    from ..ops.ivf_pq_scan import (_ivf_pq_scan_jit, decoded_row_norms,
-                                   make_cb_matrix, pad_codes_for_scan)
+    from ..ops.ivf_pq_scan import _ivf_pq_scan_jit
 
     mt = index.metric
     lmax = int(index.list_sizes.max())
-    # per-index prep (row norms, CB matrix, aligned-DMA padding): all are
-    # full passes over the compressed dataset — cache, don't redo per call
     cache = getattr(index, "_scan_cache", None)
     if cache is None or cache["n"] != index.size or cache["lmax"] != lmax:
-        rn = decoded_row_norms(index.codes, index.centers_rot,
-                               index.codebooks, index.list_offsets)
-        codes_p, norms_p = pad_codes_for_scan(index.codes, rn, lmax,
-                                              index.pq_dim)
-        cache = {"n": index.size, "lmax": lmax, "codes_p": codes_p,
-                 "norms_p": norms_p, "cbm": make_cb_matrix(index.codebooks)}
-        index._scan_cache = cache
+        if in_jax_trace():
+            cache = _scan_prep(index, lmax)   # traced: compute inline
+        else:
+            prepare_scan(index)
+            cache = index._scan_cache
 
     q_rot = hdot(q, index.rotation.T)
     coarse_metric = "ip" if mt is DistanceType.InnerProduct else "l2"

@@ -66,12 +66,21 @@ def _kernel(q_ref, d_ref, dn_ref, pen_ref, ov_ref, oi_ref, sv_ref, si_ref,
         sv_ref[:] = jnp.full_like(sv_ref, jnp.inf)
         si_ref[:] = jnp.full_like(si_ref, -1)
 
-    q = q_ref[:]                                   # (tm, dim_p)
-    d = d_ref[:]                                   # (tn, dim_p)
+    q = q_ref[:]                                   # (tm, dim_p) f32
+    d = d_ref[:]                                   # (tn, dim_p) f32|bf16
     tm = q.shape[0]
-    dot = jax.lax.dot_general(q, d, (((1,), (1,)), ((), ())),
-                              preferred_element_type=jnp.float32,
-                              precision=jax.lax.Precision(precision))  # (tm, tn)
+    if d.dtype == jnp.bfloat16:
+        # bf16 dataset mode: rows stream from HBM at half the f32 traffic;
+        # the product accumulates in f32 (precision knob is moot — the
+        # stored operand is already bf16)
+        dot = jax.lax.dot_general(q.astype(jnp.bfloat16), d,
+                                  (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+    else:
+        dot = jax.lax.dot_general(
+            q, d, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision(precision))  # (tm, tn)
     if metric == "l2":
         qn = jnp.sum(q * q, axis=1, keepdims=True)
         dist = jnp.maximum(qn + dn_ref[:] - 2.0 * dot, 0.0)
@@ -220,7 +229,9 @@ def fused_knn(
     out-of-range slots have value +inf and index -1.
     """
     q = jnp.asarray(queries, jnp.float32)
-    d = jnp.asarray(dataset, jnp.float32)
+    d = jnp.asarray(dataset)
+    if d.dtype != jnp.bfloat16:    # bf16 stays bf16 (halved HBM traffic)
+        d = d.astype(jnp.float32)
     m, dim = q.shape
     n = d.shape[0]
     if interpret is None:
@@ -234,7 +245,8 @@ def fused_knn(
     d = jnp.pad(d, ((0, n_pad - n), (0, dim_p - dim)))
 
     if metric in ("l2", "cos"):
-        dn = (jnp.sum(d * d, axis=1) if data_norms is None
+        dn = (jnp.sum(d.astype(jnp.float32) ** 2, axis=1)
+              if data_norms is None
               else jnp.pad(jnp.asarray(data_norms, jnp.float32),
                            (0, n_pad - n)))
         if metric == "cos":   # kernel divides by the norm, not its square

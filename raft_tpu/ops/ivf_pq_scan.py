@@ -43,11 +43,13 @@ def make_cb_matrix(codebooks: jax.Array) -> jax.Array:
     pq_dim, book, pq_len = codebooks.shape
     rot_dim = pq_dim * pq_len
     rot_pad = round_up_to(rot_dim, 128)
-    cb = np.zeros((rot_pad, pq_dim * book), np.float32)
-    cbh = np.asarray(codebooks, np.float32)
+    # pure-jnp construction (this also runs inside jit traces when a
+    # caller searches an unprepared index under jit)
+    cb = jnp.zeros((rot_pad, pq_dim * book), jnp.float32)
+    cbj = jnp.asarray(codebooks, jnp.float32)
     for s in range(pq_dim):
-        cb[s * pq_len : (s + 1) * pq_len, s::pq_dim] = cbh[s].T
-    return jnp.asarray(cb)
+        cb = cb.at[s * pq_len : (s + 1) * pq_len, s::pq_dim].set(cbj[s].T)
+    return cb
 
 
 def decoded_row_norms(codes, centers_rot, codebooks, list_offsets

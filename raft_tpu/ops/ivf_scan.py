@@ -99,9 +99,16 @@ def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, pen_ref, data_ref,
     copy.wait()
     rows = rows_vmem[:]                             # (lmax, dim_pad)
 
-    dot = jax.lax.dot_general(q, rows, (((1,), (1,)), ((), ())),
-                              preferred_element_type=jnp.float32,
-                              precision=jax.lax.Precision(precision))
+    if rows.dtype == jnp.bfloat16:
+        # bf16 dataset mode: list rows stream at half the f32 HBM traffic;
+        # accumulate in f32 (ivf_flat per-dtype loadAndComputeDist role)
+        dot = jax.lax.dot_general(q.astype(jnp.bfloat16), rows,
+                                  (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+    else:
+        dot = jax.lax.dot_general(q, rows, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32,
+                                  precision=jax.lax.Precision(precision))
     if metric == "l2":
         dist = jnp.maximum(qn + dn_ref[0, 0] - 2.0 * dot, 0.0)
     elif metric == "cos":
@@ -175,7 +182,7 @@ def _scan_groups(qblocks, qnorms, dnorm_slices, pen_slices, data, goffs,
                          memory_space=pltpu.VMEM),
         ],
         scratch_shapes=[
-            pltpu.VMEM((lmax, dim_pad), jnp.float32),
+            pltpu.VMEM((lmax, dim_pad), data.dtype),
             pltpu.SemaphoreType.DMA,
         ],
     )
@@ -231,11 +238,13 @@ def pad_for_scan(data, data_norms, lmax: int):
     """Row/col-pad the dataset for the scan kernel's aligned DMA windows.
 
     A full-dataset copy — call once per index (callers cache the result),
-    not per search."""
+    not per search. bf16 datasets stay bf16 (the kernel accumulates f32)."""
     lmax_pad = scan_window(lmax)
     dim_pad = round_up_to(data.shape[1], 128)
-    data_p = jnp.pad(jnp.asarray(data, jnp.float32),
-                     ((0, lmax_pad), (0, dim_pad - data.shape[1])))
+    data = jnp.asarray(data)
+    if data.dtype != jnp.bfloat16:
+        data = data.astype(jnp.float32)
+    data_p = jnp.pad(data, ((0, lmax_pad), (0, dim_pad - data.shape[1])))
     norms_p = jnp.pad(jnp.asarray(data_norms, jnp.float32), (0, lmax_pad))
     return data_p, norms_p
 

@@ -107,6 +107,9 @@ def build_ivf_flat(dataset, mesh: Mesh,
             "n_lists %d > smallest shard %d", p0.n_lists,
             min(len(r) for r in parts))
 
+    expects(jnp.dtype(p0.dtype) != jnp.int8,
+            "sharded ivf_flat supports f32/bf16 storage (int8 per-row "
+            "scales are not threaded through the stacked layout yet)")
     shards = [ivf_flat.build(dataset[rows], p0) for rows in parts]
     mt = shards[0].metric
 

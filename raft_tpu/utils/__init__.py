@@ -72,3 +72,18 @@ def hdot(x, y):
     import jax.numpy as jnp
 
     return jnp.matmul(x, y, precision="highest")
+
+
+def in_jax_trace() -> bool:
+    """True when called during a jax trace (jit/vmap/...). Used to gate
+    side-effecting caches: storing traced arrays on a Python object leaks
+    tracers out of the transformation."""
+    try:
+        from jax._src.core import trace_state_clean
+
+        return not trace_state_clean()
+    except ImportError:  # fallback probe: ops under a trace yield Tracers
+        import jax
+        import jax.numpy as jnp
+
+        return isinstance(jnp.zeros(()) + 0, jax.core.Tracer)

@@ -1,0 +1,70 @@
+"""Worker process for the 2-process jax.distributed smoke test.
+
+Role of a raft-dask worker in test_comms.py:69-338: join the clique via
+the coordinator (the ncclUniqueId-broadcast analog), run the collective
+self-tests through the injected comms, then a sharded brute-force search,
+and print a checkable verdict. Invoked by test_distributed.py as
+
+    python tests/_dist_worker.py <coordinator> <n_procs> <rank>
+"""
+import os
+import sys
+
+# each process contributes 2 virtual CPU devices to the global clique
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
+def main(coordinator: str, n_procs: int, rank: int) -> None:
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from raft_tpu.comms import bootstrap
+
+    # bootstrap FIRST: jax.distributed.initialize must run before anything
+    # touches the XLA backend (Resources eagerly derives a PRNG key)
+    mesh, comms = bootstrap.init_comms(
+        coordinator_address=coordinator, num_processes=n_procs,
+        process_id=rank, axis="shard")
+    from raft_tpu.core import Resources
+
+    res = Resources(seed=0)
+    res.set_comms(comms)
+    n_dev = len(jax.devices())
+    assert n_dev == 2 * n_procs, f"global devices {n_dev}"
+    assert res.has_comms()
+
+    # collective self-test (comms_test.hpp analog) over the global mesh
+    from raft_tpu.comms.comms_test import run_all
+
+    results = run_all(mesh)
+    failed = [name for name, ok in results.items() if not ok]
+    assert not failed, f"collective self-tests failed: {failed}"
+
+    # sharded brute-force search over the global device clique
+    from raft_tpu.parallel import sharded_knn
+
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((1000, 16)).astype(np.float32)
+    q = rng.standard_normal((8, 16)).astype(np.float32)
+    index = sharded_knn.build(data, mesh)
+    d, i = sharded_knn.search(index, q, k=5, algo="scan")
+    jax.block_until_ready((d, i))
+    # verify against the local exact answer (deterministic on every rank)
+    from raft_tpu.neighbors import brute_force
+
+    _, want = brute_force.search(brute_force.build(data), q, 5, algo="scan")
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(want))
+    print(f"DIST_WORKER_OK rank={rank} devices={n_dev}", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))

@@ -152,6 +152,45 @@ class TestBruteForce:
         _, want_idx = naive_knn(data, q, 5)
         assert calc_recall(np.asarray(i), want_idx) > 0.999
 
+    @pytest.mark.parametrize("dtype,min_recall", [("bfloat16", 0.95),
+                                                  ("int8", 0.9)])
+    @pytest.mark.parametrize("algo", ["matmul", "scan"])
+    def test_low_precision_storage(self, rng, dtype, min_recall, algo):
+        data, q = _data(rng, n=4000, m=48)
+        index = brute_force.build(data, dtype=dtype)
+        assert str(index.dataset.dtype) == dtype
+        dist, idx = brute_force.search(index, q, k=10, algo=algo)
+        _, want = naive_knn(data, q, 10)
+        assert calc_recall(np.asarray(idx), want) > min_recall
+        # distances stay near the exact values (dequantized scoring)
+        want_d, _ = naive_knn(data, q, 10)
+        assert np.median(np.abs(np.asarray(dist) - want_d)) < 0.5
+
+    def test_bf16_pallas_engine(self, rng):
+        data, q = _data(rng, n=2000, m=32)
+        index = brute_force.build(data, dtype="bfloat16")
+        dist, idx = brute_force.search(index, q, k=10, algo="pallas")
+        _, want = naive_knn(data, q, 10)
+        assert calc_recall(np.asarray(idx), want) > 0.95
+
+    def test_int8_pallas_redirects(self, rng):
+        data, q = _data(rng, n=1000, m=8)
+        index = brute_force.build(data, dtype="int8")
+        d1, i1 = brute_force.search(index, q, k=5, algo="pallas")
+        d2, i2 = brute_force.search(index, q, k=5, algo="matmul")
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_low_precision_save_load(self, tmp_path, rng):
+        for dtype in ("bfloat16", "int8"):
+            data, q = _data(rng, n=500, m=8)
+            index = brute_force.build(data, dtype=dtype)
+            brute_force.save(index, tmp_path / f"bf_{dtype}.raft")
+            loaded = brute_force.load(tmp_path / f"bf_{dtype}.raft")
+            assert str(loaded.dataset.dtype) == dtype
+            d1, i1 = brute_force.search(index, q, 5, algo="scan")
+            d2, i2 = brute_force.search(loaded, q, 5, algo="scan")
+            np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
     def test_bad_query_dim(self, rng):
         from raft_tpu.core import RaftError
         data, _ = _data(rng, n=100)

@@ -12,7 +12,7 @@ from raft_tpu.neighbors import cagra, nn_descent
 @pytest.fixture(scope="module")
 def dataset():
     rng = np.random.default_rng(7)
-    return rng.standard_normal((10_000, 32)).astype(np.float32)
+    return rng.standard_normal((6_000, 32)).astype(np.float32)
 
 
 @pytest.fixture(scope="module")
@@ -33,17 +33,18 @@ def built_index(dataset):
 
 
 class TestNnDescent:
+    @pytest.mark.slow
     def test_graph_quality(self, dataset, knn_oracle):
         k = 32
         graph = nn_descent.build(dataset, k, n_iters=20, seed=0)
         assert graph.shape == (len(dataset), k)
         assert (graph != np.arange(len(dataset))[:, None]).all()  # no self
         _, want_full = knn_oracle
-        # drop the self column from the oracle
-        want = np.empty((len(dataset), k), np.int64)
-        for i in range(len(dataset)):
-            row = want_full[i][want_full[i] != i][:k]
-            want[i] = row
+        # drop the self column from the oracle (vectorized)
+        rows = np.arange(len(dataset))[:, None]
+        not_self = want_full != rows
+        order = np.argsort(~not_self, axis=1, kind="stable")[:, :k]
+        want = np.take_along_axis(want_full, order, axis=1)
         r = calc_recall(graph, want)
         assert r >= 0.85, f"nn_descent graph recall {r}"
 
@@ -79,6 +80,7 @@ class TestCagra:
         _, want = naive_knn(dataset, queries, 10)
         assert calc_recall(np.asarray(idx), want) >= 0.85
 
+    @pytest.mark.slow
     def test_nn_descent_build(self, dataset, queries):
         index = cagra.build(dataset, cagra.IndexParams(
             intermediate_graph_degree=64, graph_degree=32,

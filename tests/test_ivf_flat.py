@@ -133,6 +133,42 @@ class TestIvfFlat:
                                  ivf_flat.SearchParams(n_probes=32))
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
+    @pytest.mark.parametrize("dtype", ["bfloat16", "int8"])
+    def test_low_precision_storage(self, dataset, queries, dtype):
+        index = ivf_flat.build(dataset, ivf_flat.IndexParams(
+            n_lists=64, seed=0, dtype=dtype))
+        assert str(index.data.dtype) == dtype
+        if dtype == "int8":
+            assert index.scales is not None
+        # full-probe search ≈ exact (quantization-limited)
+        _, idx = ivf_flat.search(index, queries, k=10,
+                                 params=ivf_flat.SearchParams(n_probes=64))
+        _, want = naive_knn(dataset, queries, 10)
+        r = calc_recall(np.asarray(idx), want)
+        assert r > (0.95 if dtype == "bfloat16" else 0.9), r
+
+    def test_bf16_pallas_scan_matches_xla(self, dataset, queries):
+        index = ivf_flat.build(dataset, ivf_flat.IndexParams(
+            n_lists=64, seed=0, dtype="bfloat16"))
+        sp = ivf_flat.SearchParams(n_probes=16)
+        dx, ix = ivf_flat.search(index, queries, 8, sp, algo="xla")
+        dp, ip = ivf_flat.search(index, queries, 8, sp, algo="pallas")
+        assert np.mean(np.asarray(ip) == np.asarray(ix)) > 0.97
+        np.testing.assert_allclose(np.asarray(dp), np.asarray(dx),
+                                   rtol=5e-2, atol=5e-2)
+
+    def test_low_precision_save_load(self, dataset, queries, tmp_path):
+        for dtype in ("bfloat16", "int8"):
+            index = ivf_flat.build(dataset[:5000], ivf_flat.IndexParams(
+                n_lists=32, seed=0, dtype=dtype))
+            ivf_flat.save(index, tmp_path / f"ivf_{dtype}.raft")
+            loaded = ivf_flat.load(tmp_path / f"ivf_{dtype}.raft")
+            assert str(loaded.data.dtype) == dtype
+            sp = ivf_flat.SearchParams(n_probes=32)
+            _, i1 = ivf_flat.search(index, queries, 5, sp, algo="xla")
+            _, i2 = ivf_flat.search(loaded, queries, 5, sp, algo="xla")
+            np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
     def test_build_empty_then_extend(self, dataset, queries):
         p = ivf_flat.IndexParams(n_lists=32, add_data_on_build=False, seed=0)
         index = ivf_flat.build(dataset, p)

@@ -107,3 +107,30 @@ class TestOps:
         w = rng.random(6).astype(np.float32)
         got = np.asarray(matrix.row_weighted_mean(jnp.asarray(m), jnp.asarray(w)))
         np.testing.assert_allclose(got, (m * w).sum(1) / w.sum(), rtol=1e-5)
+
+
+class TestSelectKAutoDispatch:
+    def test_tune_select_k_records_winner(self, tmp_path, monkeypatch):
+        from raft_tpu.matrix.select_k import tune_select_k
+        from raft_tpu.ops import autotune
+
+        monkeypatch.setenv("RAFT_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "t.json"))
+        monkeypatch.setattr(autotune, "_MEM_CACHE", {})
+        monkeypatch.setattr(autotune, "_DISK_LOADED", False)
+        winner, timings = tune_select_k(rows=32, n=4096, k=8, reps=2)
+        assert winner in ("topk", "radix")
+        assert set(timings) == {"topk", "radix"}
+        key = autotune.shape_bucket("select_k", n=4096, k=8)
+        assert autotune.lookup(key) == winner
+
+    def test_auto_matches_topk_results(self, rng):
+        # auto (whatever it dispatches) must agree with explicit topk
+        from raft_tpu.matrix.select_k import select_k
+
+        x = jnp.asarray(rng.standard_normal((16, 1 << 16)).astype(np.float32))
+        v1, i1 = select_k(x, 10, algo="auto")
+        v2, i2 = select_k(x, 10, algo="topk")
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
