@@ -51,10 +51,21 @@ BASELINE_QPS = {
 }
 
 
-def median_time(fn, *args, reps=5):
+def median_time(fn, *args, reps=5, tries=3):
+    """Per-call-blocked median with retries: tunneled backends drop the
+    remote-compile transport transiently; one flake must not kill a
+    half-hour bench. Returns None after ``tries`` consecutive failures."""
     from raft_tpu.ops.autotune import measure
 
-    return measure(fn, *args, reps=reps)
+    for t in range(tries):
+        try:
+            return measure(fn, *args, reps=reps)
+        except Exception as e:  # noqa: BLE001 - transport/compile flakes
+            log(f"# measurement attempt {t + 1}/{tries} failed: "
+                f"{type(e).__name__}: {e}")
+            if t + 1 < tries:
+                time.sleep(15 * (t + 1))
+    return None
 
 
 def make_corpus(n, d, nq, n_clusters=2000, seed=0):
@@ -110,9 +121,11 @@ def main():
     winner, timings = brute_force.tune_search(bf, queries, k, reps=3)
     sfn = jax.jit(lambda q: brute_force.search(bf, q, k, algo=winner))
     dt = median_time(sfn, queries)
-    add_entry("raft_brute_force", f"raft_brute_force.{winner}", nq / dt, 1.0,
-              0.0, {"engine_timings_ms":
-                    {kk: round(v * 1e3, 1) for kk, v in timings.items()}})
+    if dt is not None:
+        add_entry("raft_brute_force", f"raft_brute_force.{winner}",
+                  nq / dt, 1.0, 0.0,
+                  {"engine_timings_ms":
+                   {kk: round(v * 1e3, 1) for kk, v in timings.items()}})
 
     # --- ivf_flat (config 2: n_lists=1024, probe sweep) -----------------
     t0 = time.perf_counter()
@@ -126,6 +139,8 @@ def main():
         sp = ivf_flat.SearchParams(n_probes=probes)
         fn = jax.jit(lambda q, s=sp: ivf_flat.search(fi, q, k, s))
         dt = median_time(fn, queries)
+        if dt is None:
+            continue
         rec = device_recall(fn(queries)[1], gt)
         add_entry("raft_ivf_flat", f"raft_ivf_flat.nlist1024.nprobe{probes}",
                   nq / dt, rec, flat_build)
@@ -152,6 +167,8 @@ def main():
 
         fn = jax.jit(pq_refined)
         dt = median_time(fn, queries)
+        if dt is None:
+            continue
         rec = device_recall(fn(queries)[1], gt)
         add_entry("raft_ivf_pq",
                   f"raft_ivf_pq.nlist1024.pq64.nprobe{probes}.refine2",
@@ -178,13 +195,18 @@ def main():
         graph_degree=64, intermediate_graph_degree=96, seed=0))
     jax.block_until_ready(jax.tree.leaves(ci))
     cagra_build = time.perf_counter() - t0
+    cagra.prepare_search(ci)    # bf16 traversal copy out of the timed graph
     log(f"# cagra built ({cagra_n} rows) in {cagra_build:.0f}s")
-    for itopk in (64, 128):
-        sp = cagra.SearchParams(itopk_size=itopk)
+    # sweep (itopk, search_width): wider frontiers trade hops for per-hop
+    # parallel work — on dispatch-latency-heavy backends width>1 is ~2x QPS
+    for itopk, width in ((32, 4), (64, 4), (64, 1)):
+        sp = cagra.SearchParams(itopk_size=itopk, search_width=width)
         fn = jax.jit(lambda q, s=sp: cagra.search(ci, q, k, s))
         dt = median_time(fn, queries, reps=3)
+        if dt is None:
+            continue
         rec = device_recall(fn(queries)[1], cgt)
-        add_entry("raft_cagra", f"raft_cagra.degree64.itopk{itopk}",
+        add_entry("raft_cagra", f"raft_cagra.degree64.itopk{itopk}.w{width}",
                   nq / dt, rec, cagra_build, {"corpus_n": cagra_n})
         if rec >= 0.995:
             break
@@ -192,9 +214,12 @@ def main():
     # --- roofline: report utilization against the measured chip peak ----
     log("# probing roofline")
     peaks = roofline.probe(quick=True)
-    bf_entry = entries[0]
-    gemm_tflops = 2.0 * nq * n * d / (nq / bf_entry["qps"]) / 1e12
-    util = gemm_tflops / max(peaks["matmul_f32_tflops"], 1e-9)
+    bf_entries = [e for e in entries if e["algo"] == "raft_brute_force"]
+    if bf_entries:
+        gemm_tflops = 2.0 * nq * n * d / (nq / bf_entries[0]["qps"]) / 1e12
+        util = gemm_tflops / max(peaks["matmul_f32_tflops"], 1e-9)
+    else:
+        util = -1.0
 
     # headline: BASELINE config 2 (ivf_flat QPS @ recall>=0.95)
     if flat_best is not None:

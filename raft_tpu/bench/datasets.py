@@ -20,7 +20,7 @@ import numpy as np
 from ..core.errors import expects
 
 __all__ = ["read_fbin", "write_fbin", "read_ibin", "write_ibin",
-           "load_dataset", "generate_groundtruth"]
+           "iter_fbin", "load_dataset", "generate_groundtruth"]
 
 
 def _read_bin(path, dtype) -> np.ndarray:
@@ -42,6 +42,19 @@ def read_fbin(path) -> np.ndarray:
 
 def write_fbin(path, arr) -> None:
     _write_bin(path, arr, np.float32)
+
+
+def iter_fbin(path, batch_rows: int = 1 << 17):
+    """Stream an fbin file in bounded row batches via mmap — the
+    out-of-core reader for corpora larger than host memory (DEEP-1B /
+    wiki-all class; feeds ivf_*.build_from_batches). Host memory stays
+    O(batch_rows * d)."""
+    with open(path, "rb") as f:
+        n, d = np.fromfile(f, np.int32, 2)
+    n, d = int(n), int(d)
+    mm = np.memmap(path, np.float32, mode="r", offset=8, shape=(n, d))
+    for b0 in range(0, n, batch_rows):
+        yield np.asarray(mm[b0 : b0 + batch_rows])
 
 
 def read_ibin(path) -> np.ndarray:

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["count_sizes", "plan_offsets", "scatter_build", "scatter_extend",
-           "gather_dense"]
+           "gather_dense", "streaming_build"]
 
 _ALIGN = 8   # sublane multiple: keeps list starts DMA-friendly
 
@@ -128,3 +128,27 @@ def gather_dense(arrays: Sequence[jax.Array], offsets: np.ndarray,
     rows = (jnp.take(jnp.asarray(offsets[:-1]), list_of)
             + (pos - jnp.take(jnp.asarray(starts), list_of)))
     return [jnp.take(a, rows, axis=0) for a in arrays], list_of.astype(jnp.int32)
+
+
+def streaming_build(batches, params, build_fn, extend_fn, replace_fn,
+                    trainset=None):
+    """Shared streaming-build driver for IVF indexes: train quantizers on
+    ``trainset`` (or the first batch), then extend batch by batch — host
+    memory stays O(batch). ``replace_fn`` is dataclasses.replace for the
+    module's IndexParams; capacity slack is floored at 1.2 so the merges
+    amortize to O(batch) in-place scatters."""
+    import jax.numpy as jnp
+
+    from ..core.errors import expects
+
+    p = replace_fn(params, add_data_on_build=False,
+                   list_growth=max(1.2, params.list_growth))
+    it = iter(batches)
+    first = next(it, None)
+    expects(first is not None, "streaming build got an empty batch iterable")
+    first = jnp.asarray(first, jnp.float32)
+    index = build_fn(first if trainset is None else trainset, p)
+    index = extend_fn(index, first)
+    for b in it:
+        index = extend_fn(index, b)
+    return index

@@ -85,9 +85,15 @@ class SearchParams:
     itopk_size: int = 64
     search_width: int = 1          # parents expanded per iteration
     max_iterations: int = 0        # 0 → auto
+    min_iterations: int = 0        # traverse at least this many hops
     num_random_samplings: int = 1  # random seed nodes multiplier
     candidate_dtype: str = "bfloat16"   # "bfloat16" | "float32"
     seed: int = 0x5EED
+    # the reference's SINGLE_CTA/MULTI_CTA/MULTI_KERNEL strategies
+    # (factory.cuh:31-91) collapse into one batched-frontier program on
+    # TPU; "auto"/"single_cta"/"multi_cta"/"multi_kernel" are all accepted
+    # and run the same plan (XLA owns the occupancy tradeoffs)
+    algo: str = "auto"
 
 
 @jax.tree_util.register_pytree_node_class
@@ -121,7 +127,7 @@ class Index:
 
 @tracing.annotate("raft_tpu::cagra::build_knn_graph")
 def build_knn_graph(dataset, k: int, metric=DistanceType.L2Expanded,
-                    seed: int = 0, batch: int = 4096) -> np.ndarray:
+                    seed: int = 0, batch: int = 32768) -> np.ndarray:
     """All-points kNN graph via IVF-PQ search + exact refine
     (cagra_build.cuh:43, gpu_top_k = k * refine_rate then refine to k).
 
@@ -139,13 +145,19 @@ def build_knn_graph(dataset, k: int, metric=DistanceType.L2Expanded,
 
     graph = np.zeros((n, k), np.int32)
     drop_self = jax.jit(partial(_drop_self_pad, k=k, n=n))
+    batch = min(batch, n)
     for b0 in range(0, n, batch):
-        qb = dataset[b0 : b0 + batch]
+        hi = min(b0 + batch, n)
+        # tail batches are padded back to the full batch shape (wrapping
+        # rows) so every iteration hits the same compiled executable —
+        # tunnel compiles cost tens of seconds each
+        idx_rows = (np.arange(b0, b0 + batch) % n).astype(np.int32)
+        qb = dataset[idx_rows]
         _, cand = ivf_pq_mod.search(index, qb, gpu_k,
                                     ivf_pq_mod.SearchParams(n_probes))
         _, ref = refine_mod.refine(dataset, qb, cand, k + 1, mt)
-        rows = jnp.arange(b0, min(b0 + batch, n), dtype=jnp.int32)
-        graph[b0 : b0 + batch] = np.asarray(drop_self(ref, rows))
+        out = np.asarray(drop_self(ref, jnp.asarray(idx_rows)))
+        graph[b0:hi] = out[: hi - b0]
     return graph
 
 
@@ -165,17 +177,25 @@ def _drop_self_pad(ref, rows, *, k: int, n: int):
     return jnp.where(n_ok > 0, out, (rows[:, None] + 1) % n).astype(jnp.int32)
 
 
-def _detour_counts(graph_j, batch_nodes):
+def _detour_counts(graph_sorted, graph_j, batch_nodes):
     """(b, d0) detour counts for a batch of nodes (kern_prune analog).
 
     Edge (i, N_i[b]) is detourable through N_i[a] (a < b, i.e. a closer
-    neighbor) if the graph has the edge N_i[a] → N_i[b].
+    neighbor) if the graph has the edge N_i[a] → N_i[b]. Membership is a
+    searchsorted probe into pre-sorted adjacency rows — O(d0² log d0) per
+    node instead of the O(d0³) all-pairs comparison, which dominated
+    optimize() wall time at build scale.
     """
     nbrs = graph_j[batch_nodes]                       # (B, d0)
-    nbr_graph = graph_j[nbrs]                         # (B, d0, d0)
-    # adj[x, a, b]: is N_x[b] a neighbor of N_x[a]?
-    adj = jnp.any(nbr_graph[:, :, :, None] == nbrs[:, None, None, :], axis=2)
-    d0 = nbrs.shape[1]
+    b, d0 = nbrs.shape
+    nbr_rows = graph_sorted[nbrs]                     # (B, d0, d0) sorted
+    rows2 = nbr_rows.reshape(b * d0, d0)
+    tgts2 = jnp.broadcast_to(nbrs[:, None, :], (b, d0, d0)).reshape(
+        b * d0, d0)
+    pos = jax.vmap(jnp.searchsorted)(rows2, tgts2)    # (B*d0, d0)
+    hit = jnp.take_along_axis(rows2, jnp.minimum(pos, d0 - 1),
+                              axis=1) == tgts2
+    adj = hit.reshape(b, d0, d0)                      # adj[x, a, b]
     tri = jnp.tril(jnp.ones((d0, d0), bool), k=-1).T  # a < b strictly
     return jnp.sum(adj & tri[None], axis=1)           # (B, d0)
 
@@ -213,15 +233,20 @@ def optimize(knn_graph: np.ndarray, graph_degree: int,
     expects(graph_degree <= d0, "graph_degree %d > intermediate %d",
             graph_degree, d0)
     graph_j = jnp.asarray(knn_graph)
+    graph_sorted = jnp.sort(graph_j, axis=1)
 
-    # the detour adjacency intermediate is (B, d0, d0, d0) bools: bound it
-    # to ~1 GB so large intermediate degrees don't blow device memory
-    batch = max(32, min(batch, (1 << 30) // max(d0 ** 3, 1)))
+    # bound the ~4 live (B, d0, d0) membership intermediates (rows,
+    # broadcast targets, searchsorted positions, hits) to ~1 GB total
+    batch = max(256, min(batch * 8, (1 << 30) // max(d0 * d0 * 16, 1)))
     detours = np.zeros((n, d0), np.int32)
     count_fn = jax.jit(_detour_counts)
+    batch = min(batch, n)
     for b0 in range(0, n, batch):
-        nodes = jnp.arange(b0, min(b0 + batch, n))
-        detours[b0 : b0 + batch] = np.asarray(count_fn(graph_j, nodes))
+        hi = min(b0 + batch, n)
+        # constant batch shape (wrap the tail): one compile for all rounds
+        nodes = jnp.asarray(np.arange(b0, b0 + batch) % n)
+        detours[b0:hi] = np.asarray(
+            count_fn(graph_sorted, graph_j, nodes))[: hi - b0]
 
     # order edges by (detour_count, rank): stable argsort over composite key
     key = detours.astype(np.int64) * d0 + np.arange(d0)[None, :]
@@ -253,15 +278,21 @@ def optimize(knn_graph: np.ndarray, graph_degree: int,
     cand_j = jnp.asarray(cand)
     for b0 in range(0, n, batch):
         b1 = min(b0 + batch, n)
-        rows = jnp.arange(b0, b1, dtype=jnp.int32)
+        # constant batch shape (wrap the tail): one compile for all rounds
+        sel = jnp.asarray(np.arange(b0, b0 + batch) % n)
         out[b0:b1, keep_fwd:] = np.asarray(_merge_tail_batch(
-            kept_j[b0:b1], cand_j[b0:b1], rows, tail_w))
+            jnp.take(kept_j, sel, axis=0), jnp.take(cand_j, sel, axis=0),
+            sel.astype(jnp.int32), tail_w))[: b1 - b0]
     return out
 
 
 @tracing.annotate("raft_tpu::cagra::build")
 def build(dataset, params: IndexParams | None = None) -> Index:
     """kNN graph (IVF-PQ path) → optimize → index (cagra_build.cuh:292)."""
+    import time as _time
+
+    from ..core import logging as rlog
+
     p = params or IndexParams()
     dataset = np.asarray(dataset, np.float32)
     n = len(dataset)
@@ -271,13 +302,17 @@ def build(dataset, params: IndexParams | None = None) -> Index:
             "cagra supports L2/IP metrics, got %s", mt.name)
     d0 = min(p.intermediate_graph_degree, n - 1)
     degree = min(p.graph_degree, d0)
+    t0 = _time.perf_counter()
     if p.build_algo is BuildAlgo.NN_DESCENT:
         from . import nn_descent
         knn = nn_descent.build(dataset, d0, metric=mt,
                                n_iters=p.nn_descent_niter, seed=p.seed)
     else:
         knn = build_knn_graph(dataset, d0, mt, p.seed)
+    t1 = _time.perf_counter()
     graph = optimize(knn, degree)
+    rlog.log_info("cagra.build n=%d: knn_graph %.1fs, optimize %.1fs",
+                  n, t1 - t0, _time.perf_counter() - t1)
     return Index(jnp.asarray(dataset), jnp.asarray(graph), mt)
 
 
@@ -294,9 +329,9 @@ def _query_dists(qc, vecs, mt):
 
 
 @partial(jax.jit, static_argnames=("itopk", "width", "max_iter", "k",
-                                   "n_seeds", "mt_val"))
+                                   "n_seeds", "mt_val", "min_iter"))
 def _search_jit(dataset, dataset_score, graph, qc, mask_bits, seed_key,
-                itopk, width, max_iter, k, n_seeds, mt_val):
+                itopk, width, max_iter, k, n_seeds, mt_val, min_iter=0):
     """``dataset_score`` feeds the traversal's candidate gathers (bf16 in
     the default bandwidth-saving mode); ``dataset`` (f32) re-scores the
     final top-k exactly, so returned distances are exact regardless."""
@@ -331,7 +366,7 @@ def _search_jit(dataset, dataset_score, graph, qc, mask_bits, seed_key,
     def cond(state):
         _, buf_d, explored, it = state
         frontier_open = jnp.any(~explored & jnp.isfinite(buf_d))
-        return (it < max_iter) & frontier_open
+        return (it < max_iter) & (frontier_open | (it < min_iter))
 
     def body(state):
         buf_i, buf_d, explored, it = state
@@ -387,6 +422,14 @@ def _search_jit(dataset, dataset_score, graph, qc, mask_bits, seed_key,
     return out_d, out_i
 
 
+def prepare_search(index: Index) -> None:
+    """Eagerly attach the bf16 traversal copy of the dataset (used by the
+    default candidate_dtype). jit users call this once before tracing —
+    an unprepared index re-casts inside every jitted call."""
+    if getattr(index, "_score_bf16", None) is None:
+        index._score_bf16 = index.dataset.astype(jnp.bfloat16)
+
+
 @tracing.annotate("raft_tpu::cagra::search")
 def search(
     index: Index,
@@ -408,16 +451,25 @@ def search(
     mask_bits = filter.to_mask() if filter is not None else None
     key = jax.random.key(p.seed)
     if p.candidate_dtype in ("bfloat16", "bf16"):
-        # cache the bf16 traversal copy per index object (one cast pass)
+        # bf16 traversal copy, cached per index object (one cast pass) —
+        # never stored from inside a jax trace (leaked tracers); see
+        # prepare_search
         score = getattr(index, "_score_bf16", None)
         if score is None:
-            score = index.dataset.astype(jnp.bfloat16)
-            index._score_bf16 = score
+            from ..utils import in_jax_trace
+
+            if in_jax_trace():
+                score = index.dataset.astype(jnp.bfloat16)
+            else:
+                prepare_search(index)
+                score = index._score_bf16
     else:
         score = index.dataset
+    expects(p.algo in ("auto", "single_cta", "multi_cta", "multi_kernel"),
+            "unknown cagra search algo %r", p.algo)
     return _search_jit(index.dataset, score, index.graph, q, mask_bits, key,
                        itopk, width, int(max_iter), k, n_seeds,
-                       index.metric.value)
+                       index.metric.value, int(p.min_iterations))
 
 
 def save(index: Index, path) -> None:

@@ -35,7 +35,8 @@ from ..distance.distance_types import DistanceType, canonical_metric, is_min_clo
 from ..matrix.select_k import select_k
 from ..utils import cdiv, hdot, in_jax_trace
 
-__all__ = ["IndexParams", "SearchParams", "Index", "build", "extend", "search",
+__all__ = ["IndexParams", "SearchParams", "Index", "build",
+           "build_from_batches", "extend", "search", "prepare_scan",
            "save", "load"]
 
 # v2: store_dtype meta + uint16-framed bf16 rows + int8 scales; v1 files
@@ -174,6 +175,25 @@ def build(dataset, params: IndexParams | None = None) -> Index:
     if p.add_data_on_build:
         index = extend(index, dataset)
     return index
+
+
+@tracing.annotate("raft_tpu::ivf_flat::build_from_batches")
+def build_from_batches(batches, params: IndexParams | None = None,
+                       trainset=None) -> Index:
+    """Streaming build for corpora larger than host/device-transfer
+    budgets (role of the reference's bounded-batch extend loop,
+    detail/ivf_pq_build.cuh:1550, scaled to DEEP-1B-class inputs).
+
+    ``batches``: iterable of (b, d) row blocks (e.g.
+    ``bench.datasets.iter_fbin``); host memory stays O(batch). The coarse
+    quantizer trains on ``trainset`` when given, else on the first batch.
+    Capacity slack (``params.list_growth``, bumped to >=1.2 here) keeps
+    subsequent extends O(batch) in-place scatters.
+    """
+    from ._list_layout import streaming_build
+
+    return streaming_build(batches, params or IndexParams(), build, extend,
+                           dataclasses.replace, trainset)
 
 
 @tracing.annotate("raft_tpu::ivf_flat::extend")

@@ -52,8 +52,8 @@ from ..utils import cdiv, hdot, in_jax_trace
 from .ivf_flat import _candidate_rows, _probe_budget
 
 __all__ = ["CodebookGen", "IndexParams", "SearchParams", "Index", "build",
-           "extend", "search", "save", "load", "pack_codes", "unpack_codes",
-           "reconstruct"]
+           "build_from_batches", "extend", "search", "prepare_scan", "save",
+           "load", "pack_codes", "unpack_codes", "reconstruct"]
 
 _SERIAL_VERSION = 1
 
@@ -344,6 +344,20 @@ def build(dataset, params: IndexParams | None = None) -> Index:
     if p.add_data_on_build:
         index = extend(index, dataset)
     return index
+
+
+@tracing.annotate("raft_tpu::ivf_pq::build_from_batches")
+def build_from_batches(batches, params: IndexParams | None = None,
+                       trainset=None) -> Index:
+    """Streaming build for memory-scale corpora (DEEP-1B north star;
+    detail/ivf_pq_build.cuh:1550 bounded-batch role): quantizers train on
+    ``trainset`` (or the first batch), then every batch is assigned,
+    encoded and scattered on device — host memory stays O(batch).
+    Capacity slack (>=1.2) keeps the merges O(batch) in-place."""
+    from ._list_layout import streaming_build
+
+    return streaming_build(batches, params or IndexParams(), build, extend,
+                           dataclasses.replace, trainset)
 
 
 @tracing.annotate("raft_tpu::ivf_pq::extend")

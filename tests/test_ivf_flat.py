@@ -215,3 +215,26 @@ class TestIvfFlat:
         assert d.shape == (100, 64)
         # padded tail rows marked -1
         assert (np.asarray(i) == -1).any()
+
+
+class TestStreamingBuild:
+    def test_build_from_batches_matches_bulk_recall(self, dataset, queries):
+        batches = [dataset[i : i + 4096] for i in range(0, len(dataset), 4096)]
+        p = ivf_flat.IndexParams(n_lists=32, seed=0)
+        idx = ivf_flat.build_from_batches(iter(batches), p)
+        assert idx.size == len(dataset)
+        ids = np.asarray(idx.source_ids)
+        np.testing.assert_array_equal(np.sort(ids[ids >= 0]),
+                                      np.arange(len(dataset)))
+        _, i = ivf_flat.search(idx, queries, 10,
+                               ivf_flat.SearchParams(n_probes=16))
+        _, want = naive_knn(dataset, queries, 10)
+        assert calc_recall(np.asarray(i), want) > 0.85
+
+    def test_iter_fbin_roundtrip(self, dataset, tmp_path):
+        from raft_tpu.bench.datasets import iter_fbin, write_fbin
+
+        write_fbin(tmp_path / "x.fbin", dataset[:5000])
+        got = np.concatenate(list(iter_fbin(tmp_path / "x.fbin",
+                                            batch_rows=1111)))
+        np.testing.assert_array_equal(got, dataset[:5000])

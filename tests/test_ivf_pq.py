@@ -207,3 +207,13 @@ class TestRefine:
         want_d, want_i = naive_knn(dataset, queries, 10, "inner_product")
         assert calc_recall(np.asarray(idx), want_i) == 1.0
         np.testing.assert_allclose(np.asarray(dist), want_d, rtol=1e-2, atol=1e-2)
+
+
+def test_pq_build_from_batches(dataset, queries):
+    batches = [dataset[i : i + 4096] for i in range(0, len(dataset), 4096)]
+    p = ivf_pq.IndexParams(n_lists=32, pq_dim=8, seed=0)
+    idx = ivf_pq.build_from_batches(iter(batches), p)
+    assert idx.size == len(dataset)
+    _, i = ivf_pq.search(idx, queries, 10, ivf_pq.SearchParams(32))
+    _, want = naive_knn(dataset, queries, 10)
+    assert calc_recall(np.asarray(i), want) >= 0.45
