@@ -51,6 +51,21 @@ BASELINE_QPS = {
 }
 
 
+def robust_call(fn, what: str, tries: int = 3):
+    """Run a build/setup stage with retries (same transport-flake story as
+    median_time; builds are minutes of work we must not lose to one
+    dropped connection)."""
+    for t in range(tries):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001
+            log(f"# {what}: attempt {t + 1}/{tries} failed: "
+                f"{type(e).__name__}: {e}")
+            if t + 1 == tries:
+                raise
+            time.sleep(20 * (t + 1))
+
+
 def median_time(fn, *args, reps=5, tries=3):
     """Per-call-blocked median with retries: tunneled backends drop the
     remote-compile transport transiently; one flake must not kill a
@@ -97,14 +112,22 @@ def main():
     from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq, refine
 
     log(f"# corpus: {n}x{d}, {nq} queries, k={k}")
-    data, queries = make_corpus(n, d, nq)
+    data, queries = robust_call(lambda: make_corpus(n, d, nq), "corpus")
 
     # ground truth: exact search, f32-accurate GEMM
     bf = brute_force.build(data, metric="sqeuclidean")
     gt_fn = jax.jit(lambda q: brute_force.search(bf, q, k, algo="matmul"))
-    _, gt = gt_fn(queries)
-    gt = jax.block_until_ready(gt)
+    gt = robust_call(
+        lambda: jax.block_until_ready(gt_fn(queries)[1]), "ground truth")
     log("# ground truth done")
+    # pace check: corpus+GT is ~5% of the full-pipeline device work; when
+    # the backend is this slow (shared tenancy, degraded tunnel), trim the
+    # sweeps to one point per algo rather than overrun the budget
+    gt_elapsed = time.perf_counter() - t_start
+    hurry = gt_elapsed > budget_s / 6
+    if hurry:
+        log(f"# slow backend (corpus+GT took {gt_elapsed:.0f}s): "
+            "trimming sweeps")
 
     entries = []
 
@@ -118,7 +141,9 @@ def main():
         log(f"#   {name}: qps={qps:,.0f} recall={recall:.4f}")
 
     # --- brute force (BASELINE config 1): measured-best engine ----------
-    winner, timings = brute_force.tune_search(bf, queries, k, reps=3)
+    winner, timings = robust_call(
+        lambda: brute_force.tune_search(bf, queries, k, reps=3),
+        "engine autotune")
     sfn = jax.jit(lambda q: brute_force.search(bf, q, k, algo=winner))
     dt = median_time(sfn, queries)
     if dt is not None:
@@ -129,19 +154,21 @@ def main():
 
     # --- ivf_flat (config 2: n_lists=1024, probe sweep) -----------------
     t0 = time.perf_counter()
-    fi = ivf_flat.build(data, ivf_flat.IndexParams(n_lists=1024, seed=0))
+    fi = robust_call(lambda: ivf_flat.build(
+        data, ivf_flat.IndexParams(n_lists=1024, seed=0)), "ivf_flat build")
     jax.block_until_ready(jax.tree.leaves(fi))
     flat_build = time.perf_counter() - t0
     ivf_flat.prepare_scan(fi)   # scan prep out of the timed search graph
     log(f"# ivf_flat built in {flat_build:.0f}s")
     best = None
-    for probes in (20, 50, 100):
+    for probes in ((20,) if hurry else (20, 50, 100)):
         sp = ivf_flat.SearchParams(n_probes=probes)
         fn = jax.jit(lambda q, s=sp: ivf_flat.search(fi, q, k, s))
         dt = median_time(fn, queries)
         if dt is None:
             continue
-        rec = device_recall(fn(queries)[1], gt)
+        rec = robust_call(lambda: device_recall(fn(queries)[1], gt),
+                          "ivf_flat recall")
         add_entry("raft_ivf_flat", f"raft_ivf_flat.nlist1024.nprobe{probes}",
                   nq / dt, rec, flat_build)
         if rec >= 0.95 and (best is None or nq / dt > best[0]):
@@ -152,13 +179,14 @@ def main():
 
     # --- ivf_pq (config 3: pq_dim=64) + refine --------------------------
     t0 = time.perf_counter()
-    pi = ivf_pq.build(data, ivf_pq.IndexParams(n_lists=1024, pq_dim=64,
-                                               seed=0))
+    pi = robust_call(lambda: ivf_pq.build(
+        data, ivf_pq.IndexParams(n_lists=1024, pq_dim=64, seed=0)),
+        "ivf_pq build")
     jax.block_until_ready(jax.tree.leaves(pi))
     pq_build = time.perf_counter() - t0
     ivf_pq.prepare_scan(pi)     # scan prep out of the timed search graph
     log(f"# ivf_pq built in {pq_build:.0f}s")
-    for probes in (20, 50):
+    for probes in ((20,) if hurry else (20, 50)):
         sp = ivf_pq.SearchParams(n_probes=probes)
 
         def pq_refined(q, s=sp):
@@ -169,7 +197,8 @@ def main():
         dt = median_time(fn, queries)
         if dt is None:
             continue
-        rec = device_recall(fn(queries)[1], gt)
+        rec = robust_call(lambda: device_recall(fn(queries)[1], gt),
+                          "ivf_pq recall")
         add_entry("raft_ivf_pq",
                   f"raft_ivf_pq.nlist1024.pq64.nprobe{probes}.refine2",
                   nq / dt, rec, pq_build)
@@ -191,21 +220,23 @@ def main():
     else:
         cgt = gt
     t0 = time.perf_counter()
-    ci = cagra.build(cdata, cagra.IndexParams(
-        graph_degree=64, intermediate_graph_degree=96, seed=0))
+    ci = robust_call(lambda: cagra.build(cdata, cagra.IndexParams(
+        graph_degree=64, intermediate_graph_degree=96, seed=0)),
+        "cagra build")
     jax.block_until_ready(jax.tree.leaves(ci))
     cagra_build = time.perf_counter() - t0
     cagra.prepare_search(ci)    # bf16 traversal copy out of the timed graph
     log(f"# cagra built ({cagra_n} rows) in {cagra_build:.0f}s")
     # sweep (itopk, search_width): wider frontiers trade hops for per-hop
     # parallel work — on dispatch-latency-heavy backends width>1 is ~2x QPS
-    for itopk, width in ((32, 4), (64, 4), (64, 1)):
+    for itopk, width in (((32, 4),) if hurry else ((32, 4), (64, 4), (64, 1))):
         sp = cagra.SearchParams(itopk_size=itopk, search_width=width)
         fn = jax.jit(lambda q, s=sp: cagra.search(ci, q, k, s))
         dt = median_time(fn, queries, reps=3)
         if dt is None:
             continue
-        rec = device_recall(fn(queries)[1], cgt)
+        rec = robust_call(lambda: device_recall(fn(queries)[1], cgt),
+                          "cagra recall")
         add_entry("raft_cagra", f"raft_cagra.degree64.itopk{itopk}.w{width}",
                   nq / dt, rec, cagra_build, {"corpus_n": cagra_n})
         if rec >= 0.995:
@@ -227,8 +258,14 @@ def main():
         met = True
     else:
         flat_entries = [e for e in entries if e["algo"] == "raft_ivf_flat"]
-        top = max(flat_entries, key=lambda e: e["recall"])
-        value, rec, tag = top["qps"], top["recall"], top["name"]
+        if flat_entries:
+            top = max(flat_entries, key=lambda e: e["recall"])
+            value, rec, tag = top["qps"], top["recall"], top["name"]
+        elif entries:   # every ivf_flat point flaked: fall back to any entry
+            top = max(entries, key=lambda e: e["qps"])
+            value, rec, tag = top["qps"], top["recall"], top["name"]
+        else:
+            value, rec, tag = 0.0, 0.0, "no-measurements"
         met = False
     out = {
         "metric": f"ivf_flat_qps_at_recall095_synth1M" if n >= 1_000_000

@@ -446,6 +446,9 @@ def search(
     itopk = max(p.itopk_size, k)
     width = max(1, p.search_width)
     max_iter = p.max_iterations or (itopk // width + 16)
+    # min_iterations must win over the auto max (the reference adjusts
+    # max_iterations up the same way)
+    max_iter = max(int(max_iter), int(p.min_iterations))
     n_seeds = min(itopk, max(width * index.graph_degree // 2,
                              16 * p.num_random_samplings))
     mask_bits = filter.to_mask() if filter is not None else None

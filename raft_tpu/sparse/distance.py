@@ -26,8 +26,10 @@ __all__ = ["pairwise_distance", "SUPPORTED_METRICS"]
 
 SUPPORTED_METRICS = (
     DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+    DistanceType.L2Unexpanded, DistanceType.L2SqrtUnexpanded,
     DistanceType.InnerProduct, DistanceType.CosineExpanded,
     DistanceType.L1, DistanceType.Linf, DistanceType.Canberra,
+    DistanceType.LpUnexpanded, DistanceType.BrayCurtis,
     DistanceType.HammingUnexpanded, DistanceType.JaccardExpanded,
     DistanceType.HellingerExpanded, DistanceType.JensenShannon,
     DistanceType.KLDivergence, DistanceType.DiceExpanded,
@@ -53,8 +55,10 @@ def _dice(x, y):
 
 
 def pairwise_distance(x: CSR, y: CSR, metric="sqeuclidean",
-                      tile_rows: int = 2048) -> jax.Array:
-    """(m, n) distances between CSR row sets (distance.cuh:38 API)."""
+                      tile_rows: int = 2048,
+                      metric_arg: float = 2.0) -> jax.Array:
+    """(m, n) distances between CSR row sets (distance.cuh:38 API).
+    ``metric_arg`` is the Minkowski p for LpUnexpanded."""
     expects(isinstance(x, CSR) and isinstance(y, CSR),
             "sparse pairwise_distance takes CSR inputs")
     expects(x.shape[1] == y.shape[1], "dim mismatch %s vs %s",
@@ -74,5 +78,5 @@ def pairwise_distance(x: CSR, y: CSR, metric="sqeuclidean",
         elif mt is DistanceType.DiceExpanded:
             outs.append(_dice(xt, y_dense))
         else:
-            outs.append(dense_pairwise(xt, y_dense, mt))
+            outs.append(dense_pairwise(xt, y_dense, mt, metric_arg))
     return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]

@@ -175,3 +175,24 @@ class TestMultivariableGaussian:
         draws = np.asarray(multivariable_gaussian(RngState(0), 20000, mean, a))
         np.testing.assert_allclose(draws.mean(0), mean, atol=0.05)
         np.testing.assert_allclose(np.cov(draws.T), a, atol=0.1)
+
+
+class TestSparseMetricBreadth:
+    def test_wider_metric_set_matches_dense(self, rng):
+        from scipy.spatial import distance as sp
+
+        from raft_tpu.sparse import CSR, sparse_pairwise_distance
+
+        x = rng.standard_normal((20, 12)).astype(np.float32)
+        x[rng.random((20, 12)) < 0.5] = 0.0
+        y = rng.standard_normal((15, 12)).astype(np.float32)
+        y[rng.random((15, 12)) < 0.5] = 0.0
+        xc, yc = CSR.from_dense(x), CSR.from_dense(y)
+        for metric, ref in [
+            ("l2_unexpanded", sp.cdist(x, y, "sqeuclidean")),
+            ("braycurtis", sp.cdist(x, y, "braycurtis")),
+            ("lp", sp.cdist(x, y, "minkowski", p=3.0)),
+        ]:
+            got = np.asarray(sparse_pairwise_distance(
+                xc, yc, metric, metric_arg=3.0))
+            np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
