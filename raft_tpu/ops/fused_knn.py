@@ -39,12 +39,28 @@ _INT_BIG = 2**30  # sentinel column id, larger than any real lane index
 def _pick_tiles(dim_p: int, k: int) -> Tuple[int, int]:
     """(query-tile, dataset-tile) sizes under a ~12 MB VMEM working set.
 
-    Large query tiles amortize the dataset's HBM traffic (the kernel is
-    HBM-roofline-bound once the merge is cheap): measured on-chip,
-    tm=1024/tn=1024 beats tm=256 by ~25% at d=128. Shrink with dim so the
+    Defaults target v5e-class VMEM; override with
+    ``RAFT_TPU_FUSED_TILES=tm,tn`` when sweeping other generations.
+    Engine-level dispatch is where measurement lives: ops.autotune times
+    this whole kernel against the matmul/scan engines per shape class
+    (brute_force.tune_search), so a tile config only matters on hardware
+    where the fused kernel wins that race. Shrink with dim so the
     (tm, tn) distance block plus tiles stay inside VMEM, and with k since
     the merge working set grows with kp.
     """
+    import os
+
+    env = os.environ.get("RAFT_TPU_FUSED_TILES")
+    if env:
+        parts = env.split(",")
+        if len(parts) != 2:
+            raise ValueError(
+                f"RAFT_TPU_FUSED_TILES must be 'tm,tn', got {env!r}")
+        tm, tn = (int(v) for v in parts)
+        # snap to TPU tiling multiples (sublane 8 / lane 128)
+        tm = max(8, (tm // 8) * 8)
+        tn = max(128, (tn // 128) * 128)
+        return tm, tn
     if dim_p <= 256:
         tm, tn = 512, 1024
     elif dim_p <= 512:
@@ -148,12 +164,13 @@ def _kernel(q_ref, d_ref, dn_ref, pen_ref, ov_ref, oi_ref, sv_ref, si_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("k", "metric", "interpret", "precision"))
+                   static_argnames=("k", "metric", "interpret", "precision",
+                                    "tiles"))
 def _fused_knn_padded(q, d, dn, pen, k: int, metric: str, interpret: bool,
-                      precision: str):
+                      precision: str, tiles: Tuple[int, int]):
     m_pad, dim_p = q.shape
     n_pad = d.shape[0]
-    tm, tn = _pick_tiles(dim_p, k)
+    tm, tn = tiles
     tm = min(tm, m_pad)
     tn = min(tn, n_pad)
     kp = round_up_to(k, 128)
@@ -260,5 +277,5 @@ def fused_knn(
 
     vals, idxs = _fused_knn_padded(q, d, dn.reshape(1, -1),
                                    pen.reshape(1, -1), k, metric, interpret,
-                                   precision)
+                                   precision, (tm, tn))
     return vals[:m], idxs[:m]
