@@ -101,10 +101,33 @@ def device_recall(ids, gt):
     return float(jnp.sum(hit) / jnp.sum(gt >= 0))
 
 
+def preflight_scale(default: str = "full", limit_s: float = 120.0) -> str:
+    """Backend health probe: a fresh tiny compile+run takes ~1-40s on a
+    healthy chip. Tunneled backends degrade by orders of magnitude under
+    shared load; recording a 100k result beats timing out on a 1M corpus
+    and recording nothing."""
+    t0 = time.perf_counter()
+    try:
+        x = jax.random.normal(jax.random.PRNGKey(99), (512, 512))
+        jax.block_until_ready(jax.jit(lambda a: (a @ a).sum())(x))
+        probe_s = time.perf_counter() - t0
+    except Exception as e:  # noqa: BLE001
+        log(f"# pre-flight probe failed ({type(e).__name__}); downscaling")
+        probe_s = float("inf")
+    if probe_s > limit_s:
+        log(f"# pre-flight probe took {probe_s:.0f}s: degraded backend, "
+            "downscaling corpus to 100k")
+        return "small"
+    return default
+
+
 def main():
     t_start = time.perf_counter()
     budget_s = float(os.environ.get("RAFT_TPU_BENCH_BUDGET_S", "2400"))
-    scale = os.environ.get("RAFT_TPU_BENCH_SCALE", "full")
+    scale_env = os.environ.get("RAFT_TPU_BENCH_SCALE")
+    scale = scale_env or "full"
+    if scale_env is None:
+        scale = preflight_scale("full")
     # micro: CPU-runnable harness smoke (drives every code path in
     # minutes); small: single-chip quick run; full: the BASELINE scale
     n = {"full": 1_000_000, "small": 100_000, "micro": 20_000}[scale]

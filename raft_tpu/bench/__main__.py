@@ -45,7 +45,7 @@ def _cmd_run(args):
     results = run_benchmarks(
         base, queries, gt, k=args.k, metric=metric,
         algos=args.algorithms.split(","), batch_size=args.batch_size,
-        reps=args.reps)
+        reps=args.reps, dtype=args.dtype)
     context = {
         "date": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "dataset": args.dataset,
@@ -140,6 +140,9 @@ def main(argv=None):
     r.add_argument("--batch-size", type=int, default=None)
     r.add_argument("--reps", type=int, default=5)
     r.add_argument("--metric", default=None)
+    r.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16", "int8"],
+                   help="dataset storage dtype (brute force / ivf_flat)")
     r.add_argument("--output", default=None)
     r.set_defaults(fn=_cmd_run)
 

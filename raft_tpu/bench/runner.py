@@ -50,11 +50,11 @@ class BenchResult:
         }
 
 
-def _bf_case(base, metric):
+def _bf_case(base, metric, dtype="float32"):
     from ..neighbors import brute_force
 
     def build():
-        return brute_force.build(base, metric)
+        return brute_force.build(base, metric, dtype=dtype)
 
     def make_search(index, k):
         def fn(q):
@@ -64,12 +64,12 @@ def _bf_case(base, metric):
     return build, make_search, [{}]
 
 
-def _ivf_flat_case(base, metric, n_lists, probe_sweep):
+def _ivf_flat_case(base, metric, n_lists, probe_sweep, dtype="float32"):
     from ..neighbors import ivf_flat
 
     def build():
         return ivf_flat.build(base, ivf_flat.IndexParams(
-            n_lists=n_lists, metric=metric))
+            n_lists=n_lists, metric=metric, dtype=dtype))
 
     def make_search(index, k, n_probes=20):
         sp = ivf_flat.SearchParams(n_probes=n_probes)
@@ -121,7 +121,8 @@ def default_configs(base, metric, algos: Sequence[str],
                     pq_dim: Optional[int] = None,
                     probe_sweep: Optional[Sequence[int]] = None,
                     cagra_degree: int = 32,
-                    itopk_sweep: Optional[Sequence[int]] = None):
+                    itopk_sweep: Optional[Sequence[int]] = None,
+                    dtype: str = "float32"):
     """The raft-ann-bench default tuning envelopes
     (docs/ann_benchmarks_param_tuning.md:10-96) scaled to dataset size;
     every envelope overridable to pin a BASELINE.md config exactly."""
@@ -136,12 +137,13 @@ def default_configs(base, metric, algos: Sequence[str],
         itopk_sweep = [32, 64, 128, 256]
     cases = {}
     for a in algos:
+        dtag = "" if dtype == "float32" else f".{dtype}"
         if a == "raft_brute_force":
-            cases[a] = (_bf_case(base, metric), "")
+            cases[a] = (_bf_case(base, metric, dtype), dtag.lstrip("."))
         elif a == "raft_ivf_flat":
             cases[a] = (_ivf_flat_case(base, metric, n_lists,
-                                       list(probe_sweep)),
-                        f"nlist{n_lists}")
+                                       list(probe_sweep), dtype),
+                        f"nlist{n_lists}{dtag}")
         elif a == "raft_ivf_pq":
             cases[a] = (_ivf_pq_case(base, metric, n_lists, pq_dim,
                                      list(probe_sweep)),
@@ -166,6 +168,7 @@ def run_benchmarks(
     batch_size: Optional[int] = None,
     reps: int = 5,
     verbose: bool = True,
+    dtype: str = "float32",
 ) -> List[BenchResult]:
     """Build + sweep search params per algo; measure QPS and recall@k."""
     import jax
@@ -182,7 +185,7 @@ def run_benchmarks(
 
     results: List[BenchResult] = []
     for algo, ((build, make_search, sweep), tag) in default_configs(
-            base, metric, algos).items():
+            base, metric, algos, dtype=dtype).items():
         t0 = time.perf_counter()
         index = build()
         jax.block_until_ready(jax.tree.leaves(index))
