@@ -7,7 +7,7 @@ every point runs ``tune_select_k`` — per-call-blocked medians — and the
 winner lands in the ops.autotune cache consulted by ``algo="auto"``.
 
 Run: ``python -m raft_tpu.bench.select_k_sweep [out.json]`` on the target
-device; results ship in bench/select_k_sweep.json (repo root /bench).
+device; results ship in bench_select_k_sweep.json at the repo root.
 """
 from __future__ import annotations
 

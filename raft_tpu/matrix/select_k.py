@@ -76,8 +76,8 @@ def _auto_choice(n: int, k: int) -> "SelectAlgo":
     bucket pre-filter masks values but cannot shrink lax.top_k's input
     (its cost is shape-dependent), so radix only wins where a recorded
     measurement says the masked sort is cheaper on that hardware — run
-    ``tune_select_k`` (the bench does) to populate the cache; the sweep
-    results ship in bench/select_k_sweep.json."""
+    ``tune_select_k`` to populate the cache; a recorded on-chip sweep
+    ships in bench_select_k_sweep.json at the repo root."""
     from ..ops import autotune
 
     hit = autotune.lookup(autotune.shape_bucket("select_k", n=n, k=k))
