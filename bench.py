@@ -142,17 +142,34 @@ def main():
     # ground truth: exact search, f32-accurate GEMM. Computed in
     # same-shape query chunks (one compile, reused) with per-chunk
     # retries, so a transport flake costs one chunk, not the stage.
-    bf = brute_force.build(data, metric="sqeuclidean")
-    gt_fn = jax.jit(lambda q: brute_force.search(bf, q, k, algo="matmul")[1])
-    gchunk = 1000
-    gt_parts = []
-    for c0 in range(0, nq, gchunk):
-        part = robust_call(
-            lambda c0=c0: jax.block_until_ready(
-                gt_fn(queries[c0 : c0 + gchunk])),
-            f"ground truth [{c0}:{c0 + gchunk}]", tries=5)
-        gt_parts.append(part)
-    gt = jnp.concatenate(gt_parts)
+    def compute_gt(corpus, qs):
+        bfi = brute_force.build(corpus, metric="sqeuclidean")
+        fn = jax.jit(
+            lambda q: brute_force.search(bfi, q, k, algo="matmul")[1])
+        gchunk = 1000
+        parts = []
+        for c0 in range(0, nq, gchunk):
+            parts.append(robust_call(
+                lambda c0=c0: jax.block_until_ready(
+                    fn(qs[c0 : c0 + gchunk])),
+                f"ground truth [{c0}:{c0 + gchunk}]", tries=5))
+        return bfi, jnp.concatenate(parts)
+
+    try:
+        bf, gt = compute_gt(data, queries)
+    except Exception as e:  # noqa: BLE001
+        # the 1M-program compile is the tunnel's most fragile path; a
+        # 100k result beats recording nothing (observed: 100k compiles
+        # survive windows where 1M consistently dies). Regenerate a
+        # *matched* 100k corpus+queries (slicing would orphan queries
+        # perturbed from dropped rows and skew the distance structure).
+        if n <= 100_000:
+            raise
+        log(f"# full-scale ground truth failed ({type(e).__name__}): "
+            "regenerating a 100k corpus and continuing")
+        n = 100_000
+        data, queries = robust_call(lambda: make_corpus(n, d, nq), "corpus")
+        bf, gt = compute_gt(data, queries)
     log("# ground truth done")
     # pace check: corpus+GT is ~5% of the full-pipeline device work; when
     # the backend is this slow (shared tenancy, degraded tunnel), trim the
@@ -301,8 +318,8 @@ def main():
             value, rec, tag = 0.0, 0.0, "no-ivf-flat-measurements"
         met = False
     out = {
-        "metric": f"ivf_flat_qps_at_recall095_synth1M" if n >= 1_000_000
-        else "ivf_flat_qps_at_recall095_synth100k",
+        "metric": ("ivf_flat_qps_at_recall095_synth1M" if n >= 1_000_000
+                   else f"ivf_flat_qps_at_recall095_synth{n // 1000}k"),
         "value": round(value, 1),
         "unit": "queries/s",
         "vs_baseline": round(value / BASELINE_QPS["raft_ivf_flat"], 3),
