@@ -28,8 +28,8 @@ from ..distance.pairwise import pairwise_distance
 
 __all__ = [
     "InitMethod", "KMeansParams", "init_plus_plus", "fit", "predict",
-    "fit_predict", "transform", "cluster_cost", "fit_mini_batch",
-    "auto_find_k",
+    "fit_predict", "transform", "cluster_cost", "compute_new_centroids",
+    "fit_mini_batch", "auto_find_k",
 ]
 
 
@@ -97,6 +97,20 @@ def _update_centers(x, labels, k, old_centers):
     safe = jnp.maximum(counts, 1.0)
     centers = sums / safe[:, None]
     return jnp.where((counts > 0)[:, None], centers, old_centers), counts
+
+
+def compute_new_centroids(x, centroids, labels=None):
+    """One centroid update step given (or computing) the sample→centroid
+    assignment — the pylibraft ``cluster.kmeans.compute_new_centroids``
+    entry (SURVEY §2.7; cluster/kmeans.pyx). Empty clusters keep their
+    previous center."""
+    x = jnp.asarray(x, jnp.float32)
+    centroids = jnp.asarray(centroids, jnp.float32)
+    if labels is None:
+        labels, _ = predict(x, centroids)
+    centers, _ = _update_centers(x, jnp.asarray(labels, jnp.int32),
+                                 centroids.shape[0], centroids)
+    return centers
 
 
 @partial(jax.jit, static_argnums=(2, 3))

@@ -37,7 +37,7 @@ from ..utils import cdiv, hdot, in_jax_trace
 
 __all__ = ["IndexParams", "SearchParams", "Index", "build",
            "build_from_batches", "extend", "search", "prepare_scan",
-           "save", "load"]
+           "reconstruct", "save", "load"]
 
 # v2: store_dtype meta + uint16-framed bf16 rows + int8 scales; v1 files
 # (dense f32) remain readable
@@ -493,6 +493,22 @@ def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
                          index.centers, index.center_norms, offsets_j,
                          sizes_j, qc, k, n_probes, max_rows, mt, mask_bits,
                          index.scales)
+
+
+def reconstruct(index: Index, row_ids) -> jax.Array:
+    """Decode stored rows back to f32 input-space vectors by physical row
+    id (role of the reference's ivf_flat helpers unpack/reconstruct list
+    data, ivf_flat_helpers.cuh / ivf_flat_codepacker.hpp). Exact for f32
+    storage; dequantized (per-row scale) for bf16/int8 storage. Physical
+    row ids are what ``search`` returns before the source-id remap — i.e.
+    positions in the cluster-sorted ``index.data``; use ``source_ids`` to
+    map back to original ids."""
+    from .brute_force import dequantize_rows
+
+    row_ids = jnp.asarray(row_ids, jnp.int32)
+    rows = index.data[row_ids]
+    scales = None if index.scales is None else index.scales[row_ids]
+    return dequantize_rows(rows, scales)
 
 
 def save(index: Index, path) -> None:

@@ -76,6 +76,19 @@ class TestKMeans:
         three = kmeans.fit(x, KMeansParams(n_clusters=6, max_iter=30, seed=0, n_init=3))[1]
         assert float(three) <= float(one) + 1e-3
 
+    def test_compute_new_centroids_decreases_cost(self, rng):
+        x, _, _ = _blobs(rng, k=4)
+        centers = kmeans.init_plus_plus(x, 4, seed=3)
+        before = float(kmeans.cluster_cost(x, centers))
+        stepped = kmeans.compute_new_centroids(x, centers)
+        after = float(kmeans.cluster_cost(x, stepped))
+        assert after <= before + 1e-5
+        # explicit labels give the same update as recomputed labels
+        labels, _ = kmeans.predict(x, centers)
+        np.testing.assert_allclose(
+            np.asarray(kmeans.compute_new_centroids(x, centers, labels)),
+            np.asarray(stepped), rtol=1e-6)
+
 
 class TestBalanced:
     def test_balance_quality(self, rng):

@@ -147,6 +147,23 @@ class TestIvfFlat:
         r = calc_recall(np.asarray(idx), want)
         assert r > (0.95 if dtype == "bfloat16" else 0.9), r
 
+    @pytest.mark.parametrize("dtype,rtol", [("float32", 0.0),
+                                            ("bfloat16", 1e-2),
+                                            ("int8", 2e-2)])
+    def test_reconstruct(self, dataset, dtype, rtol):
+        index = ivf_flat.build(dataset, ivf_flat.IndexParams(
+            n_lists=64, seed=0, dtype=dtype))
+        ids = np.asarray(index.source_ids)
+        rows = np.flatnonzero(ids >= 0)[::97][:64]  # valid physical rows
+        got = np.asarray(ivf_flat.reconstruct(index, rows))
+        want = dataset[ids[rows]]
+        if dtype == "float32":
+            np.testing.assert_array_equal(got, want)
+        else:
+            err = np.abs(got - want).max(axis=1)
+            scale = np.abs(want).max(axis=1)
+            assert (err <= rtol * scale + 1e-6).all(), err.max()
+
     def test_bf16_pallas_scan_matches_xla(self, dataset, queries):
         index = ivf_flat.build(dataset, ivf_flat.IndexParams(
             n_lists=64, seed=0, dtype="bfloat16"))
