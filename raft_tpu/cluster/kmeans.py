@@ -20,8 +20,9 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from ..core import tracing
+from ..core import interop, tracing
 from ..core.errors import expects
 from ..distance.fused_l2_nn import fused_l2_nn_argmin
 from ..distance.pairwise import pairwise_distance
@@ -80,6 +81,7 @@ def _plus_plus(key, x, k):
     return centers
 
 
+@interop.auto_convert_output
 def init_plus_plus(x, n_clusters: int, seed: int = 0) -> jax.Array:
     """Public k-means++ seeding (analog of kmeans::init_plus_plus)."""
     x = jnp.asarray(x, jnp.float32)
@@ -99,17 +101,29 @@ def _update_centers(x, labels, k, old_centers):
     return jnp.where((counts > 0)[:, None], centers, old_centers), counts
 
 
+@interop.auto_convert_output
 def compute_new_centroids(x, centroids, labels=None):
     """One centroid update step given (or computing) the sample→centroid
     assignment — the pylibraft ``cluster.kmeans.compute_new_centroids``
     entry (SURVEY §2.7; cluster/kmeans.pyx). Empty clusters keep their
     previous center."""
+    from ..utils import in_jax_trace
+
     x = jnp.asarray(x, jnp.float32)
     centroids = jnp.asarray(centroids, jnp.float32)
-    if labels is None:
+    user_labels = labels is not None
+    if not user_labels:
         labels, _ = predict(x, centroids)
-    centers, _ = _update_centers(x, jnp.asarray(labels, jnp.int32),
-                                 centroids.shape[0], centroids)
+    labels = jnp.asarray(labels, jnp.int32)
+    if user_labels and not in_jax_trace() and labels.size:
+        # segment_sum drops out-of-range indices silently; fail loudly on
+        # untrusted input (predict-computed labels are in range by
+        # construction). One fused fetch: a single device->host sync.
+        lo, hi = np.asarray(jnp.stack([labels.min(), labels.max()]))
+        expects(lo >= 0 and hi < centroids.shape[0],
+                "labels out of range [0, %d): saw [%d, %d]",
+                centroids.shape[0], lo, hi)
+    centers, _ = _update_centers(x, labels, centroids.shape[0], centroids)
     return centers
 
 
@@ -134,6 +148,7 @@ def _lloyd(x, centers0, max_iter, tol):
     return centers, labels, jnp.sum(d2), n_iter
 
 
+@interop.auto_convert_output
 @tracing.annotate("raft_tpu::cluster::kmeans::fit")
 def fit(x, params: KMeansParams, centroids: Optional[jax.Array] = None):
     """Fit k-means → (centroids (k, d), inertia, n_iter).
@@ -164,29 +179,34 @@ def fit(x, params: KMeansParams, centroids: Optional[jax.Array] = None):
     return best
 
 
+@interop.auto_convert_output
 def predict(x, centroids) -> Tuple[jax.Array, jax.Array]:
     """Labels + per-sample squared distance (kmeans::predict)."""
     return fused_l2_nn_argmin(jnp.asarray(x, jnp.float32),
                               jnp.asarray(centroids, jnp.float32))
 
 
+@interop.auto_convert_output
 def fit_predict(x, params: KMeansParams):
     centers, inertia, n_iter = fit(x, params)
     labels, _ = predict(x, centers)
     return labels, centers, inertia
 
 
+@interop.auto_convert_output
 def transform(x, centroids) -> jax.Array:
     """Distance of each sample to every centroid (kmeans::transform)."""
     return pairwise_distance(x, centroids, "sqeuclidean")
 
 
+@interop.auto_convert_output
 def cluster_cost(x, centroids) -> jax.Array:
     """Total squared distance to nearest centroid (kmeans::cluster_cost)."""
     _, d2 = predict(x, centroids)
     return jnp.sum(d2)
 
 
+@interop.auto_convert_output
 @tracing.annotate("raft_tpu::cluster::kmeans::fit_mini_batch")
 def fit_mini_batch(x, params: KMeansParams):
     """Mini-batch k-means (detail/kmeans.cuh fit_main mini-batch path):

@@ -10,6 +10,8 @@ from .errors import RaftError, expects, fail
 from .interruptible import InterruptedException, synchronize
 from .kvp import KeyValuePair
 from .resources import DeviceResources, Resources, device_resources_manager
+from .interop import (as_device_array, auto_convert_output, convert_output,
+                      output_as, set_output_as)
 from . import logging, operators, serialize, tracing
 
 __all__ = [
@@ -23,6 +25,11 @@ __all__ = [
     "DeviceResources",
     "Resources",
     "device_resources_manager",
+    "as_device_array",
+    "auto_convert_output",
+    "convert_output",
+    "output_as",
+    "set_output_as",
     "logging",
     "operators",
     "serialize",

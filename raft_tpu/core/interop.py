@@ -1,0 +1,188 @@
+"""Array interop: input adoption + output-type hooks.
+
+Role of the pylibraft ``common/`` adapter layer (SURVEY §2.10):
+``cai_wrapper``/``ai_wrapper`` (pylibraft/common/cai_wrapper.py:21) adopt
+any ``__cuda_array_interface__``/``__array_interface__`` producer
+zero-copy, and ``config.py`` + ``auto_convert_output`` return outputs as
+cupy/torch per a process-wide setting.
+
+TPU analog: DLPack is the zero-copy lingua franca. ``as_device_array``
+is the explicit adoption helper for jax/numpy/torch arrays and any
+``__dlpack__`` producer (public entries themselves accept whatever
+``jnp.asarray`` understands, which includes numpy and CPU torch tensors
+via the array protocol — ``as_device_array`` adds the zero-copy DLPack
+route and an explicit place to put a dtype cast).
+``set_output_as``/``output_as`` select what public APIs hand back
+("jax" — the default, zero-cost — or "numpy"/"torch"/any callable), and
+``auto_convert_output`` is the decorator the public entries wear.
+Conversion only touches bare ``jax.Array`` leaves in tuple/list/dict
+results — index pytrees pass through untouched — and only at the
+library boundary: calls made *from raft_tpu modules* (ivf search calling
+``select_k``, ball_cover calling brute force, the bench harness) always
+keep device arrays, and under a jax trace the caller gets tracers
+regardless of the configured output type.
+
+Covered entries (everything else returns ``jax.Array``, itself a numpy-
+protocol array): neighbors ``brute_force.search/knn``,
+``ivf_flat.search``, ``ivf_pq.search``, ``cagra.search``,
+``ball_cover.knn/eps_nn/epsilon_neighborhood``, ``refine.refine``;
+``distance.pairwise_distance`` + ``fused_l2_nn_argmin`` /
+``masked_l2_nn_argmin``; ``matrix.select_k``; and the ``cluster.kmeans`` entries
+(``init_plus_plus``, ``fit``, ``predict``, ``fit_predict``,
+``transform``, ``cluster_cost``, ``compute_new_centroids``,
+``fit_mini_batch``).
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import functools
+import sys
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import in_jax_trace
+from .errors import expects
+
+__all__ = ["as_device_array", "set_output_as", "output_as",
+           "convert_output", "auto_convert_output"]
+
+# process-wide default (the pylibraft config contract) + a contextvar
+# overlay so the scoped form is thread-/async-safe
+_GLOBAL_OUTPUT: Union[str, Callable[[jax.Array], Any]] = "jax"
+_SCOPED_OUTPUT: contextvars.ContextVar[Optional[Union[str, Callable]]] = \
+    contextvars.ContextVar("raft_tpu_output_as", default=None)
+
+
+def _current_output():
+    scoped = _SCOPED_OUTPUT.get()
+    return _GLOBAL_OUTPUT if scoped is None else scoped
+
+
+def as_device_array(x, dtype=None) -> jax.Array:
+    """Adopt ``x`` as a ``jax.Array`` (zero-copy where the producer
+    allows). Accepts jax arrays, numpy arrays, torch tensors, any object
+    with ``__dlpack__``, and array-likes (lists, scalars)."""
+    if isinstance(x, jax.Array):
+        return x if dtype is None else x.astype(dtype)
+    # lazy torch detection (covers Tensor subclasses): a torch tensor
+    # can only exist if torch is already imported
+    torch = sys.modules.get("torch")
+    if torch is not None and isinstance(x, torch.Tensor):
+        t = x.detach().cpu().contiguous()
+        try:
+            # from_dlpack commits to the producer's device (CPU): re-place
+            # on the default backend so the result composes with
+            # TPU-resident arrays instead of raising a device mismatch
+            out = jax.device_put(jnp.from_dlpack(t))
+        except Exception:  # layout/dtype the dlpack route won't take
+            if t.dtype == torch.bfloat16:
+                # numpy can't represent bf16: round-trip f32, restate
+                out = jnp.asarray(np.asarray(t.float()), jnp.bfloat16)
+            else:
+                out = jnp.asarray(np.asarray(t))
+        return out if dtype is None else out.astype(dtype)
+    if hasattr(x, "__dlpack__") and not isinstance(x, np.ndarray):
+        out = jax.device_put(jnp.from_dlpack(x))
+        return out if dtype is None else out.astype(dtype)
+    return jnp.asarray(x, dtype)
+
+
+def _check_kind(kind):
+    expects(callable(kind) or kind in ("jax", "numpy", "torch"),
+            "output kind must be jax|numpy|torch or a callable, got %r",
+            kind)
+
+
+def set_output_as(kind: Union[str, Callable[[jax.Array], Any]]):
+    """Set the process-wide output type for public APIs: "jax" (default),
+    "numpy", "torch", or a callable applied to each output array (the
+    pylibraft ``config.set_output_as`` contract). Returns the previous
+    setting. Process-wide by design; for thread-safe scoping use the
+    :func:`output_as` context manager."""
+    global _GLOBAL_OUTPUT
+    _check_kind(kind)
+    prev, _GLOBAL_OUTPUT = _GLOBAL_OUTPUT, kind
+    return prev
+
+
+@contextlib.contextmanager
+def output_as(kind):
+    """Scoped :func:`set_output_as`, isolated per thread/task (contextvar
+    overlay — concurrent threads never see each other's scope)."""
+    _check_kind(kind)
+    token = _SCOPED_OUTPUT.set(kind)
+    try:
+        yield
+    finally:
+        _SCOPED_OUTPUT.reset(token)
+
+
+def _convert_leaf(x, kind):
+    if not isinstance(x, jax.Array):
+        return x
+    if callable(kind):
+        return kind(x)
+    if kind == "numpy":
+        # np.array copies: np.asarray would alias the device buffer
+        # read-only on CPU backends, breaking in-place user code
+        return np.array(x)
+    if kind == "torch":
+        import torch
+
+        if x.dtype == jnp.bfloat16:
+            # torch can't ingest ml_dtypes bf16 numpy arrays; round-trip
+            # through f32 (value-exact) and restate the dtype
+            return torch.from_numpy(
+                np.array(x.astype(jnp.float32))).to(torch.bfloat16)
+        # np.array copies: jax device buffers surface as read-only numpy
+        # views, which torch tensors must not alias
+        return torch.from_numpy(np.array(x))
+    return x
+
+
+def convert_output(out):
+    """Apply the configured output conversion to bare ``jax.Array``
+    leaves of ``out`` (recursing through tuple/list/dict — NamedTuples
+    rebuilt field-wise — so index dataclasses and other rich objects
+    pass through unchanged)."""
+    kind = _current_output()
+    if kind == "jax" or in_jax_trace():
+        return out
+    return _convert_tree(out, kind)
+
+
+def _convert_tree(out, kind):
+    if isinstance(out, jax.Array):
+        return _convert_leaf(out, kind)
+    if isinstance(out, tuple):
+        vals = (_convert_tree(v, kind) for v in out)
+        return type(out)(*vals) if hasattr(out, "_fields") else \
+            type(out)(vals)
+    if isinstance(out, list):
+        return [_convert_tree(v, kind) for v in out]
+    if isinstance(out, dict):
+        return {k: _convert_tree(v, kind) for k, v in out.items()}
+    return out
+
+
+def auto_convert_output(fn):
+    """Decorator: convert ``fn``'s result per the configured output type
+    (pylibraft ``auto_convert_output``). Conversion happens only when the
+    *caller* is outside raft_tpu — library internals that route through
+    public entries (ivf search → ``select_k``, ball_cover → brute force,
+    stats → pairwise distances, the bench harness) always keep device
+    arrays, whatever the user configured."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        caller = sys._getframe(1).f_globals.get("__name__", "")
+        if caller == "raft_tpu" or caller.startswith("raft_tpu."):
+            return out
+        return convert_output(out)
+
+    return wrapped

@@ -17,12 +17,13 @@ import jax
 import jax.numpy as jnp
 
 from ..core.errors import expects
-from ..core import tracing
+from ..core import interop, tracing
 from ..utils import hdot, round_up_to
 
 __all__ = ["fused_l2_nn_argmin", "masked_l2_nn_argmin"]
 
 
+@interop.auto_convert_output
 @tracing.annotate("raft_tpu::distance::fused_l2_nn_argmin")
 def fused_l2_nn_argmin(
     x: jax.Array,
@@ -74,6 +75,7 @@ def fused_l2_nn_argmin(
     return idx, val
 
 
+@interop.auto_convert_output
 @tracing.annotate("raft_tpu::distance::masked_l2_nn_argmin")
 def masked_l2_nn_argmin(
     x: jax.Array,

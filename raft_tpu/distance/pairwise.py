@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.errors import expects
-from ..core import tracing
+from ..core import interop, tracing
 from ..utils import cdiv, hdot
 from .distance_types import DistanceType, canonical_metric
 
@@ -178,6 +178,7 @@ def _tile_sizes(m: int, n: int, d: int, itemsize: int,
     return tm, tn
 
 
+@interop.auto_convert_output
 @tracing.annotate("raft_tpu::distance::pairwise_distance")
 def pairwise_distance(
     x: jax.Array,

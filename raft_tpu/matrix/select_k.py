@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.errors import expects
-from ..core import tracing
+from ..core import interop, tracing
 
 __all__ = ["SelectAlgo", "select_k", "tune_select_k"]
 
@@ -101,6 +101,7 @@ def tune_select_k(rows: int, n: int, k: int, select_min: bool = True,
     return autotune.tune_best(key, cands, x, reps=reps, force=True)
 
 
+@interop.auto_convert_output
 @tracing.annotate("raft_tpu::matrix::select_k")
 def select_k(
     values: jax.Array,

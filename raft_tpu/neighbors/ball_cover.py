@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..cluster import kmeans_balanced
-from ..core import tracing
+from ..core import interop, tracing
 from ..core.errors import expects
 from ..distance.distance_types import DistanceType, canonical_metric
 from ..distance.pairwise import pairwise_distance
@@ -95,6 +95,7 @@ def build(dataset, n_landmarks: int = 0, metric="sqeuclidean",
     return BallCoverIndex(idx, jnp.asarray(radii), mt)
 
 
+@interop.auto_convert_output
 @tracing.annotate("raft_tpu::ball_cover::knn")
 def knn(index: BallCoverIndex, queries, k: int, n_probes: int = 0
         ) -> Tuple[jax.Array, jax.Array]:
@@ -125,6 +126,7 @@ def knn(index: BallCoverIndex, queries, k: int, n_probes: int = 0
     return ivf_flat.search(ivf, q, k, sp)
 
 
+@interop.auto_convert_output
 @tracing.annotate("raft_tpu::ball_cover::eps_nn")
 def eps_nn(index: BallCoverIndex, queries, eps: float
            ) -> Tuple[jax.Array, jax.Array]:
@@ -159,6 +161,7 @@ def eps_nn(index: BallCoverIndex, queries, eps: float
     return adj, jnp.sum(inside, axis=1).astype(jnp.int32)
 
 
+@interop.auto_convert_output
 def epsilon_neighborhood(x, y, eps: float) -> Tuple[jax.Array, jax.Array]:
     """Dense eps-neighborhood (neighbors/epsilon_neighborhood.cuh:
     epsUnexpL2SqNeighborhood): adj[i, j] = ||x_i - y_j||² <= eps², plus

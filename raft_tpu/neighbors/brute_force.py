@@ -21,7 +21,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..core import tracing
+from ..core import interop, tracing
 from ..core.bitset import Bitset
 from ..core.errors import expects
 from ..core.serialize import load_arrays, save_arrays
@@ -273,6 +273,7 @@ def _search_pallas(index: Index, q, k, filter, valid_rows, precision):
     return vals, idxs
 
 
+@interop.auto_convert_output
 @tracing.annotate("raft_tpu::brute_force::search")
 def search(
     index: Index,
@@ -403,6 +404,7 @@ def search(
     return val, idx
 
 
+@interop.auto_convert_output
 def knn(dataset, queries, k, metric="sqeuclidean", metric_arg: float = 2.0,
         tile_size: int = 8192):
     """One-shot build+search (the reference's free-function ``knn``)."""

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import tracing
+from ..core import interop, tracing
 from ..core.bitset import Bitset
 from ..core.errors import expects
 from ..core.serialize import load_arrays, save_arrays
@@ -430,6 +430,7 @@ def prepare_search(index: Index) -> None:
         index._score_bf16 = index.dataset.astype(jnp.bfloat16)
 
 
+@interop.auto_convert_output
 @tracing.annotate("raft_tpu::cagra::search")
 def search(
     index: Index,

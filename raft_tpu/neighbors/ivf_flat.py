@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import tracing
+from ..core import interop, tracing
 from ..core.bitset import Bitset
 from ..core.errors import expects
 from ..core.resources import workspace_chunk_bytes
@@ -347,6 +347,7 @@ def _search_pallas(index, q, k, n_probes, offsets_j, sizes_j, precision,
 
 
 
+@interop.auto_convert_output
 @tracing.annotate("raft_tpu::ivf_flat::search")
 def search(
     index: Index,
@@ -502,10 +503,23 @@ def reconstruct(index: Index, row_ids) -> jax.Array:
     storage; dequantized (per-row scale) for bf16/int8 storage. Physical
     row ids are what ``search`` returns before the source-id remap — i.e.
     positions in the cluster-sorted ``index.data``; use ``source_ids`` to
-    map back to original ids."""
+    map back to original ids.
+
+    Range/slack validation runs eagerly only: under a jax trace invalid
+    ids follow gather clamp semantics (no error) — validate before
+    jitting."""
     from .brute_force import dequantize_rows
 
     row_ids = jnp.asarray(row_ids, jnp.int32)
+    if not in_jax_trace():
+        rid = np.asarray(row_ids)
+        cap = index.data.shape[0]
+        expects(rid.size == 0 or (rid.min() >= 0 and rid.max() < cap),
+                "row_ids out of range [0, %d)", cap)
+        # device-side gather, O(len(row_ids)) host transfer
+        src = np.asarray(index.source_ids[row_ids]) if rid.size else rid
+        expects((src >= 0).all(),
+                "row_ids hit capacity-slack rows (source_id -1)")
     rows = index.data[row_ids]
     scales = None if index.scales is None else index.scales[row_ids]
     return dequantize_rows(rows, scales)

@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import tracing
+from ..core import interop, tracing
 from ..core.bitset import Bitset
 from ..core.errors import expects
 from ..core.resources import workspace_chunk_bytes
@@ -490,6 +490,7 @@ def _search_pallas(index: Index, q, k, n_probes, lut_dtype, precision,
 
 
 
+@interop.auto_convert_output
 @tracing.annotate("raft_tpu::ivf_pq::search")
 def search(
     index: Index,

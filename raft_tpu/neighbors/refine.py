@@ -15,7 +15,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from ..core import tracing
+from ..core import interop, tracing
 from ..core.errors import expects
 from ..distance.distance_types import DistanceType, canonical_metric
 from ..matrix.select_k import select_k
@@ -23,6 +23,7 @@ from ..matrix.select_k import select_k
 __all__ = ["refine"]
 
 
+@interop.auto_convert_output
 @tracing.annotate("raft_tpu::refine")
 def refine(
     dataset,

@@ -88,6 +88,9 @@ class TestKMeans:
         np.testing.assert_allclose(
             np.asarray(kmeans.compute_new_centroids(x, centers, labels)),
             np.asarray(stepped), rtol=1e-6)
+        from raft_tpu.core.errors import RaftError
+        with pytest.raises(RaftError):  # labels from a different k
+            kmeans.compute_new_centroids(x, centers, np.full(len(x), 9))
 
 
 class TestBalanced:

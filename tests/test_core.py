@@ -220,3 +220,101 @@ class TestResourcesWiring:
         d2, i2 = ivf_flat.search(index, q, 5,
                                  ivf_flat.SearchParams(n_probes=8))
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+class TestInterop:
+    """pylibraft common/ analog: cai_wrapper-style input adoption +
+    config.set_output_as / auto_convert_output output hooks."""
+
+    def test_as_device_array_sources(self):
+        from raft_tpu.core import as_device_array
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        for src in (a, a.tolist(), jnp.asarray(a)):
+            out = as_device_array(src)
+            assert isinstance(out, jax.Array)
+            np.testing.assert_array_equal(np.asarray(out), a)
+        torch = pytest.importorskip("torch")
+        t = as_device_array(torch.from_numpy(a.copy()))
+        assert isinstance(t, jax.Array)
+        np.testing.assert_array_equal(np.asarray(t), a)
+        assert as_device_array(a, jnp.bfloat16).dtype == jnp.bfloat16
+
+    def test_output_as_numpy_and_torch(self):
+        from raft_tpu.core import output_as
+        from raft_tpu.matrix import select_k
+        x = jnp.asarray(np.random.default_rng(0).standard_normal((4, 32)),
+                        jnp.float32)
+        with output_as("numpy"):
+            d, i = select_k(x, k=3)
+            assert isinstance(d, np.ndarray) and isinstance(i, np.ndarray)
+        torch = pytest.importorskip("torch")
+        with output_as("torch"):
+            d, i = select_k(x, k=3)
+            assert isinstance(d, torch.Tensor) and isinstance(i, torch.Tensor)
+        d, i = select_k(x, k=3)  # default restored
+        assert isinstance(d, jax.Array) and isinstance(i, jax.Array)
+
+    def test_output_as_callable_and_nesting(self):
+        from raft_tpu.core import output_as
+        from raft_tpu.neighbors import brute_force
+        rng = np.random.default_rng(1)
+        ds = rng.standard_normal((500, 16)).astype(np.float32)
+        q = rng.standard_normal((8, 16)).astype(np.float32)
+        index = brute_force.build(ds)
+        seen = []
+        with output_as(lambda a: (seen.append(type(a)), np.asarray(a))[1]):
+            d, i = brute_force.search(index, q, k=5)
+        # outermost entry converted; internal select_k calls stayed jax
+        assert isinstance(d, np.ndarray) and isinstance(i, np.ndarray)
+        assert len(seen) == 2
+        _, want = brute_force.search(index, q, k=5)
+        np.testing.assert_array_equal(i, np.asarray(want))
+
+    def test_output_as_skipped_under_jit(self):
+        from raft_tpu.core import output_as
+        from raft_tpu.matrix import select_k
+        x = jnp.asarray(np.random.default_rng(2).standard_normal((4, 32)),
+                        jnp.float32)
+        with output_as("numpy"):
+            d, i = jax.jit(lambda v: select_k(v, k=3))(x)
+        assert isinstance(d, jax.Array) and isinstance(i, jax.Array)
+
+    def test_output_as_bf16_to_torch(self):
+        from raft_tpu.core import output_as
+        from raft_tpu.matrix import select_k
+        torch = pytest.importorskip("torch")
+        x = jnp.asarray(np.random.default_rng(3).standard_normal((2, 16)),
+                        jnp.bfloat16)
+        with output_as("torch"):
+            d, i = select_k(x, k=2)
+        assert d.dtype == torch.bfloat16
+        assert i.dtype == torch.int32
+
+    def test_convert_output_namedtuple(self):
+        from raft_tpu.core import convert_output, output_as
+        from raft_tpu.core.kvp import KeyValuePair
+        kv = KeyValuePair(jnp.zeros((3,)), jnp.ones((3,)))
+        with output_as("numpy"):
+            out = convert_output(kv)
+        assert isinstance(out, KeyValuePair)
+        assert isinstance(out.key, np.ndarray) and isinstance(out.value, np.ndarray)
+
+    def test_internal_callers_keep_device_arrays(self):
+        # an undecorated library path (ball_cover) routes through decorated
+        # entries internally; a user-set output type must not leak inside
+        from raft_tpu.core import output_as
+        from raft_tpu.neighbors import ball_cover
+        rng = np.random.default_rng(4)
+        ds = rng.standard_normal((300, 8)).astype(np.float32)
+        q = rng.standard_normal((4, 8)).astype(np.float32)
+        index = ball_cover.build(ds)
+        # knn internally routes through decorated brute_force.search and
+        # jnp-post-processes its result: if the user's converter leaked
+        # into that internal call, the jnp ops would crash on "poison".
+        # The outer knn entry is itself decorated, so the final result IS
+        # converted — exactly once, at the library boundary.
+        with output_as(lambda a: "poison"):
+            d, i = ball_cover.knn(index, q, k=3, n_probes=0)
+        assert d == "poison" and i == "poison"
+        d, i = ball_cover.knn(index, q, k=3, n_probes=0)
+        assert isinstance(d, jax.Array) and isinstance(i, jax.Array)

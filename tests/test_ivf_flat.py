@@ -164,6 +164,16 @@ class TestIvfFlat:
             scale = np.abs(want).max(axis=1)
             assert (err <= rtol * scale + 1e-6).all(), err.max()
 
+    def test_reconstruct_rejects_bad_rows(self, built_index):
+        from raft_tpu.core.errors import RaftError
+        cap = built_index.data.shape[0]
+        with pytest.raises(RaftError):
+            ivf_flat.reconstruct(built_index, [cap + 5])
+        slack = np.flatnonzero(np.asarray(built_index.source_ids) < 0)
+        if slack.size:
+            with pytest.raises(RaftError):
+                ivf_flat.reconstruct(built_index, [int(slack[0])])
+
     def test_bf16_pallas_scan_matches_xla(self, dataset, queries):
         index = ivf_flat.build(dataset, ivf_flat.IndexParams(
             n_lists=64, seed=0, dtype="bfloat16"))
