@@ -141,7 +141,7 @@ def main(argv=None):
     r.add_argument("--reps", type=int, default=5)
     r.add_argument("--metric", default=None)
     r.add_argument("--dtype", default="float32",
-                   choices=["float32", "bfloat16", "int8"],
+                   choices=["float32", "bfloat16", "int8", "uint8"],
                    help="dataset storage dtype (brute force / ivf_flat)")
     r.add_argument("--output", default=None)
     r.set_defaults(fn=_cmd_run)

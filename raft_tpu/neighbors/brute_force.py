@@ -28,7 +28,7 @@ from ..core.serialize import load_arrays, save_arrays
 from ..distance.distance_types import DistanceType, canonical_metric, is_min_close
 from ..distance.pairwise import _ELEMENTWISE, _elementwise_tile, _haversine
 from ..matrix.select_k import select_k
-from ..utils import hdot, round_up_to
+from ..utils import hdot, in_jax_trace, round_up_to
 
 __all__ = ["Index", "build", "search", "knn", "knn_merge_parts", "save",
            "load", "tune_search"]
@@ -85,8 +85,19 @@ def quantize_rows(dataset: jax.Array, dtype) -> Tuple[jax.Array, Optional[jax.Ar
         return dataset, None
     if dtype == jnp.bfloat16:
         return dataset.astype(jnp.bfloat16), None
-    expects(dtype == jnp.int8, "store dtype must be f32/bf16/int8, got %s",
-            dtype)
+    if dtype == jnp.uint8:
+        # byte corpora (SIFT/DEEP): exact for integral [0, 255] inputs,
+        # no scales (the reference's native uint8 dataset mode)
+        q = jnp.clip(jnp.round(dataset), 0, 255)
+        if not in_jax_trace():
+            # silent clamping of float data would collapse recall with no
+            # error; scaled float data belongs in int8 mode
+            expects(bool(jnp.all(jnp.abs(dataset - q) < 1e-3)),
+                    "uint8 storage expects byte-valued data (integral in "
+                    "[0, 255]); use dtype='int8' for scaled float data")
+        return q.astype(jnp.uint8), None
+    expects(dtype == jnp.int8,
+            "store dtype must be f32/bf16/int8/uint8, got %s", dtype)
     amax = jnp.max(jnp.abs(dataset), axis=1)
     scale = jnp.maximum(amax, 1e-30) / 127.0
     q = jnp.clip(jnp.round(dataset / scale[:, None]), -127, 127)
@@ -194,9 +205,9 @@ def _search_matmul(index: Index, q, k, filter, valid_rows, precision):
         if ds.dtype == jnp.bfloat16:
             lhs = qc.astype(jnp.bfloat16)
             rhs = ds
-        elif ds.dtype == jnp.int8:
-            # XLA fuses the convert into the GEMM: int8 rows stream from
-            # HBM at 1/4 the f32 traffic; scales fold in after
+        elif ds.dtype in (jnp.int8, jnp.uint8):
+            # XLA fuses the convert into the GEMM: byte rows stream from
+            # HBM at 1/4 the f32 traffic; int8 scales fold in after
             lhs, rhs = qc, ds.astype(jnp.float32)
         else:
             lhs, rhs = qc, ds
@@ -332,8 +343,8 @@ def search(
             else:
                 algo = ("pallas" if jax.default_backend() == "tpu"
                         else "scan")
-    if algo == "pallas" and index.store_dtype == jnp.int8:
-        algo = "matmul"   # int8 rides the GEMM engines (fused convert)
+    if algo == "pallas" and index.store_dtype in (jnp.int8, jnp.uint8):
+        algo = "matmul"   # byte rows ride the GEMM engines (fused convert)
     if algo == "pallas":
         expects(mt in _PALLAS_METRICS,
                 "algo='pallas' supports L2/cosine/IP, got %s", mt.name)

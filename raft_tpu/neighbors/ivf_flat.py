@@ -61,7 +61,8 @@ class IndexParams:
     seed: int = 0
     list_growth: float = 1.0
     # dataset storage dtype: float32 | bfloat16 (half the scan HBM
-    # traffic) | int8 (quarter, per-row scales) — role of the per-dtype
+    # traffic) | int8 (quarter, per-row scales) | uint8 (quarter, exact
+    # for byte corpora like SIFT/DEEP) — role of the per-dtype
     # loadAndComputeDist variants (ivf_flat_interleaved_scan-inl.cuh:99)
     dtype: str = "float32"
 
@@ -379,12 +380,13 @@ def search(
     sizes_np = index.list_sizes
     sizes_j = jnp.asarray(sizes_np, jnp.int32)
 
-    # int8 storage rides the XLA gather path (fused dequant); the pallas
-    # scan covers f32/bf16 rows
-    expects(not (algo == "pallas" and index.data.dtype == jnp.int8),
-            "algo='pallas' supports f32/bf16 storage; int8 uses the xla "
-            "gather path")
-    use_pallas = (index.data.dtype != jnp.int8 and
+    # byte (int8/uint8) storage rides the XLA gather path (fused
+    # dequant); the pallas scan covers f32/bf16 rows
+    expects(not (algo == "pallas" and
+                 index.data.dtype in (jnp.int8, jnp.uint8)),
+            "algo='pallas' supports f32/bf16 storage; int8/uint8 use the "
+            "xla gather path")
+    use_pallas = (index.data.dtype not in (jnp.int8, jnp.uint8) and
                   (algo == "pallas" or
                    (algo == "auto" and mt in _PALLAS_METRICS and
                     jax.default_backend() == "tpu")))

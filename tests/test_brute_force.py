@@ -180,9 +180,38 @@ class TestBruteForce:
         d2, i2 = brute_force.search(index, q, k=5, algo="matmul")
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
+    def test_uint8_byte_corpus_exact(self, rng):
+        # SIFT/DEEP-style byte vectors: uint8 storage is lossless, so
+        # search must match the f32 index exactly (incl. save/load)
+        data = rng.integers(0, 256, (3000, 32)).astype(np.float32)
+        q = rng.integers(0, 256, (32, 32)).astype(np.float32)
+        u8 = brute_force.build(data, dtype="uint8")
+        assert str(u8.dataset.dtype) == "uint8" and u8.scales is None
+        f32 = brute_force.build(data)
+        for algo in ("matmul", "scan"):
+            du, iu = brute_force.search(u8, q, k=10, algo=algo)
+            df, jf = brute_force.search(f32, q, k=10, algo=algo)
+            np.testing.assert_array_equal(np.asarray(iu), np.asarray(jf))
+            np.testing.assert_allclose(np.asarray(du), np.asarray(df),
+                                       rtol=1e-5)
+        # pallas redirects to the GEMM engine for byte rows
+        dp, ip = brute_force.search(u8, q, k=10, algo="pallas")
+        np.testing.assert_array_equal(np.asarray(ip),
+                                      np.asarray(brute_force.search(
+                                          u8, q, k=10, algo="matmul")[1]))
+
+    def test_uint8_rejects_float_data(self, rng):
+        from raft_tpu.core import RaftError
+        data, _ = _data(rng, n=200, m=8)  # zero-centered floats
+        with pytest.raises(RaftError, match="byte-valued"):
+            brute_force.build(data, dtype="uint8")
+
     def test_low_precision_save_load(self, tmp_path, rng):
-        for dtype in ("bfloat16", "int8"):
+        for dtype in ("bfloat16", "int8", "uint8"):
             data, q = _data(rng, n=500, m=8)
+            if dtype == "uint8":  # uint8 demands byte-valued corpora
+                data = np.round(np.clip(data * 40 + 128, 0, 255)
+                                ).astype(np.float32)
             index = brute_force.build(data, dtype=dtype)
             brute_force.save(index, tmp_path / f"bf_{dtype}.raft")
             loaded = brute_force.load(tmp_path / f"bf_{dtype}.raft")

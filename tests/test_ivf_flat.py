@@ -164,6 +164,38 @@ class TestIvfFlat:
             scale = np.abs(want).max(axis=1)
             assert (err <= rtol * scale + 1e-6).all(), err.max()
 
+    def test_uint8_byte_corpus(self):
+        rng = np.random.default_rng(11)
+        data = rng.integers(0, 256, (8000, 32)).astype(np.float32)
+        q = rng.integers(0, 256, (50, 32)).astype(np.float32)
+        u8 = ivf_flat.build(data, ivf_flat.IndexParams(
+            n_lists=32, seed=0, dtype="uint8"))
+        assert str(u8.data.dtype) == "uint8" and u8.scales is None
+        # full probe: lossless storage → exact vs brute-force oracle
+        _, idx = ivf_flat.search(u8, q, k=10,
+                                 params=ivf_flat.SearchParams(n_probes=32))
+        _, want = naive_knn(data, q, 10)
+        assert calc_recall(np.asarray(idx), want) > 0.9999
+        # reconstruct round-trips bytes exactly
+        ids = np.asarray(u8.source_ids)
+        rows = np.flatnonzero(ids >= 0)[:16]
+        np.testing.assert_array_equal(
+            np.asarray(ivf_flat.reconstruct(u8, rows)), data[ids[rows]])
+
+    def test_uint8_save_load(self, tmp_path):
+        rng = np.random.default_rng(12)
+        data = rng.integers(0, 256, (2000, 16)).astype(np.float32)
+        q = rng.integers(0, 256, (20, 16)).astype(np.float32)
+        u8 = ivf_flat.build(data, ivf_flat.IndexParams(
+            n_lists=8, seed=0, dtype="uint8"))
+        ivf_flat.save(u8, tmp_path / "u8.raft")
+        loaded = ivf_flat.load(tmp_path / "u8.raft")
+        assert str(loaded.data.dtype) == "uint8"
+        sp = ivf_flat.SearchParams(n_probes=8)
+        _, i1 = ivf_flat.search(u8, q, 5, sp)
+        _, i2 = ivf_flat.search(loaded, q, 5, sp)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
     def test_reconstruct_rejects_bad_rows(self, built_index):
         from raft_tpu.core.errors import RaftError
         cap = built_index.data.shape[0]
