@@ -51,7 +51,7 @@ class Index:
     ``norms`` are always exact f32 norms of the *stored* representation.
     """
 
-    dataset: jax.Array          # (n, d) f32 | bf16 | int8
+    dataset: jax.Array          # (n, d) f32 | bf16 | int8 | uint8
     norms: Optional[jax.Array]  # (n,) squared L2 norms, for expanded metrics
     metric: DistanceType
     metric_arg: float = 2.0
@@ -118,8 +118,10 @@ def build(dataset: jax.Array, metric="sqeuclidean", metric_arg: float = 2.0,
     """Build = store dataset + precompute norms (no training).
 
     ``dtype``: storage dtype — float32 (exact), bfloat16 (half the HBM
-    scan traffic, ~1e-3 relative distance error) or int8 (quarter
-    traffic, per-row symmetric quantization; the ANN-candidate mode).
+    scan traffic, ~1e-3 relative distance error), int8 (quarter
+    traffic, per-row symmetric quantization; the ANN-candidate mode) or
+    uint8 (quarter traffic, exact — byte-valued corpora like SIFT/DEEP
+    only; scaled float data belongs in int8).
     """
     dataset = jnp.asarray(dataset, jnp.float32)
     expects(dataset.ndim == 2, "dataset must be (n, d)")

@@ -87,7 +87,7 @@ class Index:
     (n_lists, d).
     """
 
-    data: jax.Array                # (cap_total, d) f32 | bf16 | int8
+    data: jax.Array                # (cap_total, d) f32 | bf16 | int8 | uint8
     data_norms: jax.Array          # (cap_total,) exact f32 (of stored rep)
     source_ids: jax.Array
     centers: jax.Array
