@@ -177,25 +177,33 @@ def run_benchmarks(
 
     base = np.asarray(base, np.float32)
     queries = np.asarray(queries, np.float32)
-    if dtype == "uint8" and not (base.min() >= 0 and base.max() <= 255
-                                 and np.all(base == np.round(base))):
-        # uint8 storage is exact bytes only: discretize float corpora to
-        # the byte grid via an affine map applied to base AND queries.
-        # The shared shift preserves L2 distance ordering only — and only
-        # the dtype-consuming algos may be in the run (ivf_pq/cagra would
-        # otherwise silently benchmark remapped data vs original gt).
-        expects(metric in ("sqeuclidean", "euclidean", "l2", "L2Expanded",
-                           "L2SqrtExpanded"),
-                "uint8 on a float corpus requires an L2 metric (the byte-"
-                "grid shift reorders cosine/IP neighbors); got %r", metric)
-        expects(set(algos) <= {"raft_brute_force", "raft_ivf_flat"},
-                "uint8 on a float corpus: restrict --algorithms to "
-                "raft_brute_force/raft_ivf_flat (other algos ignore dtype "
-                "and would run on remapped data against original gt)")
-        lo = float(base.min())
-        scale = 255.0 / max(float(base.max()) - lo, 1e-30)
-        base = np.round((base - lo) * scale).astype(np.float32)
-        queries = ((queries - lo) * scale).astype(np.float32)
+    if dtype == "uint8":
+        mn, mx = float(base.min()), float(base.max())
+        sample = base[:: max(1, len(base) // 4096)]  # cheap gate; the
+        # builder's eager byte-validation is the authoritative full check
+        if not (mn >= 0 and mx <= 255
+                and np.all(sample == np.round(sample))):
+            # uint8 storage is exact bytes only: discretize float corpora
+            # to the byte grid via an affine map applied to base AND
+            # queries. The shared shift preserves L2 distance ordering
+            # only — and only the dtype-consuming algos may be in the run
+            # (ivf_pq/cagra would otherwise silently benchmark remapped
+            # data vs original gt).
+            from ..distance.distance_types import (DistanceType,
+                                                   canonical_metric)
+
+            expects(canonical_metric(metric) in (
+                        DistanceType.L2Expanded, DistanceType.L2SqrtExpanded),
+                    "uint8 on a float corpus requires an L2 metric (the "
+                    "byte-grid shift reorders cosine/IP neighbors); got %r",
+                    metric)
+            expects(set(algos) <= {"raft_brute_force", "raft_ivf_flat"},
+                    "uint8 on a float corpus: restrict --algorithms to "
+                    "raft_brute_force/raft_ivf_flat (other algos ignore "
+                    "dtype and would run on remapped data vs original gt)")
+            scale = 255.0 / max(mx - mn, 1e-30)
+            base = np.round((base - mn) * scale).astype(np.float32)
+            queries = ((queries - mn) * scale).astype(np.float32)
     gt = np.asarray(gt_indices)[:, :k]
     if batch_size:
         queries = queries[:batch_size]

@@ -68,9 +68,10 @@ class ShardedIvfFlat:
     """Stacked per-shard IVF-Flat arrays, leading axis sharded over AXIS."""
 
     def __init__(self, mesh, data, data_norms, source_ids, centers,
-                 center_norms, offsets, sizes, n_total, metric, max_rows_tbl):
+                 center_norms, offsets, sizes, n_total, metric, max_rows_tbl,
+                 scales=None):
         self.mesh = mesh
-        self.data = data                    # (p, R, d)
+        self.data = data                    # (p, R, d) f32|bf16|int8|uint8
         self.data_norms = data_norms        # (p, R)
         self.source_ids = source_ids        # (p, R) global ids, -1 pad
         self.centers = centers              # (p, L, d)
@@ -80,6 +81,7 @@ class ShardedIvfFlat:
         self.n_total = n_total
         self.metric = metric
         self._max_rows_tbl = max_rows_tbl   # host: n_probes → max_rows bound
+        self.scales = scales                # (p, R) f32, int8 mode only
 
     @property
     def n_shards(self) -> int:
@@ -107,9 +109,6 @@ def build_ivf_flat(dataset, mesh: Mesh,
             "n_lists %d > smallest shard %d", p0.n_lists,
             min(len(r) for r in parts))
 
-    expects(jnp.dtype(p0.dtype) != jnp.int8,
-            "sharded ivf_flat supports f32/bf16 storage (int8 per-row "
-            "scales are not threaded through the stacked layout yet)")
     shards = [ivf_flat.build(dataset[rows], p0) for rows in parts]
     mt = shards[0].metric
 
@@ -129,13 +128,17 @@ def build_ivf_flat(dataset, mesh: Mesh,
     def put(x, spec):
         return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
 
+    scales = None
+    if shards[0].scales is not None:   # int8: per-row dequant factors
+        scales = put(_stack_pad([np.asarray(s.scales) for s in shards]),
+                     P(AXIS, None))
     return ShardedIvfFlat(
         mesh,
         put(data, P(AXIS, None, None)), put(norms, P(AXIS, None)),
         put(gids, P(AXIS, None)),
         put(centers, P(AXIS, None, None)), put(cnorms, P(AXIS, None)),
         put(offsets, P(AXIS, None)), put(sizes, P(AXIS, None)),
-        n, mt, [s.list_sizes for s in shards])
+        n, mt, [s.list_sizes for s in shards], scales)
 
 
 def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
@@ -150,26 +153,35 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
     select_min = is_min_close(mt)
     comms = _comms_of(index.mesh, res)
 
-    def local(data, norms, gids, centers, cnorms, offsets, sizes, qq):
+    has_scales = index.scales is not None
+
+    def local(data, norms, gids, centers, cnorms, offsets, sizes, qq,
+              *rest):
         args = [a[0] for a in (data, norms, gids, centers, cnorms, offsets,
                                sizes)]
+        sc = rest[0][0] if has_scales else None
         d, i = ivf_flat.search_arrays(
             args[0], args[1], args[2], args[3], args[4], args[5], args[6],
-            qq, k, n_probes, max_rows, mt)
+            qq, k, n_probes, max_rows, mt, scales=sc)
         all_d = comms.allgather(d)              # (p, m, k)
         all_i = comms.allgather(i)
         return brute_force.knn_merge_parts(all_d, all_i, select_min)
 
+    in_specs = [P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
+                P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
+                P(AXIS, None), P()]
+    arrays = [index.data, index.data_norms, index.source_ids,
+              index.centers, index.center_norms, index.offsets,
+              index.sizes, q]
+    if has_scales:
+        in_specs.append(P(AXIS, None))
+        arrays.append(index.scales)
     shmap = jax.shard_map(
         local, mesh=index.mesh,
-        in_specs=(P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
-                  P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
-                  P(AXIS, None), P()),
+        in_specs=tuple(in_specs),
         out_specs=(P(), P()),
         check_vma=False)
-    return shmap(index.data, index.data_norms, index.source_ids,
-                 index.centers, index.center_norms, index.offsets,
-                 index.sizes, q)
+    return shmap(*arrays)
 
 
 class ShardedCagra:

@@ -40,6 +40,23 @@ class TestShardedIvfFlat:
         assert calc_recall(np.asarray(i), want_i) == 1.0
         np.testing.assert_allclose(np.asarray(d), want_d, rtol=1e-2, atol=1e-2)
 
+    @pytest.mark.parametrize("dtype", ["bfloat16", "int8", "uint8"])
+    def test_low_precision_storage(self, mesh, dataset, queries, dtype):
+        data, q = dataset, queries
+        if dtype == "uint8":  # byte-valued corpus for exact uint8 storage
+            data = np.round(np.clip(data * 40 + 128, 0, 255)
+                            ).astype(np.float32)
+            q = np.round(np.clip(q * 40 + 128, 0, 255)).astype(np.float32)
+        index = sharded_ann.build_ivf_flat(
+            data, mesh, ivf_flat.IndexParams(n_lists=16, seed=0,
+                                             dtype=dtype))
+        d, i = sharded_ann.search_ivf_flat(
+            index, q, k=10, params=ivf_flat.SearchParams(n_probes=16))
+        _, want_i = naive_knn(data, q, 10)
+        r = calc_recall(np.asarray(i), want_i)
+        floor = {"bfloat16": 0.95, "int8": 0.9, "uint8": 0.9999}[dtype]
+        assert r > floor, r
+
     def test_partial_probes(self, mesh, dataset, queries):
         index = sharded_ann.build_ivf_flat(
             dataset, mesh, ivf_flat.IndexParams(n_lists=16, seed=0))
