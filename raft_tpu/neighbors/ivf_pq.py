@@ -57,6 +57,9 @@ __all__ = ["CodebookGen", "IndexParams", "SearchParams", "Index", "build",
 
 _SERIAL_VERSION = 1
 
+# auto-dispatch downgrade reasons already logged (once per process)
+_GATHER_FALLBACK_LOGGED: set = set()
+
 
 class CodebookGen(enum.Enum):
     """ivf_pq_types.hpp:43 codebook_gen."""
@@ -526,6 +529,20 @@ def search(
                    index.codebook_kind is CodebookGen.PER_SUBSPACE and
                    not wide_needs_bf16 and
                    jax.default_backend() == "tpu"))
+    if (algo == "auto" and not use_pallas and not in_jax_trace()
+            and jax.default_backend() == "tpu"):
+        # make the kernel→gather downgrade visible — once per reason, not
+        # per call (serving loops would otherwise spam identical lines)
+        why = ("PER_CLUSTER codebooks"
+               if index.codebook_kind is CodebookGen.PER_CLUSTER
+               else "f32 LUT with wide PQ "
+                    "(set SearchParams.lut_dtype=bfloat16)")
+        if why not in _GATHER_FALLBACK_LOGGED:
+            _GATHER_FALLBACK_LOGGED.add(why)
+            from ..core.logging import logger
+
+            logger.info("ivf_pq auto: XLA gather path (%s); the pallas "
+                        "scan kernel does not cover this config", why)
     if use_pallas:
         expects(index.codebook_kind is CodebookGen.PER_SUBSPACE,
                 "algo='pallas' needs PER_SUBSPACE codebooks")

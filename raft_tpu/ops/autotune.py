@@ -105,15 +105,48 @@ def record(key: str, choice: str) -> None:
     save_cache()
 
 
-def measure(fn: Callable, *args, reps: int = 5) -> float:
+def measure(fn: Callable, *args, reps: int = 5, out0=None) -> float:
     """Median seconds per call, one blocking sync per call (see module
-    docstring for why per-call blocking is load-bearing)."""
-    out = fn(*args)
-    jax.block_until_ready(out)      # compile + warm
+    docstring for why per-call blocking is load-bearing).
+
+    Each rep scales the first float-array argument by a distinct factor
+    a few ulps above 1 (dtype-aware — an additive 1e-6 would round away
+    entirely for bf16 or large-magnitude f32) AND adds a zero-valued
+    dependency on the previous rep's output: tunneled backends have been
+    observed serving value-identical replays from a result cache (a
+    150 ms search "measuring" 0.1 ms on later reps), and the chain +
+    perturb makes every rep distinct, ordered, real work.
+
+    ``out0``: pre-warmed output of ``fn(*args)`` — pass it to skip the
+    internal warmup call when the caller already compiled+ran ``fn``.
+    """
+    import jax.numpy as jnp
+
+    if out0 is None:
+        out0 = fn(*args)
+        jax.block_until_ready(out0)      # compile + warm
+    out = out0
+
+    first = args[0] if args else None
+    can_vary = (isinstance(first, jax.Array)
+                and jnp.issubdtype(first.dtype, jnp.inexact))
+    if can_vary:
+        ulp = float(jnp.finfo(first.dtype).eps)
+
     ts = []
-    for _ in range(reps):
+    for r in range(reps):
+        if can_vary:
+            dep = jax.tree_util.tree_leaves(out)[0].ravel()[0]
+            # inf/NaN-safe zero that still depends on the previous output
+            dep0 = jnp.where(jnp.isfinite(dep), dep, 0).astype(
+                first.dtype) * 0
+            a0 = first * jnp.asarray(1 + (r + 1) * 4 * ulp,
+                                     first.dtype) + dep0
+            args_r = (a0,) + args[1:]
+        else:
+            args_r = args
         t0 = time.perf_counter()
-        out = fn(*args)
+        out = fn(*args_r)
         jax.block_until_ready(out)
         ts.append(time.perf_counter() - t0)
     ts.sort()
