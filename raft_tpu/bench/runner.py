@@ -185,10 +185,16 @@ def run_benchmarks(
         maybe_bytes = (mn >= 0 and mx <= 255
                        and np.all(sample == np.round(sample)))
         # full integrality scan only when the sample says bytes (float
-        # corpora — the remap path — never pay it); without it a corpus
-        # with sparse fractional rows would skip the remap and crash in
-        # the builder's byte validation mid-bench
-        if not (maybe_bytes and np.array_equal(base, np.round(base))):
+        # corpora — the remap path — never pay it); chunked with
+        # early-exit so no full-corpus temporary is materialized. Without
+        # it a corpus with sparse fractional rows would skip the remap
+        # and crash in the builder's byte validation mid-bench
+        def _all_integral(a, rows=1 << 16):
+            return all(np.array_equal(c, np.round(c))
+                       for c in (a[i : i + rows]
+                                 for i in range(0, len(a), rows)))
+
+        if not (maybe_bytes and _all_integral(base)):
             # uint8 storage is exact bytes only: discretize float corpora
             # to the byte grid via an affine map applied to base AND
             # queries. The shared shift preserves L2 distance ordering

@@ -247,7 +247,8 @@ def _search_matmul(index: Index, q, k, filter, valid_rows, precision):
     return vals, idxs
 
 
-def tune_search(index: Index, queries, k: int, reps: int = 5):
+def tune_search(index: Index, queries, k: int, reps: int = 5,
+                suspect_floor_s: float = 0.0):
     """Measure the search engines on-device for this shape class and cache
     the winner (consulted by ``algo="auto"``). Returns (winner, timings).
 
@@ -266,7 +267,8 @@ def tune_search(index: Index, queries, k: int, reps: int = 5):
     if index.metric in _PALLAS_METRICS and jax.default_backend() == "tpu":
         cands["pallas"] = jax.jit(
             lambda qq: search(index, qq, k, algo="pallas"))
-    return autotune.tune_best(key, cands, q, reps=reps, force=True)
+    return autotune.tune_best(key, cands, q, reps=reps, force=True,
+                              suspect_floor_s=suspect_floor_s)
 
 
 def _search_pallas(index: Index, q, k, filter, valid_rows, precision):

@@ -529,10 +529,11 @@ def search(
                    index.codebook_kind is CodebookGen.PER_SUBSPACE and
                    not wide_needs_bf16 and
                    jax.default_backend() == "tpu"))
-    if (algo == "auto" and not use_pallas and not in_jax_trace()
+    if (algo == "auto" and not use_pallas
             and jax.default_backend() == "tpu"):
         # make the kernel→gather downgrade visible — once per reason, not
-        # per call (serving loops would otherwise spam identical lines)
+        # per call; fires at trace time too (jitted callers like the
+        # bench harnesses only ever execute this body while tracing)
         why = ("PER_CLUSTER codebooks"
                if index.codebook_kind is CodebookGen.PER_CLUSTER
                else "f32 LUT with wide PQ "

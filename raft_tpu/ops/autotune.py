@@ -105,7 +105,44 @@ def record(key: str, choice: str) -> None:
     save_cache()
 
 
-def measure(fn: Callable, *args, reps: int = 5, out0=None) -> float:
+def _timed_reps(fn: Callable, args, reps: int, out0):
+    import jax.numpy as jnp
+
+    out = out0
+    first = args[0] if args else None
+    can_vary = (isinstance(first, jax.Array)
+                and jnp.issubdtype(first.dtype, jnp.inexact))
+    if can_vary:
+        ulp = float(jnp.finfo(first.dtype).eps)
+
+    ts = []
+    for r in range(reps):
+        if can_vary:
+            leaves = jax.tree_util.tree_leaves(out)
+            a0 = first * jnp.asarray(1 + (r + 1) * 4 * ulp, first.dtype)
+            if leaves and isinstance(leaves[0], jax.Array):
+                dep = leaves[0].ravel()[0]
+                # inf/NaN-safe zero that still depends on the previous
+                # output (ordering chain)
+                a0 = a0 + jnp.where(jnp.isfinite(dep), dep, 0).astype(
+                    first.dtype) * 0
+            # settle the perturbation ops before the timed window opens:
+            # for microsecond-scale probes the 3-4 eager ops building a0
+            # would otherwise still be in flight at t0
+            jax.block_until_ready(a0)
+            args_r = (a0,) + args[1:]
+        else:
+            args_r = args
+        t0 = time.perf_counter()
+        out = fn(*args_r)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def measure(fn: Callable, *args, reps: int = 5, out0=None,
+            suspect_floor_s: float = 0.0) -> float:
     """Median seconds per call, one blocking sync per call (see module
     docstring for why per-call blocking is load-bearing).
 
@@ -119,43 +156,47 @@ def measure(fn: Callable, *args, reps: int = 5, out0=None) -> float:
 
     ``out0``: pre-warmed output of ``fn(*args)`` — pass it to skip the
     internal warmup call when the caller already compiled+ran ``fn``.
-    """
-    import jax.numpy as jnp
 
+    ``suspect_floor_s``: physical-plausibility floor. The tunnel has a
+    second lying mode where even value-distinct chained dispatches return
+    "done" in ~50 us — keyed per *executable*, so the defense is a fresh
+    compile: when the median lands below the floor, ``fn`` is re-wrapped
+    in a new outer ``jax.jit`` (new executable) and re-measured; the
+    larger (more credible) median is returned and the event is logged.
+    0 disables the check. Callers set it to a lower bound no real call of
+    theirs could beat (e.g. milliseconds for a 10k-query search batch).
+    """
     if out0 is None:
         out0 = fn(*args)
         jax.block_until_ready(out0)      # compile + warm
-    out = out0
 
-    first = args[0] if args else None
-    can_vary = (isinstance(first, jax.Array)
-                and jnp.issubdtype(first.dtype, jnp.inexact))
-    if can_vary:
-        ulp = float(jnp.finfo(first.dtype).eps)
-
-    ts = []
-    for r in range(reps):
-        if can_vary:
-            dep = jax.tree_util.tree_leaves(out)[0].ravel()[0]
-            # inf/NaN-safe zero that still depends on the previous output
-            dep0 = jnp.where(jnp.isfinite(dep), dep, 0).astype(
-                first.dtype) * 0
-            a0 = first * jnp.asarray(1 + (r + 1) * 4 * ulp,
-                                     first.dtype) + dep0
-            args_r = (a0,) + args[1:]
-        else:
-            args_r = args
-        t0 = time.perf_counter()
-        out = fn(*args_r)
-        jax.block_until_ready(out)
-        ts.append(time.perf_counter() - t0)
-    ts.sort()
-    return ts[len(ts) // 2]
+    med = _timed_reps(fn, args, reps, out0)
+    if suspect_floor_s and med < suspect_floor_s:
+        rlog.log_warn(
+            "measure: median %.3g s below plausibility floor %.3g s — "
+            "re-measuring through a fresh executable (tunnel replay mode)",
+            med, suspect_floor_s)
+        try:
+            fresh = jax.jit(lambda *a: fn(*a))
+            out0 = fresh(*args)
+            jax.block_until_ready(out0)      # fresh compile + warm
+            med2 = _timed_reps(fresh, args, reps, out0)
+        except Exception as e:  # noqa: BLE001 - fn not re-jittable
+            rlog.log_warn("measure: fresh-executable re-measure failed "
+                          "(%s); keeping suspect median", e)
+            return med
+        if med2 < suspect_floor_s:
+            rlog.log_warn(
+                "measure: fresh executable also below floor (%.3g s) — "
+                "timing on this backend window is unreliable", med2)
+        med = max(med, med2)
+    return med
 
 
 def tune_best(key: str, candidates: Mapping[str, Callable], *args,
               reps: int = 5,
-              force: bool = False) -> Tuple[str, Dict[str, float]]:
+              force: bool = False,
+              suspect_floor_s: float = 0.0) -> Tuple[str, Dict[str, float]]:
     """Measure every candidate on device, record + return the winner.
 
     Returns (winner name, {name: median seconds}). Failures (e.g. a kernel
@@ -168,7 +209,8 @@ def tune_best(key: str, candidates: Mapping[str, Callable], *args,
     timings: Dict[str, float] = {}
     for name, fn in candidates.items():
         try:
-            timings[name] = measure(fn, *args, reps=reps)
+            timings[name] = measure(fn, *args, reps=reps,
+                                    suspect_floor_s=suspect_floor_s)
         except Exception as e:  # noqa: BLE001 - any engine failure = skip
             rlog.log_warn("autotune %s: candidate %s failed: %s", key, name, e)
     if not timings:
