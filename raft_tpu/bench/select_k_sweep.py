@@ -44,7 +44,9 @@ def run(out_path: str | None = None) -> dict:
     dev = jax.devices()[0]
     doc = {
         "device": f"{dev.platform}:{getattr(dev, 'device_kind', '?')}",
-        "methodology": "tune_select_k: per-call-blocked median of 5",
+        "methodology": ("tune_select_k: per-call-blocked median of 5, "
+                        "per-rep input perturb + output chain "
+                        "(anti replay-cache)"),
         "results": results,
     }
     if out_path:
