@@ -126,10 +126,13 @@ def _timed_reps(fn: Callable, args, reps: int, out0):
             a0 = first * jnp.asarray(1 + (r + 1) * 4 * ulp, first.dtype)
             if leaves and isinstance(leaves[0], jax.Array):
                 dep = leaves[0].ravel()[0]
-                # inf/NaN-safe zero that still depends on the previous
-                # output (ordering chain)
-                a0 = a0 + jnp.where(jnp.isfinite(dep), dep, 0).astype(
-                    first.dtype) * 0
+                # REAL (nonzero) dependency on the previous output: a
+                # `* 0` chain could be shortcut by a value-analyzing
+                # backend; a 1e-12-scaled finite term cannot be built
+                # until the previous result's value exists, yet perturbs
+                # the input by ~nothing numerically
+                a0 = a0 + (jnp.where(jnp.isfinite(dep), dep, 0)
+                           * 1e-12).astype(first.dtype)
             # settle the perturbation ops before the timed window opens:
             # for microsecond-scale probes the 3-4 eager ops building a0
             # would otherwise still be in flight at t0
@@ -152,11 +155,12 @@ def measure(fn: Callable, *args, reps: int = 5, out0=None,
 
     Each rep scales the first float-array argument by a distinct factor
     a few ulps above 1 (dtype-aware — an additive 1e-6 would round away
-    entirely for bf16 or large-magnitude f32) AND adds a zero-valued
-    dependency on the previous rep's output: tunneled backends have been
-    observed serving value-identical replays from a result cache (a
-    150 ms search "measuring" 0.1 ms on later reps), and the chain +
-    perturb makes every rep distinct, ordered, real work.
+    entirely for bf16 or large-magnitude f32) AND adds a *real* (nonzero,
+    1e-12-scaled) dependency on the previous rep's output — a `* 0` chain
+    could be shortcut by a value-analyzing backend: tunneled backends
+    have been observed serving value-identical replays from a result
+    cache (a 150 ms search "measuring" 0.1 ms on later reps), and the
+    chain + perturb makes every rep distinct, ordered, real work.
 
     ``out0``: pre-warmed output of ``fn(*args)`` — pass it to skip the
     internal warmup call when the caller already compiled+ran ``fn``.
