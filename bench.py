@@ -51,10 +51,17 @@ BASELINE_QPS = {
 }
 
 
-def robust_call(fn, what: str, tries: int = 3):
+def robust_call(fn, what: str, tries: int = 3, deadline: float = 0.0):
     """Run a build/setup stage with retries (same transport-flake story as
     median_time; builds are minutes of work we must not lose to one
-    dropped connection)."""
+    dropped connection).
+
+    ``deadline``: absolute ``time.perf_counter()`` cutoff — when a retry
+    would start past it, give up immediately instead. On fragile nights a
+    single 1M-program compile retry can run 15+ minutes; without a
+    deadline the ground-truth stage can consume the whole bench budget
+    before any measurement exists (the caller's downscale fallback needs
+    time left to be useful)."""
     for t in range(tries):
         try:
             return fn()
@@ -62,6 +69,9 @@ def robust_call(fn, what: str, tries: int = 3):
             log(f"# {what}: attempt {t + 1}/{tries} failed: "
                 f"{type(e).__name__}: {e}")
             if t + 1 == tries:
+                raise
+            if deadline and time.perf_counter() > deadline:
+                log(f"# {what}: stage deadline passed; not retrying")
                 raise
             time.sleep(20 * (t + 1))
 
@@ -154,12 +164,23 @@ def main():
         fn = jax.jit(
             lambda q: brute_force.search(bfi, q, k, algo="matmul")[1])
         gchunk = 1000
+        # stage deadline: if full-scale GT can't land inside ~35% of the
+        # budget, stop retrying so the downscale fallback still has time
+        # to produce a recorded result
+        gt_deadline = t_start + 0.35 * budget_s
+        full_scale = len(corpus) > 100_000
         parts = []
         for c0 in range(0, nq, gchunk):
+            # deadline applies before each launch too: slow-but-succeeding
+            # chunks must not eat the budget any more than failing ones
+            if full_scale and time.perf_counter() > gt_deadline:
+                raise RuntimeError(
+                    f"ground truth stage deadline exceeded at [{c0}]")
             parts.append(robust_call(
                 lambda c0=c0: jax.block_until_ready(
                     fn(qs[c0 : c0 + gchunk])),
-                f"ground truth [{c0}:{c0 + gchunk}]", tries=5))
+                f"ground truth [{c0}:{c0 + gchunk}]", tries=5,
+                deadline=gt_deadline if full_scale else 0.0))
         return bfi, jnp.concatenate(parts)
 
     try:

@@ -128,11 +128,16 @@ def _timed_reps(fn: Callable, args, reps: int, out0):
                 dep = leaves[0].ravel()[0]
                 # REAL (nonzero) dependency on the previous output: a
                 # `* 0` chain could be shortcut by a value-analyzing
-                # backend; a 1e-12-scaled finite term cannot be built
-                # until the previous result's value exists, yet perturbs
-                # the input by ~nothing numerically
-                a0 = a0 + (jnp.where(jnp.isfinite(dep), dep, 0)
-                           * 1e-12).astype(first.dtype)
+                # backend. The term is sign(dep) (value-dependent, never
+                # foldable) scaled to a few multiples of the dtype's
+                # smallest normal — representable in ANY float dtype
+                # (a fixed 1e-12 underflows to exactly 0 in f16), yet
+                # numerically negligible
+                depf = jnp.where(jnp.isfinite(dep), dep, 0).astype(
+                    jnp.float32)
+                sgn = jnp.sign(depf) + (depf == 0)
+                a0 = a0 + (sgn * (4 * float(jnp.finfo(first.dtype).tiny))
+                           ).astype(first.dtype)
             # settle the perturbation ops before the timed window opens:
             # for microsecond-scale probes the 3-4 eager ops building a0
             # would otherwise still be in flight at t0
