@@ -25,8 +25,10 @@ import sys
 import time
 
 # persistent executable cache: lets the full-scale compile probe's child
-# process pre-pay the fragile 1M compile for the parent (no-op where the
-# backend can't serialize executables)
+# process pre-pay the fragile 1M compile for the parent. NOTE:
+# ops.autotune.measure disables this cache around its fresh-executable
+# re-measure — a cache hit there would replay the very executable whose
+# timing is under suspicion.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/raft_tpu_xla_cache")
 
 import jax  # noqa: E402
@@ -130,6 +132,8 @@ d, nq = 128, 1000
 k1, k2 = jax.random.split(jax.random.PRNGKey(99))
 data = jax.random.normal(k1, (n, d), jnp.float32)
 q = jax.random.normal(k2, (nq, d), jnp.float32)
+jax.block_until_ready((data, q))
+print("PROBE_INIT_OK", flush=True)   # backend init + device alloc worked
 bfi = brute_force.build(data)
 fn = jax.jit(lambda qq: brute_force.search(bfi, qq, 10, algo="matmul")[1])
 jax.block_until_ready(fn(q))
@@ -162,17 +166,17 @@ def probe_full_scale_compile(timeout_s: float = 600.0) -> bool:
         return True
     err = (r.stderr or "").strip()
     log(f"# full-scale compile probe rc={r.returncode}: {err[-300:]}")
-    backendish = any(s in err for s in (
-        "remote_compile", "UNAVAILABLE", "RESOURCE_EXHAUSTED", "INTERNAL",
-        "DEADLINE_EXCEEDED"))
-    if backendish:
-        return False
-    # a broken probe (import error, device already exclusively held by
-    # this process, ...) must not silently cap every run at 100k — the
-    # mid-run GT deadline + downscale fallback still protects full scale
-    log("# probe failure looks unrelated to compile viability; "
-        "keeping full scale")
-    return True
+    if "PROBE_INIT_OK" not in (r.stdout or ""):
+        # the child never got past backend init / device alloc (import
+        # error, device exclusively held, ...): says nothing about 1M
+        # compile viability — keep full scale; the mid-run GT deadline +
+        # downscale fallback still protects it
+        log("# probe failed before backend init completed; keeping "
+            "full scale")
+        return True
+    # init worked, the 1M program itself failed: treat as a genuine
+    # backend no (compile rejection / OOM / transport death)
+    return False
 
 
 def preflight_scale(default: str = "full", limit_s: float = 120.0,
@@ -201,15 +205,17 @@ def preflight_scale(default: str = "full", limit_s: float = 120.0,
 
 
 def main():
+    t_wall0 = time.perf_counter()
     budget_s = float(os.environ.get("RAFT_TPU_BENCH_BUDGET_S", "2400"))
     scale_env = os.environ.get("RAFT_TPU_BENCH_SCALE")
     scale = scale_env or "full"
     if scale_env is None:
         scale = preflight_scale(
             "full", probe_timeout_s=min(600.0, 0.25 * budget_s))
-    # the budget governs measurement, not preflight: rebase the clock so
-    # a slow (up to 600 s) compile probe doesn't eat the GT deadline and
-    # sweep-trimming allowances
+    # deduct preflight from the budget (keeping a floor for the actual
+    # measurements) so total wall time stays within what the caller set,
+    # while a slow compile probe doesn't starve the GT deadline
+    budget_s = max(600.0, budget_s - (time.perf_counter() - t_wall0))
     t_start = time.perf_counter()
     # micro: CPU-runnable harness smoke (drives every code path in
     # minutes); small: single-chip quick run; full: the BASELINE scale
@@ -440,6 +446,14 @@ def main():
         # how many timings tripped the plausibility floor and were
         # re-measured through a fresh executable (ops.autotune.measure)
         "timing_floor_trips": _autotune.suspect_events,
+        # BASELINE config 5 (multi-node sharded ivf_pq) has no QPS here:
+        # one physical chip. Its correctness path runs elsewhere.
+        "sharded_config5": {
+            "status": "validated-functionally",
+            "evidence": "8-device CPU-mesh tests (tests/test_sharded_ann"
+                        ".py) + driver dryrun_multichip (sharded brute "
+                        "force AND ivf_pq steps); no multi-chip hardware "
+                        "for QPS"},
         "baseline_note": "derived A100 estimates (see bench.py); RAFT "
                          "24.02 publishes plots, not tables",
     }

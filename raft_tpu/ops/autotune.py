@@ -191,7 +191,12 @@ def measure(fn: Callable, *args, reps: int = 5, out0=None,
             "measure: median %.3g s below plausibility floor %.3g s — "
             "re-measuring through a fresh executable (tunnel replay mode)",
             med, suspect_floor_s)
+        # the fresh compile must NOT be served from the persistent
+        # compilation cache: a cache hit would hand back the very
+        # executable whose timing is under suspicion
+        cache_dir = jax.config.jax_compilation_cache_dir
         try:
+            jax.config.update("jax_compilation_cache_dir", None)
             fresh = jax.jit(lambda *a: fn(*a))
             out0 = fresh(*args)
             jax.block_until_ready(out0)      # fresh compile + warm
@@ -200,6 +205,8 @@ def measure(fn: Callable, *args, reps: int = 5, out0=None,
             rlog.log_warn("measure: fresh-executable re-measure failed "
                           "(%s); keeping suspect median", e)
             return med
+        finally:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
         if med2 < suspect_floor_s:
             rlog.log_warn(
                 "measure: fresh executable also below floor (%.3g s) — "
