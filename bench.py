@@ -86,13 +86,19 @@ def robust_call(fn, what: str, tries: int = 3, deadline: float = 0.0):
 def median_time(fn, *args, reps=5, tries=3, floor=0.0):
     """Per-call-blocked median with retries: tunneled backends drop the
     remote-compile transport transiently; one flake must not kill a
-    half-hour bench. Returns None after ``tries`` consecutive failures."""
-    from raft_tpu.ops.autotune import measure
+    half-hour bench. Returns None after ``tries`` consecutive failures,
+    or immediately when the timing is declared unreliable (a lying
+    backend window is not a flake — retrying just re-trips the floor and
+    re-pays fresh compiles)."""
+    from raft_tpu.ops.autotune import TimingUnreliableError, measure
 
     for t in range(tries):
         try:
             return measure(fn, *args, reps=reps,
                            suspect_floor_s=floor)
+        except TimingUnreliableError as e:
+            log(f"# measurement unreliable (no retry): {e}")
+            return None
         except Exception as e:  # noqa: BLE001 - transport/compile flakes
             log(f"# measurement attempt {t + 1}/{tries} failed: "
                 f"{type(e).__name__}: {e}")

@@ -31,7 +31,15 @@ import jax
 from ..core import logging as rlog
 
 __all__ = ["shape_bucket", "lookup", "record", "measure", "tune_best",
-           "cache_path", "load_cache", "save_cache"]
+           "cache_path", "load_cache", "save_cache",
+           "TimingUnreliableError"]
+
+
+class TimingUnreliableError(RuntimeError):
+    """Both the original and a freshly-compiled executable timed below
+    the physical plausibility floor: the backend window is lying and no
+    honest number exists. Callers should skip the measurement rather
+    than record an impossible one."""
 
 _MEM_CACHE: Dict[str, str] = {}
 _DISK_LOADED = False
@@ -172,12 +180,15 @@ def measure(fn: Callable, *args, reps: int = 5, out0=None,
 
     ``suspect_floor_s``: physical-plausibility floor. The tunnel has a
     second lying mode where even value-distinct chained dispatches return
-    "done" in ~50 us — keyed per *executable*, so the defense is a fresh
-    compile: when the median lands below the floor, ``fn`` is re-wrapped
-    in a new outer ``jax.jit`` (new executable) and re-measured; the
-    larger (more credible) median is returned and the event is logged.
-    0 disables the check. Callers set it to a lower bound no real call of
-    theirs could beat (e.g. milliseconds for a 10k-query search batch).
+    "done" in ~50 us. Defense: when the median lands below the floor,
+    ``fn`` is re-wrapped in a new outer ``jax.jit`` (fresh executable,
+    compilation cache disabled) and re-measured. If the fresh median is
+    credible, the larger median is returned; if it is ALSO below the
+    floor — or the fresh compile itself fails while the original median
+    is suspect — ``TimingUnreliableError`` is raised: no honest number
+    exists and callers must skip the measurement. 0 disables the check.
+    Callers set the floor to a lower bound no real call of theirs could
+    beat (e.g. milliseconds for a 10k-query search batch).
     """
     if out0 is None:
         out0 = fn(*args)
@@ -201,16 +212,18 @@ def measure(fn: Callable, *args, reps: int = 5, out0=None,
             out0 = fresh(*args)
             jax.block_until_ready(out0)      # fresh compile + warm
             med2 = _timed_reps(fresh, args, reps, out0)
-        except Exception as e:  # noqa: BLE001 - fn not re-jittable
-            rlog.log_warn("measure: fresh-executable re-measure failed "
-                          "(%s); keeping suspect median", e)
-            return med
+        except Exception as e:  # noqa: BLE001 - fn not re-jittable/compile died
+            raise TimingUnreliableError(
+                f"median {med:.3g}s below plausibility floor and the "
+                f"fresh-executable re-measure failed ({e})") from e
         finally:
             jax.config.update("jax_compilation_cache_dir", cache_dir)
         if med2 < suspect_floor_s:
-            rlog.log_warn(
-                "measure: fresh executable also below floor (%.3g s) — "
-                "timing on this backend window is unreliable", med2)
+            # recording nothing beats recording an impossible number
+            # (252M QPS has been observed surviving the fresh compile)
+            raise TimingUnreliableError(
+                f"median {med2:.3g}s below plausibility floor "
+                f"{suspect_floor_s:.3g}s even on a fresh executable")
         med = max(med, med2)
     return med
 
@@ -222,20 +235,37 @@ def tune_best(key: str, candidates: Mapping[str, Callable], *args,
     """Measure every candidate on device, record + return the winner.
 
     Returns (winner name, {name: median seconds}). Failures (e.g. a kernel
-    whose constraints reject the shape) disqualify that candidate.
+    whose constraints reject the shape) disqualify that candidate. If ALL
+    candidates are unmeasurable purely because the backend window lies
+    about timing (TimingUnreliableError), the first candidate is returned
+    uncached; if they all genuinely fail, RuntimeError is raised.
     """
     if not force:
         hit = lookup(key)
         if hit in candidates:
             return hit, {}
     timings: Dict[str, float] = {}
+    unreliable = 0
     for name, fn in candidates.items():
         try:
             timings[name] = measure(fn, *args, reps=reps,
                                     suspect_floor_s=suspect_floor_s)
+        except TimingUnreliableError as e:
+            unreliable += 1
+            rlog.log_warn("autotune %s: candidate %s unmeasurable: %s",
+                          key, name, e)
         except Exception as e:  # noqa: BLE001 - any engine failure = skip
             rlog.log_warn("autotune %s: candidate %s failed: %s", key, name, e)
     if not timings:
+        if candidates and unreliable == len(candidates):
+            # every engine WORKS but the backend window lies about all of
+            # them: fall back to the first candidate WITHOUT caching, so
+            # a later honest window re-measures
+            fallback = next(iter(candidates))
+            rlog.log_warn("autotune %s: all candidates unmeasurable "
+                          "(lying window); defaulting to %r (not cached)",
+                          key, fallback)
+            return fallback, {}
         raise RuntimeError(f"autotune {key}: every candidate failed")
     winner = min(timings, key=timings.get)
     record(key, winner)
