@@ -212,10 +212,13 @@ def measure(fn: Callable, *args, reps: int = 5, out0=None,
             out0 = fresh(*args)
             jax.block_until_ready(out0)      # fresh compile + warm
             med2 = _timed_reps(fresh, args, reps, out0)
-        except Exception as e:  # noqa: BLE001 - fn not re-jittable/compile died
-            raise TimingUnreliableError(
-                f"median {med:.3g}s below plausibility floor and the "
-                f"fresh-executable re-measure failed ({e})") from e
+        except Exception:  # noqa: BLE001 - compile flake or fn not re-jittable
+            # may be a retryable transport flake, not proof of a lying
+            # window: surface the original error so retry loops can
+            # decide (the suspect median is discarded either way)
+            rlog.log_warn("measure: suspect median %.3g s and the fresh "
+                          "re-measure errored; propagating", med)
+            raise
         finally:
             jax.config.update("jax_compilation_cache_dir", cache_dir)
         if med2 < suspect_floor_s:
@@ -245,25 +248,26 @@ def tune_best(key: str, candidates: Mapping[str, Callable], *args,
         if hit in candidates:
             return hit, {}
     timings: Dict[str, float] = {}
-    unreliable = 0
+    unreliable_names: list = []
     for name, fn in candidates.items():
         try:
             timings[name] = measure(fn, *args, reps=reps,
                                     suspect_floor_s=suspect_floor_s)
         except TimingUnreliableError as e:
-            unreliable += 1
+            unreliable_names.append(name)
             rlog.log_warn("autotune %s: candidate %s unmeasurable: %s",
                           key, name, e)
         except Exception as e:  # noqa: BLE001 - any engine failure = skip
             rlog.log_warn("autotune %s: candidate %s failed: %s", key, name, e)
     if not timings:
-        if candidates and unreliable == len(candidates):
-            # every engine WORKS but the backend window lies about all of
-            # them: fall back to the first candidate WITHOUT caching, so
-            # a later honest window re-measures
-            fallback = next(iter(candidates))
-            rlog.log_warn("autotune %s: all candidates unmeasurable "
-                          "(lying window); defaulting to %r (not cached)",
+        if unreliable_names:
+            # at least one engine WORKS but the backend window lies about
+            # its timing: fall back to the first such candidate WITHOUT
+            # caching, so a later honest window re-measures (genuinely
+            # failing candidates are never the fallback)
+            fallback = unreliable_names[0]
+            rlog.log_warn("autotune %s: no measurable candidate (lying "
+                          "window); defaulting to %r (not cached)",
                           key, fallback)
             return fallback, {}
         raise RuntimeError(f"autotune {key}: every candidate failed")

@@ -81,3 +81,23 @@ def test_kmeans_on_device(dataset):
     assert centers.shape == (32, 64)
     counts = np.bincount(np.asarray(labels), minlength=32)
     assert (counts > 0).all()
+
+
+def test_low_precision_storage_on_device(dataset, queries, oracle):
+    """bf16 and byte storage must compile and score correctly on the
+    real chip (the dequant-fused GEMM and bf16 scan paths are
+    TPU-lowering-sensitive)."""
+    from raft_tpu.neighbors import brute_force
+
+    bf16 = brute_force.build(dataset, dtype="bfloat16")
+    _, i = brute_force.search(bf16, queries, 10)
+    assert calc_recall(np.asarray(i), oracle) > 0.95
+
+    bytes_data = np.round(np.clip(dataset * 40 + 128, 0, 255)
+                          ).astype(np.float32)
+    bytes_q = np.round(np.clip(queries * 40 + 128, 0, 255)
+                       ).astype(np.float32)
+    u8 = brute_force.build(bytes_data, dtype="uint8")
+    _, iu = brute_force.search(u8, bytes_q, 10)
+    _, want = naive_knn(bytes_data, bytes_q, 10)
+    assert calc_recall(np.asarray(iu), want) > 0.999
