@@ -212,13 +212,15 @@ def measure(fn: Callable, *args, reps: int = 5, out0=None,
             out0 = fresh(*args)
             jax.block_until_ready(out0)      # fresh compile + warm
             med2 = _timed_reps(fresh, args, reps, out0)
-        except Exception:  # noqa: BLE001 - compile flake or fn not re-jittable
-            # may be a retryable transport flake, not proof of a lying
-            # window: surface the original error so retry loops can
-            # decide (the suspect median is discarded either way)
-            rlog.log_warn("measure: suspect median %.3g s and the fresh "
-                          "re-measure errored; propagating", med)
-            raise
+        except Exception as e:  # noqa: BLE001 - compile died / not re-jittable
+            # classify as unreliable (cause chained): the suspect median
+            # already tripped the floor, and retrying a fresh compile in
+            # a degraded window costs minutes per attempt — callers'
+            # lying-window fallbacks (tune_best) and no-retry policy
+            # (median_time) are the right response, not flake retries
+            raise TimingUnreliableError(
+                f"median {med:.3g}s below plausibility floor and the "
+                f"fresh-executable re-measure failed ({e})") from e
         finally:
             jax.config.update("jax_compilation_cache_dir", cache_dir)
         if med2 < suspect_floor_s:
@@ -238,10 +240,11 @@ def tune_best(key: str, candidates: Mapping[str, Callable], *args,
     """Measure every candidate on device, record + return the winner.
 
     Returns (winner name, {name: median seconds}). Failures (e.g. a kernel
-    whose constraints reject the shape) disqualify that candidate. If ALL
-    candidates are unmeasurable purely because the backend window lies
-    about timing (TimingUnreliableError), the first candidate is returned
-    uncached; if they all genuinely fail, RuntimeError is raised.
+    whose constraints reject the shape) disqualify that candidate. When no
+    candidate produced an honest timing but at least one was merely
+    unmeasurable (TimingUnreliableError — a lying backend window), the
+    first such working candidate is returned uncached; when every
+    candidate genuinely failed, RuntimeError is raised.
     """
     if not force:
         hit = lookup(key)

@@ -100,4 +100,6 @@ def test_low_precision_storage_on_device(dataset, queries, oracle):
     u8 = brute_force.build(bytes_data, dtype="uint8")
     _, iu = brute_force.search(u8, bytes_q, 10)
     _, want = naive_knn(bytes_data, bytes_q, 10)
-    assert calc_recall(np.asarray(iu), want) > 0.999
+    # >= 0.998 tolerates one k-boundary tie (integer distances on byte
+    # vectors can tie exactly; tie order may differ from the oracle)
+    assert calc_recall(np.asarray(iu), want) >= 0.998
