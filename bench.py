@@ -399,14 +399,17 @@ def main():
         cagra_env = os.environ.get("RAFT_TPU_BENCH_CAGRA_N")
         if cagra_env:
             cagra_n = int(cagra_env)
-        # budget gate scaled to the corpus actually being built (100k
-        # builds have taken 500-1300s in degraded windows; small builds
-        # are cheap) — a recorded three-algo result beats dying mid-build
-        need_s = 700 if cagra_n > 50_000 else 120
-        from raft_tpu.core.errors import expects as _expects
-        _expects(remaining > need_s,
-                 "budget skip: %.0fs left < %ds needed for a %d-row "
-                 "cagra build", remaining, need_s, cagra_n)
+        else:
+            # budget gate scaled to the corpus actually being built (100k
+            # builds have taken 500-1300s in degraded windows; small builds
+            # are cheap) — a recorded three-algo result beats dying
+            # mid-build. An explicit CAGRA_N override always runs: the
+            # operator asked for this data point.
+            need_s = 700 if cagra_n > 50_000 else 120
+            from raft_tpu.core.errors import expects as _expects
+            _expects(remaining > need_s,
+                     "budget skip: %.0fs left < %ds needed for a %d-row "
+                     "cagra build", remaining, need_s, cagra_n)
         cdata = data[:cagra_n]
         if cagra_n != n:
             cgt_fn = jax.jit(lambda q: brute_force.search(
@@ -422,25 +425,30 @@ def main():
         cagra_build = time.perf_counter() - t0
         cagra.prepare_search(ci)    # bf16 traversal copy out of the timed graph
         log(f"# cagra built ({cagra_n} rows) in {cagra_build:.0f}s")
-        # sweep (itopk, search_width): wider frontiers trade hops for per-hop
-        # parallel work — on dispatch-latency-heavy backends width>1 is ~2x QPS
-        # (16, 8) first: fewer hops x wider frontier is the fast low-recall
-        # point — on this backend per-hop dispatch dominates, so trading hops
-        # for width moves up the QPS-recall pareto front
-        for itopk, width in (((32, 4),) if hurry
-                             else ((16, 8), (32, 4), (64, 4), (64, 1))):
-            sp = cagra.SearchParams(itopk_size=itopk, search_width=width)
+        # sweep (itopk, search_width, max_iterations): wider frontiers trade
+        # hops for per-hop parallel work, and capping iterations below the
+        # auto bound (itopk/width + 16) buys ~2x QPS at the 0.95-recall
+        # operating point — measured sweep 2026-07-31: (32,4,mi10) 31.9k QPS
+        # @ 0.954 vs (32,4,auto) 16.0k @ 0.964 on the 100k corpus
+        sweep = (((32, 4, 10),) if hurry
+                 else ((24, 6, 6), (32, 4, 10), (48, 4, 10), (64, 4, 0)))
+        opener = sweep[0]
+        for itopk, width, mi in sweep:
+            sp = cagra.SearchParams(itopk_size=itopk, search_width=width,
+                                    max_iterations=mi)
             fn = jax.jit(lambda q, s=sp: cagra.search(ci, q, k, s))
             dt = median_time(fn, queries, reps=3, floor=suspect_floor)
             if dt is None:
                 continue
             rec = robust_call(lambda: device_recall(fn(queries)[1], cgt),
                               "cagra recall")
-            add_entry("raft_cagra", f"raft_cagra.degree64.itopk{itopk}.w{width}",
+            add_entry("raft_cagra",
+                      f"raft_cagra.degree64.itopk{itopk}.w{width}"
+                      f".mi{mi or 'auto'}",
                       nq / dt, rec, cagra_build, {"corpus_n": cagra_n})
-            # never break on the low-recall (16, 8) opener: the baseline-
-            # comparable (32, 4) anchor must always be measured
-            if rec >= 0.995 and (itopk, width) != (16, 8):
+            # never break on the low-recall opener: the baseline-comparable
+            # ≥0.95-recall anchor must always be measured
+            if rec >= 0.995 and (itopk, width, mi) != opener:
                 break
 
     # --- roofline: report utilization against the measured chip peak ----

@@ -247,7 +247,7 @@ def search_cagra(index: ShardedCagra, queries, k: int,
         # random seeding can't surface them
         valid = jnp.arange(data.shape[1], dtype=jnp.int32) < count[0]
         d, i = cagra._search_jit(
-            data[0], data[0], graph[0], qq, valid,
+            data[0], data[0], None, graph[0], qq, valid,
             jax.random.key(sp.seed), itopk,
             width, int(max_iter), k, n_seeds, mt.value)
         gi = jnp.where(i >= 0, i + base[0], -1)

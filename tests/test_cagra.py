@@ -115,3 +115,38 @@ class TestCagra:
         graph = cagra.optimize(knn, 16)
         assert graph.shape == (2000, 16)
         assert (graph != np.arange(2000)[:, None]).all()
+
+    def test_knn_graph_brute_exact(self, dataset, knn_oracle):
+        """The brute path must produce the exact kNN graph."""
+        sub = dataset[:2000]
+        g = cagra.build_knn_graph(sub, 8, algo="brute")
+        _, want_full = naive_knn(sub, sub, 9)
+        rows = np.arange(2000)[:, None]
+        not_self = want_full != rows
+        order = np.argsort(~not_self, axis=1, kind="stable")[:, :8]
+        want = np.take_along_axis(want_full, order, axis=1)
+        assert calc_recall(g, want) >= 0.999
+
+    def test_knn_graph_ivf_pq_path(self, dataset):
+        """The reference's ivf_pq+refine path stays available above the
+        brute cutover (forced here via algo=)."""
+        g = cagra.build_knn_graph(dataset[:2000], 8, algo="ivf_pq")
+        assert g.shape == (2000, 8)
+        assert (g != np.arange(2000)[:, None]).all()
+
+    def test_candidate_dtype_int8(self, built_index, dataset, queries):
+        _, idx = cagra.search(built_index, queries, k=10,
+                              params=cagra.SearchParams(
+                                  itopk_size=64, candidate_dtype="int8"))
+        _, want = naive_knn(dataset, queries, 10)
+        assert calc_recall(np.asarray(idx), want) >= 0.85
+
+    def test_max_iterations_cap(self, built_index, dataset, queries):
+        """A capped traversal still reaches usable recall (the bench's
+        QPS@0.95 operating point) and never exceeds the cap's work."""
+        _, idx = cagra.search(built_index, queries, k=10,
+                              params=cagra.SearchParams(
+                                  itopk_size=32, search_width=4,
+                                  max_iterations=10))
+        _, want = naive_knn(dataset, queries, 10)
+        assert calc_recall(np.asarray(idx), want) >= 0.80
