@@ -331,6 +331,22 @@ def main():
                       nq / dt, 1.0, 0.0,
                       {"engine_timings_ms":
                        {kk: round(v * 1e3, 1) for kk, v in timings.items()}})
+        # bf16 storage: half the scan HBM traffic (the exact path's
+        # bandwidth bound); recall measured against the f32 ground truth.
+        # Optional variant — skipped in hurry mode.
+        if not hurry:
+            bf16i = robust_call(
+                lambda: brute_force.build(data, dtype=jnp.bfloat16),
+                "brute bf16 build")
+            hfn = jax.jit(lambda q: brute_force.search(bf16i, q, k,
+                                                       algo="matmul"))
+            dt = median_time(hfn, queries, floor=suspect_floor)
+            if dt is not None:
+                rec = robust_call(
+                    lambda: device_recall(hfn(queries)[1], gt),
+                    "brute bf16 recall")
+                add_entry("raft_brute_force", "raft_brute_force.matmul.bf16",
+                          nq / dt, rec, 0.0)
 
     # --- ivf_flat (config 2: n_lists=1024, probe sweep) -----------------
     with algo_section('ivf_flat'):
@@ -342,23 +358,69 @@ def main():
         flat_build = time.perf_counter() - t0
         ivf_flat.prepare_scan(fi)   # scan prep out of the timed search graph
         log(f"# ivf_flat built in {flat_build:.0f}s")
-        for probes in ((20,) if hurry else (20, 50, 100)):
+        def measure_flat(probes):
+            nonlocal flat_best
             sp = ivf_flat.SearchParams(n_probes=probes)
             fn = jax.jit(lambda q, s=sp: ivf_flat.search(fi, q, k, s))
             dt = median_time(fn, queries, floor=suspect_floor)
             if dt is None:
-                continue
+                return None
             rec = robust_call(lambda: device_recall(fn(queries)[1], gt),
                               "ivf_flat recall")
-            add_entry("raft_ivf_flat", f"raft_ivf_flat.nlist1024.nprobe{probes}",
+            add_entry("raft_ivf_flat",
+                      f"raft_ivf_flat.nlist1024.nprobe{probes}",
                       nq / dt, rec, flat_build)
-            # update the headline candidate IN the loop: a later-probe
+            # update the headline candidate AS measured: a later-probe
             # failure swallowed by algo_section must not discard an
             # already-measured qualifying point
             if rec >= 0.95 and (flat_best is None or nq / dt > flat_best[0]):
                 flat_best = (nq / dt, rec, f"nprobe{probes}")
-            if rec >= 0.995:
-                break
+            return rec
+
+        # the BASELINE config-2 anchor (nprobe=20) is always measured;
+        # then walk the probe count DOWN while recall holds ≥0.95 (fewer
+        # probes = proportionally less list scanning = the headline
+        # lever), or UP if the anchor misses the target
+        best_probes = 20
+        rec20 = measure_flat(20)
+        if not hurry and rec20 is not None:
+            if rec20 >= 0.95:
+                for probes in (10, 5):
+                    r = measure_flat(probes)
+                    if r is None or r < 0.95:
+                        break
+                    best_probes = probes
+            else:
+                for probes in (50, 100):
+                    best_probes = probes
+                    r = measure_flat(probes)
+                    if r is not None and r >= 0.95:
+                        break
+        # bf16 list storage at the best qualifying probe count: half the
+        # list-scan HBM traffic for ~1e-3 relative distance error.
+        # Optional variant — skipped in hurry mode.
+        if not hurry:
+            t0 = time.perf_counter()
+            fih = robust_call(lambda: ivf_flat.build(
+                data, ivf_flat.IndexParams(n_lists=1024, seed=0,
+                                           dtype="bfloat16")),
+                "ivf_flat bf16 build")
+            jax.block_until_ready(jax.tree.leaves(fih))
+            bf16_build = time.perf_counter() - t0
+            ivf_flat.prepare_scan(fih)
+            fnh = jax.jit(lambda q: ivf_flat.search(
+                fih, q, k, ivf_flat.SearchParams(n_probes=best_probes)))
+            dt = median_time(fnh, queries, floor=suspect_floor)
+            if dt is not None:
+                rec = robust_call(
+                    lambda: device_recall(fnh(queries)[1], gt),
+                    "ivf_flat bf16 recall")
+                add_entry("raft_ivf_flat",
+                          f"raft_ivf_flat.nlist1024.nprobe{best_probes}"
+                          ".bf16",
+                          nq / dt, rec, bf16_build)
+                if rec >= 0.95 and nq / dt > (flat_best or (0,))[0]:
+                    flat_best = (nq / dt, rec, f"nprobe{best_probes}.bf16")
 
     # --- ivf_pq (config 3: pq_dim=64) + refine --------------------------
     with algo_section('ivf_pq'):

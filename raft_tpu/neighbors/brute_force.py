@@ -177,13 +177,17 @@ def _penalty_row(index: Index, filter, valid_rows):
     return pen
 
 
-def _search_matmul(index: Index, q, k, filter, valid_rows, precision):
+def _search_matmul(index: Index, q, k, filter, valid_rows, precision,
+                   workspace_mb: Optional[int] = None):
     """One-shot GEMM + top_k engine, query-chunked to a workspace budget.
 
     On backends where XLA's fused GEMM→top_k pipeline outruns the Pallas
     kernel (dispatch-dominated regimes; measured via ops.autotune), this is
     the fastest exact path. Expanded metrics only — the distance block for
     a query chunk is one MXU GEMM plus row/col norm terms.
+
+    ``workspace_mb`` overrides the RAFT_TPU_MATMUL_WORKSPACE_MB budget
+    for this call (bigger chunks amortize per-chunk top_k fixed costs).
     """
     import os
 
@@ -192,7 +196,8 @@ def _search_matmul(index: Index, q, k, filter, valid_rows, precision):
     prec = jax.lax.Precision(precision)
     pen = _penalty_row(index, filter, valid_rows)
 
-    budget = int(os.environ.get("RAFT_TPU_MATMUL_WORKSPACE_MB", "1024")) << 20
+    budget = (workspace_mb if workspace_mb is not None else int(
+        os.environ.get("RAFT_TPU_MATMUL_WORKSPACE_MB", "1024"))) << 20
     chunk = int(max(8, min(m, budget // max(n * 4, 1))))
     m_pad = round_up_to(m, chunk)
     qp = jnp.pad(q, ((0, m_pad - m), (0, 0)))
@@ -299,6 +304,7 @@ def search(
     valid_rows: Optional[jax.Array] = None,
     algo: str = "auto",
     precision: str = "highest",
+    workspace_mb: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """k nearest neighbors of each query → (distances (m, k), indices (m, k)).
 
@@ -315,6 +321,8 @@ def search(
     ``tune_search`` — falling back to matmul/scan by metric; see
     ops/autotune.py for why dispatch is measured, not hard-coded).
     ``precision``: MXU precision for the distance GEMM ("highest"/"default").
+    ``workspace_mb``: matmul-engine distance-block budget override (else
+    RAFT_TPU_MATMUL_WORKSPACE_MB, default 1024).
     """
     q = jnp.asarray(queries, jnp.float32)
     expects(q.ndim == 2 and q.shape[1] == index.dim,
@@ -356,7 +364,8 @@ def search(
     if algo == "matmul":
         expects(expanded,
                 "algo='matmul' supports L2/cosine/IP, got %s", mt.name)
-        return _search_matmul(index, q, k, filter, valid_rows, precision)
+        return _search_matmul(index, q, k, filter, valid_rows, precision,
+                              workspace_mb)
 
     tile = min(tile_size, round_up_to(n, 128))
     n_pad = round_up_to(n, tile)

@@ -25,8 +25,11 @@ TPU design differences:
   probes instead of a per-edge kernel; the reverse-edge grouping runs on
   device too (stable sort by target + segment positions — see
   ``_rev_group_jit``).
-* Graph build reuses our IVF-PQ + refine (path A); NN_DESCENT lands with
-  nn_descent.py.
+* Graph build defaults to an *exact* all-pairs MXU GEMM+top_k sweep up
+  to ~1.2M rows (see ``build_knn_graph``: the GPU economics that make
+  the reference detour through approximate IVF-PQ + refine don't hold
+  on the MXU); the IVF-PQ+refine path covers larger corpora, and
+  NN_DESCENT remains available via ``IndexParams.build_algo``.
 """
 from __future__ import annotations
 
@@ -71,6 +74,10 @@ class IndexParams:
     metric: DistanceType | str = DistanceType.L2Expanded
     nn_descent_niter: int = 20
     seed: int = 0
+    # candidate pass for the BuildAlgo.IVF_PQ route: "auto" substitutes
+    # the exact MXU all-pairs sweep below the brute cutover (see
+    # build_knn_graph); "ivf_pq"/"brute" force a specific pass
+    knn_graph_algo: str = "auto"
 
 
 @dataclasses.dataclass
@@ -174,15 +181,11 @@ def build_knn_graph(dataset, k: int, metric=DistanceType.L2Expanded,
         # at memory scale, bigger distance-block chunks amortize the
         # per-chunk top_k fixed cost of the n² pass; respect an explicit
         # user workspace choice
-        override = (n > 400_000
-                    and "RAFT_TPU_MATMUL_WORKSPACE_MB" not in os.environ)
-        if override:
-            os.environ["RAFT_TPU_MATMUL_WORKSPACE_MB"] = "4096"
-        try:
-            _brute_graph_loop(dataset, index, graph, drop_self, k, n, batch)
-        finally:
-            if override:
-                del os.environ["RAFT_TPU_MATMUL_WORKSPACE_MB"]
+        ws = (4096 if n > 400_000
+              and "RAFT_TPU_MATMUL_WORKSPACE_MB" not in os.environ
+              else None)
+        _brute_graph_loop(bf_mod.search, dataset, index, graph, drop_self,
+                          k, n, batch, ws)
         return graph
 
     n_lists = max(16, min(1024, int(np.sqrt(n) * 2)))
@@ -204,10 +207,9 @@ def build_knn_graph(dataset, k: int, metric=DistanceType.L2Expanded,
     return graph
 
 
-def _brute_graph_loop(dataset, index, graph, drop_self, k, n, batch):
+def _brute_graph_loop(search_fn, dataset, index, graph, drop_self, k, n,
+                      batch, workspace_mb):
     """Exact-graph batch loop: one MXU GEMM + top_k per query batch."""
-    from . import brute_force as bf_mod
-
     for b0 in range(0, n, batch):
         hi = min(b0 + batch, n)
         # tail batches are padded back to the full batch shape (wrapping
@@ -215,7 +217,8 @@ def _brute_graph_loop(dataset, index, graph, drop_self, k, n, batch):
         # tunnel compiles cost tens of seconds each
         idx_rows = (np.arange(b0, b0 + batch) % n).astype(np.int32)
         qb = jnp.asarray(dataset[idx_rows])
-        _, cand = bf_mod.search(index, qb, min(n, k + 1), algo="matmul")
+        _, cand = search_fn(index, qb, min(n, k + 1), algo="matmul",
+                            workspace_mb=workspace_mb)
         out = np.asarray(drop_self(cand, jnp.asarray(idx_rows)))
         graph[b0:hi] = out[: hi - b0]
 
@@ -391,7 +394,8 @@ def build(dataset, params: IndexParams | None = None) -> Index:
         knn = nn_descent.build(dataset, d0, metric=mt,
                                n_iters=p.nn_descent_niter, seed=p.seed)
     else:
-        knn = build_knn_graph(dataset, d0, mt, p.seed)
+        knn = build_knn_graph(dataset, d0, mt, p.seed,
+                              algo=p.knn_graph_algo)
     t1 = _time.perf_counter()
     graph = optimize(knn, degree)
     rlog.log_info("cagra.build n=%d: knn_graph %.1fs, optimize %.1fs",
