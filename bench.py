@@ -434,7 +434,9 @@ def main():
         log(f"# ivf_pq built in {pq_build:.0f}s")
         # sweep the refine ratio (the recall axis once probes stop binding —
         # measured: recall plateaus in n_probes at fixed candidate count)
-        for probes, ratio in (((20, 2),) if hurry else ((20, 2), (20, 4))):
+        # and a reduced-probe point (the QPS axis, as in the ivf_flat walk)
+        for probes, ratio in (((20, 2),) if hurry
+                              else ((20, 2), (10, 2), (20, 4))):
             sp = ivf_pq.SearchParams(n_probes=probes)
 
             def pq_refined(q, s=sp, r=ratio):
@@ -487,13 +489,14 @@ def main():
         cagra_build = time.perf_counter() - t0
         cagra.prepare_search(ci)    # bf16 traversal copy out of the timed graph
         log(f"# cagra built ({cagra_n} rows) in {cagra_build:.0f}s")
-        # sweep (itopk, search_width, max_iterations): wider frontiers trade
-        # hops for per-hop parallel work, and capping iterations below the
-        # auto bound (itopk/width + 16) buys ~2x QPS at the 0.95-recall
-        # operating point — measured sweep 2026-07-31: (32,4,mi10) 31.9k QPS
-        # @ 0.954 vs (32,4,auto) 16.0k @ 0.964 on the 100k corpus
-        sweep = (((32, 4, 10),) if hurry
-                 else ((24, 6, 6), (32, 4, 10), (48, 4, 10), (64, 4, 0)))
+        # sweep (itopk, search_width, max_iterations): the covering seed
+        # set (one GEMM) plus a few gather-bound hops is the operating
+        # regime — measured sweep 2026-07-31 (seeds=1558, 100k corpus):
+        # (16,8,mi2) 58.6k @ 0.956, (32,4,mi3) 58.6k @ 0.959,
+        # (32,4,mi5) 47.0k @ 0.972, (64,4,mi8) 29.6k @ 0.982;
+        # vs 31.8k @ 0.948 for the best random-seeded point
+        sweep = (((32, 4, 5),) if hurry
+                 else ((16, 8, 2), (32, 4, 3), (32, 4, 5), (64, 4, 8)))
         opener = sweep[0]
         for itopk, width, mi in sweep:
             sp = cagra.SearchParams(itopk_size=itopk, search_width=width,

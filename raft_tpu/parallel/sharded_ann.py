@@ -18,6 +18,7 @@ is trivial.
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import Tuple
 
 import jax
@@ -206,6 +207,9 @@ def build_cagra(dataset, mesh: Mesh,
     """Build one CAGRA graph per shard row block."""
     expects(AXIS in mesh.shape, "mesh must have a %r axis", AXIS)
     p0 = params or cagra.IndexParams()
+    # per-shard covering seed sets would be discarded by search_cagra
+    # (it seeds randomly inside shard_map) — don't pay for them
+    p0 = dataclasses.replace(p0, seed_nodes=0)
     dataset = np.asarray(dataset, np.float32)
     n = len(dataset)
     p = mesh.shape[AXIS]
@@ -248,7 +252,7 @@ def search_cagra(index: ShardedCagra, queries, k: int,
         valid = jnp.arange(data.shape[1], dtype=jnp.int32) < count[0]
         d, i = cagra._search_jit(
             data[0], data[0], None, graph[0], qq, valid,
-            jax.random.key(sp.seed), itopk,
+            jax.random.key(sp.seed), None, itopk,
             width, int(max_iter), k, n_seeds, mt.value)
         gi = jnp.where(i >= 0, i + base[0], -1)
         bad = jnp.inf if select_min else -jnp.inf
