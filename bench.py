@@ -162,8 +162,9 @@ print("FULL_PROBE_OK")
 """.format(repo=os.path.dirname(os.path.abspath(__file__)))
 
 
-def probe_full_scale_compile(timeout_s: float = 600.0) -> bool:
-    """Compile+run a 1M-shape search program in a KILLABLE subprocess.
+def probe_full_scale_compile(timeout_s: float = 600.0,
+                             n: int = 1_000_000) -> bool:
+    """Compile+run an n-shape search program in a KILLABLE subprocess.
 
     The tunnel's compile endpoint has been observed *hanging* (not
     erroring) on 1M-scale programs for 25+ minutes while trivial probes
@@ -175,27 +176,28 @@ def probe_full_scale_compile(timeout_s: float = 600.0) -> bool:
     """
     import subprocess
 
+    env = dict(os.environ)
+    env["RAFT_TPU_PROBE_N"] = str(n)
     try:
         r = subprocess.run(
             [sys.executable, "-c", _FULL_PROBE_SRC],
-            timeout=timeout_s, capture_output=True, text=True)
+            timeout=timeout_s, capture_output=True, text=True, env=env)
     except subprocess.TimeoutExpired:
-        log(f"# full-scale compile probe exceeded {timeout_s:.0f}s "
+        log(f"# {n}-scale compile probe exceeded {timeout_s:.0f}s "
             "(hung compile endpoint); downscaling")
         return False
     if r.returncode == 0 and "FULL_PROBE_OK" in r.stdout:
         return True
     err = (r.stderr or "").strip()
-    log(f"# full-scale compile probe rc={r.returncode}: {err[-300:]}")
+    log(f"# {n}-scale compile probe rc={r.returncode}: {err[-300:]}")
     if "PROBE_INIT_OK" not in (r.stdout or ""):
         # the child never got past backend init / device alloc (import
-        # error, device exclusively held, ...): says nothing about 1M
-        # compile viability — keep full scale; the mid-run GT deadline +
-        # downscale fallback still protects it
-        log("# probe failed before backend init completed; keeping "
-            "full scale")
+        # error, device exclusively held, ...): says nothing about the
+        # program's compile viability — keep the scale; the mid-run GT
+        # deadline + downscale fallback still protects it
+        log("# probe failed before backend init completed; keeping scale")
         return True
-    # init worked, the 1M program itself failed: treat as a genuine
+    # init worked, the program itself failed: treat as a genuine
     # backend no (compile rejection / OOM / transport death)
     return False
 
@@ -204,10 +206,12 @@ def preflight_scale(default: str = "full", limit_s: float = 120.0,
                     probe_timeout_s: float = 600.0) -> str:
     """Backend health probe: a fresh tiny compile+run takes ~1-40s on a
     healthy chip. Tunneled backends degrade by orders of magnitude under
-    shared load; recording a 100k result beats timing out on a 1M corpus
-    and recording nothing. When the tiny probe passes and full scale is
-    on the table, a second, killable subprocess additionally proves the
-    1M-shape program actually compiles (see probe_full_scale_compile)."""
+    shared load; recording a smaller result beats timing out on a 1M
+    corpus and recording nothing. When the tiny probe passes and full
+    scale is on the table, killable subprocesses prove the 1M-shape
+    program actually compiles — and if 1M hangs (the tunnel's observed
+    ceiling is between 500k and 1M), a 500k probe arbitrates the "mid"
+    scale before falling all the way back to 100k."""
     t0 = time.perf_counter()
     try:
         x = jax.random.normal(jax.random.PRNGKey(99), (512, 512))
@@ -220,7 +224,14 @@ def preflight_scale(default: str = "full", limit_s: float = 120.0,
         log(f"# pre-flight probe took {probe_s:.0f}s: degraded backend, "
             "downscaling corpus to 100k")
         return "small"
-    if default == "full" and not probe_full_scale_compile(probe_timeout_s):
+    if default == "full":
+        if probe_full_scale_compile(probe_timeout_s):
+            return "full"
+        # measured 2026-07-31: 500k compiles+runs in ~134s where 1M
+        # hangs >600s — half scale beats a 10x downscale
+        if probe_full_scale_compile(min(probe_timeout_s, 450.0),
+                                    n=500_000):
+            return "mid"
         return "small"
     return default
 
@@ -240,7 +251,8 @@ def main():
     t_start = time.perf_counter()
     # micro: CPU-runnable harness smoke (drives every code path in
     # minutes); small: single-chip quick run; full: the BASELINE scale
-    n = {"full": 1_000_000, "small": 100_000, "micro": 20_000}[scale]
+    n = {"full": 1_000_000, "mid": 500_000, "small": 100_000,
+         "micro": 20_000}[scale]
     d, nq, k = 128, 10_000 if scale != "micro" else 1_000, 10
     # plausibility floor: tunnel dispatch alone is ~1 ms, and the
     # observed replay-mode lies are ~50 us — a low floor catches the lies
@@ -458,6 +470,9 @@ def main():
     # --- cagra (config 4: graph_degree=64) ------------------------------
     with algo_section('cagra'):
         remaining = budget_s - (time.perf_counter() - t_start)
+        # full-corpus CAGRA builds only when the budget clearly allows
+        # (a 500k optimize pass alone is ~15 min through the tunnel);
+        # mid/small scales cap the graph corpus at 100k
         cagra_n = n if remaining > 1200 and scale == "full" else \
             min(n, 100_000 if scale != "micro" else 20_000)
         cagra_env = os.environ.get("RAFT_TPU_BENCH_CAGRA_N")
@@ -517,10 +532,18 @@ def main():
                 break
 
     # --- roofline: report utilization against the measured chip peak ----
+    # never let the probe kill the run: after an earlier section OOMs,
+    # the backend can stay resource-exhausted, and losing the JSON line
+    # over a diagnostic probe would discard every recorded measurement
     log("# probing roofline")
-    peaks = roofline.probe(quick=True)
+    try:
+        peaks = roofline.probe(quick=True)
+    except Exception as e:  # noqa: BLE001
+        log(f"# roofline probe failed ({type(e).__name__}: {e}); "
+            "omitting utilization")
+        peaks = {}
     bf_entries = [e for e in entries if e["algo"] == "raft_brute_force"]
-    if bf_entries:
+    if bf_entries and peaks.get("matmul_f32_tflops"):
         gemm_tflops = 2.0 * nq * n * d / (nq / bf_entries[0]["qps"]) / 1e12
         util = gemm_tflops / max(peaks["matmul_f32_tflops"], 1e-9)
     else:

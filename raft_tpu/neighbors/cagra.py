@@ -118,8 +118,9 @@ class SearchParams:
 class Index:
     """Dataset + fixed-degree neighbor graph (cagra_types.hpp:134).
 
-    ``seed_nodes``: optional (s,) row ids of a shared covering seed set
-    (see IndexParams.seed_nodes); None → random-only seeding."""
+    ``seed_nodes``: optional (s,) *sorted unique* row ids of a shared
+    covering seed set (see IndexParams.seed_nodes; the search-time
+    collision probe relies on sortedness); None → random-only seeding."""
 
     dataset: jax.Array        # (n, dim) float32
     graph: jax.Array          # (n, degree) int32
@@ -430,16 +431,20 @@ def build(dataset, params: IndexParams | None = None) -> Index:
 
 
 def _covering_seeds(dataset, s: int, mt, seed: int) -> jax.Array:
-    """(s,) dataset row ids nearest to balanced-kmeans centroids: the
-    shared traversal seed set (one small GEMM scores it for every
-    query at search time)."""
+    """(s,) sorted unique dataset row ids nearest to balanced-kmeans
+    centroids: the shared traversal seed set (one small GEMM scores it
+    for every query at search time).
+
+    The centroid→row step always uses L2: the seed set must cover the
+    *geometry* of the corpus — under InnerProduct a max-IP pick would
+    collapse onto a few high-norm rows and cover nothing."""
     from ..cluster import kmeans_balanced
     from . import brute_force as bf_mod
 
     cent = kmeans_balanced.fit(
         jnp.asarray(dataset), s,
         kmeans_balanced.BalancedKMeansParams(seed=seed))
-    index = bf_mod.build(dataset, mt)
+    index = bf_mod.build(dataset, DistanceType.L2Expanded)
     _, ids = bf_mod.search(index, cent, 1, algo="matmul")
     return jnp.asarray(np.unique(np.asarray(ids[:, 0])), jnp.int32)
 
@@ -527,9 +532,13 @@ def _search_jit(dataset, dataset_score, score_scales, graph, qc, mask_bits,
         sd = _seed_dists(qc, svecs, mt)               # (m, s)
         if mask_bits is not None:
             sd = jnp.where(mask_bits[seed_rows][None, :], sd, jnp.inf)
-        # a random seed colliding with a shared seed is a duplicate
-        coll = jnp.any(seeds[:, :, None] == seed_rows[None, None, :],
-                       axis=2)
+        # a random seed colliding with a shared seed is a duplicate;
+        # seed_rows is sorted unique (np.unique in _covering_seeds), so
+        # membership is a searchsorted probe — not an (m, n_seeds, s)
+        # broadcast compare
+        pos = jnp.searchsorted(seed_rows, seeds)
+        coll = jnp.take(seed_rows,
+                        jnp.clip(pos, 0, seed_rows.shape[0] - 1)) == seeds
         seed_d = jnp.where(coll, jnp.inf, seed_d)
         seeds = jnp.concatenate(
             [jnp.broadcast_to(seed_rows[None, :], (m, seed_rows.shape[0])),
@@ -642,11 +651,13 @@ def search(
     # min_iterations must win over the auto max (the reference adjusts
     # max_iterations up the same way)
     max_iter = max(int(max_iter), int(p.min_iterations))
-    if index.seed_nodes is not None and filter is None:
+    if (index.seed_nodes is not None and filter is None
+            and index.seed_nodes.shape[0] >= 64):
         # the shared covering set does the heavy seeding; random seeds
         # stay only as degenerate-case insurance. Under a filter the
         # whole shared set can be masked out (a selective tenant
-        # slice), so keep the full random count there.
+        # slice), and a degenerately small set (duplicate-heavy corpus)
+        # covers too little — keep the full random count in both cases.
         n_seeds = min(itopk, 16 * p.num_random_samplings)
     else:
         n_seeds = min(itopk, max(width * index.graph_degree // 2,

@@ -385,6 +385,13 @@ def extend(index: Index, new_vectors, new_ids=None,
         new_ids = jnp.asarray(new_ids, jnp.int32)
 
     per_cluster = index.codebook_kind is CodebookGen.PER_CLUSTER
+    # the per-subspace argmin inside _encode materializes a
+    # (batch, pq_dim, book) f32 tensor — bound it to the shared HBM
+    # budget, but never above a batch the caller explicitly lowered
+    from ..ops.ivf_pq_scan import pq_chunk_rows
+
+    batch_size = min(batch_size,
+                     pq_chunk_rows(index.pq_dim, index.codebooks.shape[-2]))
     labels_parts, codes_parts = [], []
     for b0 in range(0, n_new, batch_size):
         xb = new_vectors[b0 : b0 + batch_size]

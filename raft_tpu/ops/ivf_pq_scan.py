@@ -52,22 +52,51 @@ def make_cb_matrix(codebooks: jax.Array) -> jax.Array:
     return cb
 
 
+def pq_chunk_rows(pq_dim: int, book: int,
+                  budget_bytes: int = 2 << 30) -> int:
+    """Row-chunk bound for ops whose per-row cost is a (pq_dim, book)
+    f32 plane (the per-subspace encode argmin, and the codebook gather
+    that XLA lowers through a one-hot contraction on TPU): an unbounded
+    pass at 500k×pq64×book256 is ~33 GB and exhausts HBM."""
+    return max(4096, budget_bytes // max(pq_dim * book * 4, 1))
+
+
+@jax.jit
+def _row_norms_chunk(codes_c, labels_c, centers_rot, codebooks):
+    pq_dim, book, pq_len = codebooks.shape
+    c = centers_rot[labels_c]                        # (b, rot_dim)
+    cs = c.reshape(c.shape[0], pq_dim, pq_len)
+    # decoded vectors per subspace: (b, pq_dim, pq_len)
+    dec = codebooks[jnp.arange(pq_dim)[None, :], codes_c]
+    cross = 2.0 * jnp.sum(cs * dec, axis=(1, 2))
+    dec2 = jnp.sum(dec * dec, axis=(1, 2))
+    return jnp.sum(c * c, axis=1) + cross + dec2
+
+
 def decoded_row_norms(codes, centers_rot, codebooks, list_offsets
                       ) -> jax.Array:
     """(n,) exact ||c_l(i) + decode(i)||² — subspaces are orthogonal, so
     the decode cross-terms vanish:
-    = ||c||² + 2 Σ_s c_s·cb[s,code] + Σ_s ||cb[s,code]||²."""
+    = ||c||² + 2 Σ_s c_s·cb[s,code] + Σ_s ||cb[s,code]||².
+
+    Runs in bounded row chunks (see pq_chunk_rows)."""
     codes = jnp.asarray(codes, jnp.int32)            # (n, pq_dim)
     pq_dim, book, pq_len = codebooks.shape
+    n = codes.shape[0]
     sizes = np.diff(np.asarray(list_offsets))
     labels = jnp.asarray(np.repeat(np.arange(len(sizes)), sizes))
-    c = centers_rot[labels]                          # (n, rot_dim)
-    cs = c.reshape(c.shape[0], pq_dim, pq_len)
-    # decoded vectors per subspace: (n, pq_dim, pq_len)
-    dec = codebooks[jnp.arange(pq_dim)[None, :], codes]
-    cross = 2.0 * jnp.sum(cs * dec, axis=(1, 2))
-    dec2 = jnp.sum(dec * dec, axis=(1, 2))
-    return jnp.sum(c * c, axis=1) + cross + dec2
+    chunk = pq_chunk_rows(pq_dim, book)
+    if n <= chunk:
+        return _row_norms_chunk(codes, labels, centers_rot, codebooks)
+    # wrap the tail to the same chunk shape: one compiled executable
+    parts = []
+    for b0 in range(0, n, chunk):
+        sel = jnp.asarray((np.arange(b0, b0 + chunk) % n).astype(np.int32))
+        part = _row_norms_chunk(jnp.take(codes, sel, axis=0),
+                                jnp.take(labels, sel, axis=0),
+                                centers_rot, codebooks)
+        parts.append(part[: min(chunk, n - b0)])
+    return jnp.concatenate(parts)
 
 
 def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, pen_ref, cent_ref,
