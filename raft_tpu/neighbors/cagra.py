@@ -140,11 +140,23 @@ class Index:
         return self.graph.shape[1]
 
     def tree_flatten(self):
-        return (self.dataset, self.graph, self.seed_nodes), (self.metric,)
+        # traversal-dtype caches travel WITH the index so jitted
+        # functions can take it as an ARGUMENT (closure-baking the
+        # dataset + bf16 copy as HLO constants exceeds remote-compile
+        # request limits at memory scale)
+        leaves = (self.dataset, self.graph, self.seed_nodes,
+                  getattr(self, "_score_bf16", None),
+                  getattr(self, "_score_i8", None))
+        return leaves, (self.metric,)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        return cls(leaves[0], leaves[1], aux[0], leaves[2])
+        out = cls(leaves[0], leaves[1], aux[0], leaves[2])
+        if leaves[3] is not None:
+            out._score_bf16 = leaves[3]
+        if leaves[4] is not None:
+            out._score_i8 = leaves[4]
+        return out
 
 
 @tracing.annotate("raft_tpu::cagra::build_knn_graph")

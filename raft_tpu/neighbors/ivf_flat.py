@@ -119,22 +119,33 @@ class Index:
         return np.diff(self.list_offsets)
 
     def tree_flatten(self):
+        # the pallas scan-prep cache travels WITH the index so a jitted
+        # function can take the index as an ARGUMENT (closure-baked index
+        # arrays become HLO constants whose serialized size exceeds
+        # remote-compile request limits at memory scale)
+        cache = getattr(self, "_scan_pad", None)
+        cache_leaves = None if cache is None else tuple(cache[1:])
         leaves = (self.data, self.data_norms, self.source_ids,
-                  self.centers, self.center_norms, self.scales)
+                  self.centers, self.center_norms, self.scales,
+                  cache_leaves)
         aux = (tuple(self.list_offsets.tolist()), self.metric,
                self.conservative_memory,
                None if self.list_sizes_arr is None
                else tuple(self.list_sizes_arr.tolist()),
-               self.list_growth)
+               self.list_growth,
+               None if cache is None else cache[0])
         return leaves, aux
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        offsets, metric, conservative, sizes, growth = aux
-        return cls(*leaves[:5], np.asarray(offsets, np.int64), metric,
-                   conservative,
-                   None if sizes is None else np.asarray(sizes, np.int64),
-                   growth, leaves[5])
+        offsets, metric, conservative, sizes, growth, cache_lmax = aux
+        out = cls(*leaves[:5], np.asarray(offsets, np.int64), metric,
+                  conservative,
+                  None if sizes is None else np.asarray(sizes, np.int64),
+                  growth, leaves[5])
+        if cache_lmax is not None and leaves[6] is not None:
+            out._scan_pad = (cache_lmax, *leaves[6])
+        return out
 
 
 @tracing.annotate("raft_tpu::ivf_flat::build")

@@ -158,22 +158,39 @@ class Index:
         return np.diff(self.list_offsets)
 
     def tree_flatten(self):
+        # the pallas scan-prep cache travels WITH the index: a jitted
+        # function taking the index as an argument (the
+        # constants-as-parameters pattern — closure-baked index arrays
+        # at 500k rows exceed remote-compile request limits) keeps the
+        # prepared arrays instead of re-deriving them inside the trace
+        cache = getattr(self, "_scan_cache", None)
+        cache_leaves = (None if cache is None else
+                        (cache["codes_p"], cache["norms_p"], cache["cbm"]))
+        cache_aux = (None if cache is None else
+                     (cache["n"], cache["lmax"]))
         leaves = (self.codes, self.source_ids, self.centers_rot,
-                  self.codebooks, self.rotation)
+                  self.codebooks, self.rotation, cache_leaves)
         aux = (tuple(self.list_offsets.tolist()), self.metric, self.pq_bits,
                self.codebook_kind,
                None if self.list_sizes_arr is None
                else tuple(self.list_sizes_arr.tolist()),
-               self.list_growth)
+               self.list_growth, cache_aux)
         return leaves, aux
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        offsets, metric, pq_bits, kind, sizes, growth = aux
-        return cls(*leaves, np.asarray(offsets, np.int64), metric, pq_bits,
-                   kind,
-                   None if sizes is None else np.asarray(sizes, np.int64),
-                   growth)
+        offsets, metric, pq_bits, kind, sizes, growth, cache_aux = aux
+        *core, cache_leaves = leaves
+        out = cls(*core, np.asarray(offsets, np.int64), metric, pq_bits,
+                  kind,
+                  None if sizes is None else np.asarray(sizes, np.int64),
+                  growth)
+        if cache_aux is not None and cache_leaves is not None:
+            out._scan_cache = {
+                "n": cache_aux[0], "lmax": cache_aux[1],
+                "codes_p": cache_leaves[0], "norms_p": cache_leaves[1],
+                "cbm": cache_leaves[2]}
+        return out
 
 
 def _default_pq_dim(dim: int) -> int:

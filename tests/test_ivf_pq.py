@@ -182,6 +182,37 @@ class TestIvfPq:
                                params=ivf_pq.SearchParams(16))
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
+    def test_index_as_jit_argument(self, built_index, queries,
+                                   monkeypatch):
+        """The Index pytree carries its scan-prep cache, so a jitted
+        function may take the index as an ARGUMENT (arrays become
+        program parameters, not closure-baked HLO constants — at 500k
+        rows baked constants exceed remote-compile request limits) and
+        must match the eager path WITHOUT re-deriving the cache (the
+        in-trace _scan_prep fallback would silently mask a broken
+        flatten/unflatten round-trip, so it is forbidden here)."""
+        import jax
+
+        ivf_pq.prepare_scan(built_index)
+        leaves, td = jax.tree_util.tree_flatten(built_index)
+        rebuilt = jax.tree_util.tree_unflatten(td, leaves)
+        assert getattr(rebuilt, "_scan_cache", None) is not None
+
+        def no_prep(*a, **k):  # noqa: ARG001
+            raise AssertionError(
+                "scan cache was re-derived under the trace: the pytree "
+                "dropped it")
+
+        monkeypatch.setattr(ivf_pq, "_scan_prep", no_prep)
+        fn = jax.jit(lambda q, idx: ivf_pq.search(
+            idx, q, 5, ivf_pq.SearchParams(16)))
+        d1, i1 = fn(queries, rebuilt)
+        d2, i2 = ivf_pq.search(built_index, queries, k=5,
+                               params=ivf_pq.SearchParams(16))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   rtol=1e-5, atol=1e-5)
+
 
 class TestRefine:
     def test_refine_exact_when_candidates_cover(self, dataset, queries):
