@@ -196,7 +196,15 @@ class TestIvfPq:
         ivf_pq.prepare_scan(built_index)
         leaves, td = jax.tree_util.tree_flatten(built_index)
         rebuilt = jax.tree_util.tree_unflatten(td, leaves)
-        assert getattr(rebuilt, "_scan_cache", None) is not None
+        cache0, cache1 = built_index._scan_cache, rebuilt._scan_cache
+        assert cache1 is not None
+        # the cache must survive BYTE-IDENTICAL: off-TPU the search path
+        # below doesn't consume it (pallas is TPU-only), so leaf mixups
+        # must be caught here, not by the recall check
+        assert cache1["n"] == cache0["n"] and cache1["lmax"] == cache0["lmax"]
+        for key in ("codes_p", "norms_p", "cbm"):
+            np.testing.assert_array_equal(np.asarray(cache0[key]),
+                                          np.asarray(cache1[key]))
 
         def no_prep(*a, **k):  # noqa: ARG001
             raise AssertionError(
