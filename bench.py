@@ -479,8 +479,13 @@ def main():
             return rec
 
         rec_a = measure_pq(20, 2)
-        if not hurry and rec_a is not None:
-            if rec_a >= 0.95:
+        if not hurry:
+            if rec_a is None:
+                # a transient anchor failure must not zero the lane:
+                # still record the secondary operating points
+                measure_pq(10, 2)
+                measure_pq(20, 4)
+            elif rec_a >= 0.95:
                 measure_pq(10, 2)
                 if rec_a < 0.995:
                     measure_pq(20, 4)

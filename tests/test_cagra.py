@@ -161,6 +161,27 @@ class TestCagra:
         assert r_seed >= 0.7, r_seed
         assert r_seed >= r_rand - 0.02, (r_seed, r_rand)
 
+    def test_index_as_jit_argument(self, built_index, dataset, queries):
+        """The pytree carries the traversal caches and seed set
+        byte-identical, so jitted functions can take the index as an
+        ARGUMENT (baked closure constants exceed remote-compile limits
+        at memory scale)."""
+        import jax
+
+        cagra.prepare_search(built_index)
+        leaves, td = jax.tree_util.tree_flatten(built_index)
+        rebuilt = jax.tree_util.tree_unflatten(td, leaves)
+        np.testing.assert_array_equal(np.asarray(built_index._score_bf16),
+                                      np.asarray(rebuilt._score_bf16))
+        np.testing.assert_array_equal(np.asarray(built_index.seed_nodes),
+                                      np.asarray(rebuilt.seed_nodes))
+        fn = jax.jit(lambda q, idx: cagra.search(
+            idx, q, 10, cagra.SearchParams(itopk_size=64)))
+        _, i1 = fn(queries, rebuilt)
+        _, i2 = cagra.search(built_index, queries, k=10,
+                             params=cagra.SearchParams(itopk_size=64))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
     def test_max_iterations_cap(self, built_index, dataset, queries):
         """A capped traversal still reaches usable recall (the bench's
         QPS@0.95 operating point) and never exceeds the cap's work."""

@@ -267,6 +267,28 @@ class TestIvfFlat:
                                  params=ivf_flat.SearchParams(16))
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
+    def test_index_as_jit_argument(self, built_index, queries):
+        """The pytree carries the aligned-DMA pad cache byte-identical,
+        so jitted functions can take the index as an ARGUMENT (baked
+        closure constants exceed remote-compile limits at 500k rows)."""
+        import jax
+
+        ivf_flat.prepare_scan(built_index)
+        leaves, td = jax.tree_util.tree_flatten(built_index)
+        rebuilt = jax.tree_util.tree_unflatten(td, leaves)
+        c0, c1 = built_index._scan_pad, rebuilt._scan_pad
+        assert c1[0] == c0[0]
+        for a, b in zip(c0[1:], c1[1:]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        fn = jax.jit(lambda q, idx: ivf_flat.search(
+            idx, q, 5, ivf_flat.SearchParams(16)))
+        d1, i1 = fn(queries, rebuilt)
+        d2, i2 = ivf_flat.search(built_index, queries, k=5,
+                                 params=ivf_flat.SearchParams(16))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   rtol=1e-5, atol=1e-5)
+
     def test_k_larger_than_candidates(self, dataset, queries):
         index = ivf_flat.build(dataset[:500], ivf_flat.IndexParams(n_lists=64, seed=0))
         d, i = ivf_flat.search(index, queries, k=64,
