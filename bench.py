@@ -2,36 +2,61 @@
 """Headline benchmark: prints ONE JSON line for the driver.
 
 Measures QPS at recall@10 for the BASELINE.md configs on a SIFT-like
-synthetic corpus (clustered gaussian mixture, 1M x 128 by default —
-IVF probing is partition-limited on *unclustered* gaussian noise, which
-real ANN corpora are not), plus brute-force QPS and an on-device roofline
+synthetic corpus (clustered gaussian mixture; queries are FRESH samples
+from the mixture, not perturbed corpus rows, so the nprobe sweep shows a
+real recall frontier), plus brute-force QPS and an on-device roofline
 probe so kernel throughput is reported against the measured peak of the
 chip actually in use.
 
-Methodology (see raft_tpu/ops/autotune.py): every timing is a median of
-per-call-blocked runs — some backends elide never-awaited dispatches, so
-block-once-after-N under-reports by orders of magnitude. All data is
-generated ON DEVICE (host<->device transfers through remote tunnels are
-slow and would pollute build/search timings); recall is computed on
-device against exact ground truth and only scalars leave the chip.
+Two timings per entry (see raft_tpu/ops/autotune.py):
 
-vs_baseline: reference numbers are *derived A100 estimates* (RAFT 24.02
-publishes Pareto plots, not tables — BASELINE.md): each entry's
-`baseline_qps` carries its derivation in the source below.
+* ``latency_ms`` — per-call-blocked median: every call pays the full
+  dispatch round trip (~90 ms through the axon tunnel).
+* ``qps`` — pipelined throughput: ``measure_throughput`` keeps several
+  value-distinct, data-chained calls in flight and blocks once, so
+  dispatch overlaps device compute. This matches the reference harness's
+  ``items_per_second`` (Google Benchmark runs iterations back-to-back
+  with one wall clock: cpp/bench/ann/src/common/benchmark.hpp:337 and
+  docs/source/raft_ann_benchmarks.md:429); per-call blocking would bill
+  every iteration the tunnel RTT that no serving system pays.
+
+Both modes defend against elision/replay with per-call input
+perturbation + real data dependencies and a physical plausibility floor.
+All data is generated ON DEVICE (host<->device transfers through remote
+tunnels are slow and would pollute build/search timings); recall is
+computed on device against exact ground truth and only scalars leave the
+chip.
+
+The 1M (full) scale never compiles a 1M-row program — the tunnel's
+compile endpoint has hung on those for 25+ minutes where 500k compiles
+in ~134 s — instead the corpus is split into two 500k parts sharing ONE
+compiled executable per algorithm (index as jit argument), and per-part
+top-k results are merged exactly (knn_merge_parts). This is the
+single-chip form of the reference's data-sharded MNMG search
+(detail/knn_merge_parts.cuh:172).
 """
+import contextlib
 import json
 import os
 import sys
 import time
 
-# persistent executable cache: lets the full-scale compile probe's child
-# process pre-pay the fragile 1M compile for the parent. NOTE:
-# ops.autotune.measure disables this cache around its fresh-executable
-# re-measure — a cache hit there would replay the very executable whose
-# timing is under suspicion.
+# persistent executable cache: lets compile probes / child processes
+# pre-pay fragile compiles for the parent. NOTE: ops.autotune.measure
+# disables this cache around its fresh-executable re-measure — a cache
+# hit there would replay the very executable whose timing is under
+# suspicion.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/raft_tpu_xla_cache")
 
 import jax  # noqa: E402
+
+# RAFT_TPU_BENCH_CPU=1 pins the CPU backend IN-PROCESS (the env-var form
+# JAX_PLATFORMS=cpu is unreliably honored under the axon tunnel — see
+# tests/conftest.py); used by the micro harness-smoke lane so it never
+# contends with a TPU run
+if os.environ.get("RAFT_TPU_BENCH_CPU") == "1":
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
@@ -40,22 +65,60 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-# --- derived reference baselines (QPS @ recall@10 = 0.95, batch 10k) -----
-# brute force:  A100 TF32 GEMM ~156 TFLOP/s; 2*n*d = 256 MFLOP/query at
-#               1M x 128 -> ~600k QPS roofline; tiled select_k overhead
-#               ~2x -> 300k.
-# ivf_flat:     probing ~6% of a 1M corpus reads ~30 MB/query; A100 HBM
-#               1.55 TB/s -> ~50k QPS.
-# ivf_pq+refine: same probe fraction over 64B codes = 3.75 MB/query ->
-#               ~400k QPS roofline; LUT + refine overhead ~2x -> 200k.
-# cagra:        published H100 plots put graph search at ~500k-1M QPS
-#               @0.95 for million-scale corpora; use 500k.
-BASELINE_QPS = {
-    "raft_brute_force": 300_000.0,
-    "raft_ivf_flat": 50_000.0,
-    "raft_ivf_pq": 200_000.0,
-    "raft_cagra": 500_000.0,
+# --- reference baselines (QPS @ recall@10 = 0.95, 10k-query batches) -----
+# RAFT 24.02 publishes QPS-vs-recall Pareto PLOTS, not numeric tables
+# (docs/source/raft_ann_benchmarks.md:255-257; the positioning claim —
+# CAGRA outperforming CPU HNSW and GPU state of the art at all recall
+# levels — is README.md:74). The numbers below are therefore derived
+# A100-class estimates; each derivation is pinned to the reference file
+# it reads from and reported in the output so vs_baseline is traceable.
+BASELINES = {
+    "raft_brute_force": {
+        "qps": 300_000.0,
+        "derivation": (
+            "GEMM+select design of detail/knn_brute_force.cuh:61: A100 "
+            "TF32 peak ~156 TFLOP/s, 2*n*d = 256 MFLOP/query at 1Mx128 "
+            "-> ~600k QPS GEMM ceiling; ~2x tiled select_k overhead -> "
+            "300k"),
+    },
+    "raft_ivf_flat": {
+        "qps": 50_000.0,
+        "derivation": (
+            "list-scan bandwidth bound (ivf_flat_interleaved_scan-inl."
+            "cuh): nprobe=20 of nlist=1024 over 1Mx128xf32 reads ~10-30 "
+            "MB/query depending on imbalance; A100 HBM 1.55 TB/s -> "
+            "~50k QPS. Param envelope: ann_benchmarks_param_tuning.md:"
+            "10-33"),
+    },
+    "raft_ivf_pq": {
+        "qps": 200_000.0,
+        "derivation": (
+            "same probe fraction over 64B codes (ivf_pq_compute_"
+            "similarity-inl.cuh:271 LUT scan) = ~8x less traffic than "
+            "ivf_flat -> ~400k ceiling; LUT + refine overhead ~2x -> "
+            "200k. Param envelope: ann_benchmarks_param_tuning.md:34-68"),
+    },
+    "raft_cagra": {
+        "qps": 500_000.0,
+        "derivation": (
+            "published H100 batch-10 Pareto plots put graph search at "
+            "~500k-1M QPS @0.95 for million-scale corpora (raft_ann_"
+            "benchmarks.md:255-257, img/raft-vector-search-batch-10."
+            "png); 500k is the conservative read"),
+    },
 }
+BASELINE_QPS = {k: v["qps"] for k, v in BASELINES.items()}
+
+# corpus geometry: a LOW-INTRINSIC-DIMENSION clustered mixture. Real ANN
+# corpora (SIFT ~16 effective dims in 128 ambient) are hard for IVF
+# because neighborhoods straddle partition boundaries in the low-dim
+# manifold; full-rank gaussian clusters are trivially recoverable at any
+# nprobe (measured: recall@np20 = 1.0 for every full-rank variant —
+# scratch/exp_corpus_hard.py). Queries are fresh mixture draws, never
+# perturbed corpus rows.
+CORPUS_SCALE = float(os.environ.get("RAFT_TPU_BENCH_CSCALE", "1.0"))
+CORPUS_INTRINSIC_D = int(os.environ.get("RAFT_TPU_BENCH_INTRINSIC_D", "16"))
+CORPUS_CLUSTERS = int(os.environ.get("RAFT_TPU_BENCH_NCLUSTERS", "200"))
 
 
 def robust_call(fn, what: str, tries: int = 3, deadline: float = 0.0):
@@ -64,11 +127,7 @@ def robust_call(fn, what: str, tries: int = 3, deadline: float = 0.0):
     dropped connection).
 
     ``deadline``: absolute ``time.perf_counter()`` cutoff — when a retry
-    would start past it, give up immediately instead. On fragile nights a
-    single 1M-program compile retry can run 15+ minutes; without a
-    deadline the ground-truth stage can consume the whole bench budget
-    before any measurement exists (the caller's downscale fallback needs
-    time left to be useful)."""
+    would start past it, give up immediately instead."""
     for t in range(tries):
         try:
             return fn()
@@ -84,18 +143,13 @@ def robust_call(fn, what: str, tries: int = 3, deadline: float = 0.0):
 
 
 def median_time(fn, *args, reps=5, tries=3, floor=0.0):
-    """Per-call-blocked median with retries: tunneled backends drop the
-    remote-compile transport transiently; one flake must not kill a
-    half-hour bench. Returns None after ``tries`` consecutive failures,
-    or immediately when the timing is declared unreliable (a lying
-    backend window is not a flake — retrying just re-trips the floor and
-    re-pays fresh compiles)."""
+    """Per-call-blocked median (latency). Returns None after ``tries``
+    consecutive failures or when the backend window is lying."""
     from raft_tpu.ops.autotune import TimingUnreliableError, measure
 
     for t in range(tries):
         try:
-            return measure(fn, *args, reps=reps,
-                           suspect_floor_s=floor)
+            return measure(fn, *args, reps=reps, suspect_floor_s=floor)
         except TimingUnreliableError as e:
             log(f"# measurement unreliable (no retry): {e}")
             return None
@@ -107,7 +161,25 @@ def median_time(fn, *args, reps=5, tries=3, floor=0.0):
     return None
 
 
-import contextlib  # noqa: E402
+def throughput_time(fn, *args, depth=10, reps=3, tries=3, floor=0.0):
+    """Pipelined steady-state seconds/call (the QPS number; see module
+    docstring). Same failure policy as median_time."""
+    from raft_tpu.ops.autotune import (TimingUnreliableError,
+                                       measure_throughput)
+
+    for t in range(tries):
+        try:
+            return measure_throughput(fn, *args, depth=depth, reps=reps,
+                                      suspect_floor_s=floor)
+        except TimingUnreliableError as e:
+            log(f"# throughput unreliable (no retry): {e}")
+            return None
+        except Exception as e:  # noqa: BLE001
+            log(f"# throughput attempt {t + 1}/{tries} failed: "
+                f"{type(e).__name__}: {e}")
+            if t + 1 < tries:
+                time.sleep(15 * (t + 1))
+    return None
 
 
 @contextlib.contextmanager
@@ -122,15 +194,31 @@ def algo_section(name):
             "continuing with remaining algos")
 
 
-def make_corpus(n, d, nq, n_clusters=2000, seed=0):
-    """Clustered gaussian mixture + queries perturbed from corpus points
-    (the structure real ANN corpora have; all on device)."""
-    kc, kx, ka, kq, kp = jax.random.split(jax.random.PRNGKey(seed), 5)
-    centers = jax.random.normal(kc, (n_clusters, d), jnp.float32) * 4.0
+def make_corpus(n, d, nq, n_clusters=None, seed=0, scale=None,
+                intrinsic_d=None):
+    """Low-intrinsic-dimension clustered mixture; queries are FRESH
+    mixture samples (the structure real ANN corpora + query sets have;
+    all on device). Points live near a random ``intrinsic_d``-dim
+    subspace (cluster centers and within-cluster spread both low-rank)
+    plus small ambient noise, so neighborhoods straddle IVF partition
+    boundaries the way SIFT's do."""
+    scale = CORPUS_SCALE if scale is None else scale
+    n_clusters = CORPUS_CLUSTERS if n_clusters is None else n_clusters
+    intrinsic_d = CORPUS_INTRINSIC_D if intrinsic_d is None else intrinsic_d
+    kw, kc, kx, ka, kq, kp, ke, kf = jax.random.split(
+        jax.random.PRNGKey(seed), 8)
+    w = jax.random.normal(kw, (intrinsic_d, d), jnp.float32)
+    w = w / jnp.linalg.norm(w, axis=1, keepdims=True)
+    centers_z = jax.random.normal(kc, (n_clusters, intrinsic_d),
+                                  jnp.float32) * scale
     assign = jax.random.randint(ka, (n,), 0, n_clusters)
-    data = centers[assign] + jax.random.normal(kx, (n, d), jnp.float32)
-    qrows = jax.random.randint(kq, (nq,), 0, n)
-    queries = data[qrows] + 0.1 * jax.random.normal(kp, (nq, d), jnp.float32)
+    z = centers_z[assign] + jax.random.normal(kx, (n, intrinsic_d),
+                                              jnp.float32)
+    data = z @ w + 0.1 * jax.random.normal(ke, (n, d), jnp.float32)
+    qassign = jax.random.randint(kq, (nq,), 0, n_clusters)
+    qz = centers_z[qassign] + jax.random.normal(kp, (nq, intrinsic_d),
+                                                jnp.float32)
+    queries = qz @ w + 0.1 * jax.random.normal(kf, (nq, d), jnp.float32)
     return jax.block_until_ready(data), jax.block_until_ready(queries)
 
 
@@ -140,15 +228,32 @@ def device_recall(ids, gt):
     return float(jnp.sum(hit) / jnp.sum(gt >= 0))
 
 
-# the probe compiles EXACTLY the ground-truth program (same shapes, same
-# matmul engine, same workspace chunking) so a persistent-cache hit in
-# the parent is possible and memory behavior matches the real path
-_FULL_PROBE_SRC = """
+def exercise_fbin_io(data, rows=100_000):
+    """Round-trip a corpus slice through the raft-ann-bench fbin loader
+    (bench/datasets.py) so the recorded artifact exercises the dataset IO
+    path; returns the artifact note. Deliberately outside all timed
+    sections — host<->device transfer through the tunnel is slow."""
+    from raft_tpu.bench import datasets as bds
+
+    rows = min(rows, len(data))
+    path = "/tmp/raft_tpu_bench_corpus.fbin"
+    host = np.asarray(data[:rows])
+    bds.write_fbin(path, host)
+    back = bds.read_fbin(path)
+    ok = back.shape == host.shape and bool(np.array_equal(back, host))
+    os.remove(path)
+    return {"fbin_roundtrip_rows": rows, "ok": ok}
+
+
+# the probe compiles EXACTLY the ground-truth program shape (same matmul
+# engine, same workspace chunking) so a persistent-cache hit in the
+# parent is possible and memory behavior matches the real path
+_PART_PROBE_SRC = """
 import os, sys
 sys.path.insert(0, {repo!r})
 import jax, jax.numpy as jnp
 from raft_tpu.neighbors import brute_force
-n = int(os.environ.get("RAFT_TPU_PROBE_N", "1000000"))
+n = int(os.environ.get("RAFT_TPU_PROBE_N", "500000"))
 d, nq = 128, 1000
 k1, k2 = jax.random.split(jax.random.PRNGKey(99))
 data = jax.random.normal(k1, (n, d), jnp.float32)
@@ -156,62 +261,52 @@ q = jax.random.normal(k2, (nq, d), jnp.float32)
 jax.block_until_ready((data, q))
 print("PROBE_INIT_OK", flush=True)   # backend init + device alloc worked
 bfi = brute_force.build(data)
-fn = jax.jit(lambda qq: brute_force.search(bfi, qq, 10, algo="matmul")[1])
-jax.block_until_ready(fn(q))
-print("FULL_PROBE_OK")
+fn = jax.jit(lambda qq, idx: brute_force.search(idx, qq, 10,
+                                                algo="matmul")[1])
+jax.block_until_ready(fn(q, bfi))
+print("PART_PROBE_OK")
 """.format(repo=os.path.dirname(os.path.abspath(__file__)))
 
 
-def probe_full_scale_compile(timeout_s: float = 600.0,
-                             n: int = 1_000_000) -> bool:
-    """Compile+run an n-shape search program in a KILLABLE subprocess.
-
-    The tunnel's compile endpoint has been observed *hanging* (not
-    erroring) on 1M-scale programs for 25+ minutes while trivial probes
-    pass — an in-process deadline cannot interrupt a blocked compile, so
-    the probe runs where SIGKILL works. The persistent compilation cache
-    (enabled in main via JAX_COMPILATION_CACHE_DIR) lets a successful
-    probe's executable be reused by the parent where the backend supports
-    it; where it doesn't, the probe still bounds the go/no-go decision.
-    """
+def probe_part_compile(timeout_s: float = 450.0, n: int = 500_000) -> bool:
+    """Compile+run the 500k part-shape search program in a KILLABLE
+    subprocess (an in-process deadline cannot interrupt a blocked
+    compile). The full (1M) scale only ever compiles 500k-part programs,
+    so this one probe bounds the go/no-go decision for both full and mid
+    scales."""
     import subprocess
 
     env = dict(os.environ)
     env["RAFT_TPU_PROBE_N"] = str(n)
     try:
         r = subprocess.run(
-            [sys.executable, "-c", _FULL_PROBE_SRC],
+            [sys.executable, "-c", _PART_PROBE_SRC],
             timeout=timeout_s, capture_output=True, text=True, env=env)
     except subprocess.TimeoutExpired:
-        log(f"# {n}-scale compile probe exceeded {timeout_s:.0f}s "
+        log(f"# {n}-part compile probe exceeded {timeout_s:.0f}s "
             "(hung compile endpoint); downscaling")
         return False
-    if r.returncode == 0 and "FULL_PROBE_OK" in r.stdout:
+    if r.returncode == 0 and "PART_PROBE_OK" in r.stdout:
         return True
     err = (r.stderr or "").strip()
-    log(f"# {n}-scale compile probe rc={r.returncode}: {err[-300:]}")
+    log(f"# {n}-part compile probe rc={r.returncode}: {err[-300:]}")
     if "PROBE_INIT_OK" not in (r.stdout or ""):
-        # the child never got past backend init / device alloc (import
-        # error, device exclusively held, ...): says nothing about the
-        # program's compile viability — keep the scale; the mid-run GT
-        # deadline + downscale fallback still protects it
+        # the child never got past backend init (import error, device
+        # exclusively held, ...): says nothing about the program's
+        # compile viability — keep the scale
         log("# probe failed before backend init completed; keeping scale")
         return True
-    # init worked, the program itself failed: treat as a genuine
-    # backend no (compile rejection / OOM / transport death)
     return False
 
 
 def preflight_scale(default: str = "full", limit_s: float = 120.0,
-                    probe_timeout_s: float = 600.0) -> str:
+                    probe_timeout_s: float = 450.0) -> str:
     """Backend health probe: a fresh tiny compile+run takes ~1-40s on a
     healthy chip. Tunneled backends degrade by orders of magnitude under
-    shared load; recording a smaller result beats timing out on a 1M
-    corpus and recording nothing. When the tiny probe passes and full
-    scale is on the table, killable subprocesses prove the 1M-shape
-    program actually compiles — and if 1M hangs (the tunnel's observed
-    ceiling is between 500k and 1M), a 500k probe arbitrates the "mid"
-    scale before falling all the way back to 100k."""
+    shared load; recording a smaller result beats timing out and
+    recording nothing. The two-part design means only the 500k part
+    shape ever compiles — measured 2026-07-31: 500k compiles+runs in
+    ~134s where a 1M program hangs >600s."""
     t0 = time.perf_counter()
     try:
         x = jax.random.normal(jax.random.PRNGKey(99), (512, 512))
@@ -224,16 +319,51 @@ def preflight_scale(default: str = "full", limit_s: float = 120.0,
         log(f"# pre-flight probe took {probe_s:.0f}s: degraded backend, "
             "downscaling corpus to 100k")
         return "small"
-    if default == "full":
-        if probe_full_scale_compile(probe_timeout_s):
-            return "full"
-        # measured 2026-07-31: 500k compiles+runs in ~134s where 1M
-        # hangs >600s — half scale beats a 10x downscale
-        if probe_full_scale_compile(min(probe_timeout_s, 450.0),
-                                    n=500_000):
-            return "mid"
+    if default in ("full", "mid"):
+        if probe_part_compile(probe_timeout_s):
+            return default
         return "small"
     return default
+
+
+class TwoPart:
+    """Search a corpus split into equal-shape parts with ONE compiled
+    executable, merging per-part top-k exactly. ``search_jit`` must be a
+    jitted (queries, index, *extra) -> (dist, ids) callable with
+    part-local ids; ``offsets`` map part-local ids to global; ``extras``
+    optionally zips additional per-part jit arguments (e.g. a bf16 refine
+    corpus). Indexes ride as jit ARGUMENTS, never closures — baked index
+    constants exceed the tunnel's remote-compile request limit (observed
+    HTTP 413 at 500k rows) — and ``fresh_executable`` keeps that true on
+    ops.autotune's plausibility-floor re-measure path."""
+
+    def __init__(self, search_jit, indexes, offsets, k, extras=None):
+        from raft_tpu.neighbors import brute_force as _bf
+
+        self.search_jit = search_jit
+        self.indexes = indexes
+        self.offsets = offsets
+        self.extras = extras or [()] * len(indexes)
+        self._merge = jax.jit(
+            lambda d, i: _bf.knn_merge_parts(d, i, True))
+        self.k = k
+
+    def __call__(self, q, *_):
+        ds, is_ = [], []
+        for idx, off, extra in zip(self.indexes, self.offsets, self.extras):
+            d, i = self.search_jit(q, idx, *extra)
+            ds.append(d[:, : self.k])
+            is_.append(jnp.where(i[:, : self.k] >= 0,
+                                 i[:, : self.k] + off, -1))
+        if len(ds) == 1:
+            return ds[0], is_[0]
+        return self._merge(jnp.stack(ds), jnp.stack(is_))
+
+    def fresh_executable(self):
+        inner = self.search_jit
+        fresh = TwoPart(jax.jit(lambda q, idx, *e: inner(q, idx, *e)),
+                        self.indexes, self.offsets, self.k, self.extras)
+        return fresh
 
 
 def main():
@@ -243,76 +373,67 @@ def main():
     scale = scale_env or "full"
     if scale_env is None:
         scale = preflight_scale(
-            "full", probe_timeout_s=min(600.0, 0.25 * budget_s))
-    # deduct preflight from the budget (keeping a floor for the actual
-    # measurements) so total wall time stays within what the caller set,
-    # while a slow compile probe doesn't starve the GT deadline
+            "full", probe_timeout_s=min(450.0, 0.2 * budget_s))
     budget_s = max(600.0, budget_s - (time.perf_counter() - t_wall0))
     t_start = time.perf_counter()
-    # micro: CPU-runnable harness smoke (drives every code path in
-    # minutes); small: single-chip quick run; full: the BASELINE scale
+    # micro: CPU-runnable harness smoke; small: single-chip quick run;
+    # mid: one 500k part; full: the BASELINE 1M scale as two 500k parts
     n = {"full": 1_000_000, "mid": 500_000, "small": 100_000,
          "micro": 20_000}[scale]
+    part_n = min(n, 500_000)
+    n = (n // part_n) * part_n
+    n_parts = n // part_n
     d, nq, k = 128, 10_000 if scale != "micro" else 1_000, 10
-    # plausibility floor: tunnel dispatch alone is ~1 ms, and the
-    # observed replay-mode lies are ~50 us — a low floor catches the lies
-    # while keeping false trips (each costs one fresh recompile) rare on
-    # genuinely fast windows
+    # plausibility floor: tunnel dispatch alone is ~1 ms, and observed
+    # replay-mode lies are ~50 us
     suspect_floor = 0.001 if scale == "micro" else 0.002
 
     from raft_tpu.bench import roofline
     from raft_tpu.ops import autotune as _autotune
     from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq, refine
 
-    log(f"# corpus: {n}x{d}, {nq} queries, k={k}")
+    log(f"# corpus: {n}x{d} ({n_parts} part(s) of {part_n}), {nq} queries, "
+        f"k={k}, mixture scale {CORPUS_SCALE}")
     data, queries = robust_call(lambda: make_corpus(n, d, nq), "corpus")
+    parts = [data[i * part_n : (i + 1) * part_n] for i in range(n_parts)]
+    offsets = [i * part_n for i in range(n_parts)]
 
-    # ground truth: exact search, f32-accurate GEMM. Computed in
-    # same-shape query chunks (one compile, reused) with per-chunk
-    # retries, so a transport flake costs one chunk, not the stage.
-    def compute_gt(corpus, qs):
-        bfi = brute_force.build(corpus, metric="sqeuclidean")
-        fn = jax.jit(
-            lambda q: brute_force.search(bfi, q, k, algo="matmul")[1])
+    # ground truth: exact search over each part with one shared
+    # executable, exact cross-part merge; query chunks give retries a
+    # small failure unit
+    def compute_gt():
+        bfs = [brute_force.build(p, metric="sqeuclidean") for p in parts]
+        fn = jax.jit(lambda q, idx: brute_force.search(idx, q, k,
+                                                       algo="matmul"))
+        tp = TwoPart(fn, bfs, offsets, k)
         gchunk = 1000
-        # stage deadline: if full-scale GT can't land inside ~35% of the
-        # budget, stop retrying so the downscale fallback still has time
-        # to produce a recorded result
         gt_deadline = t_start + 0.35 * budget_s
-        full_scale = len(corpus) > 100_000
-        parts = []
+        big = part_n > 100_000
+        parts_out = []
         for c0 in range(0, nq, gchunk):
-            # deadline applies before each launch too: slow-but-succeeding
-            # chunks must not eat the budget any more than failing ones
-            if full_scale and time.perf_counter() > gt_deadline:
+            if big and time.perf_counter() > gt_deadline:
                 raise RuntimeError(
                     f"ground truth stage deadline exceeded at [{c0}]")
-            parts.append(robust_call(
+            parts_out.append(robust_call(
                 lambda c0=c0: jax.block_until_ready(
-                    fn(qs[c0 : c0 + gchunk])),
+                    tp(queries[c0 : c0 + gchunk])[1]),
                 f"ground truth [{c0}:{c0 + gchunk}]", tries=5,
-                deadline=gt_deadline if full_scale else 0.0))
-        return bfi, jnp.concatenate(parts)
+                deadline=gt_deadline if big else 0.0))
+        return bfs, jnp.concatenate(parts_out)
 
     try:
-        bf, gt = compute_gt(data, queries)
+        bfs, gt = compute_gt()
     except Exception as e:  # noqa: BLE001
-        # the 1M-program compile is the tunnel's most fragile path; a
-        # 100k result beats recording nothing (observed: 100k compiles
-        # survive windows where 1M consistently dies). Regenerate a
-        # *matched* 100k corpus+queries (slicing would orphan queries
-        # perturbed from dropped rows and skew the distance structure).
         if n <= 100_000:
             raise
-        log(f"# full-scale ground truth failed ({type(e).__name__}): "
+        log(f"# part-scale ground truth failed ({type(e).__name__}): "
             "regenerating a 100k corpus and continuing")
-        n = 100_000
+        n = part_n = 100_000
+        n_parts, scale = 1, "small"
         data, queries = robust_call(lambda: make_corpus(n, d, nq), "corpus")
-        bf, gt = compute_gt(data, queries)
+        parts, offsets = [data], [0]
+        bfs, gt = compute_gt()
     log("# ground truth done")
-    # pace check: corpus+GT is ~5% of the full-pipeline device work; when
-    # the backend is this slow (shared tenancy, degraded tunnel), trim the
-    # sweeps to one point per algo rather than overrun the budget
     gt_elapsed = time.perf_counter() - t_start
     hurry = gt_elapsed > budget_s / 6
     if hurry:
@@ -321,83 +442,91 @@ def main():
 
     entries = []
 
-    def add_entry(algo, name, qps, recall, build_s, extra=None):
+    def add_entry(algo, name, dt_thr, dt_lat, recall, build_s, extra=None):
+        qps = nq / dt_thr if dt_thr else 0.0
         e = {"algo": algo, "name": name, "qps": round(qps, 1),
+             "latency_ms": round(dt_lat * 1e3, 1) if dt_lat else -1.0,
              "recall": round(recall, 4), "build_s": round(build_s, 1),
              "vs_baseline": round(qps / BASELINE_QPS[algo], 3)}
         if extra:
             e.update(extra)
         entries.append(e)
-        log(f"#   {name}: qps={qps:,.0f} recall={recall:.4f}")
+        log(f"#   {name}: qps={qps:,.0f} (lat "
+            f"{e['latency_ms']}ms) recall={recall:.4f}")
+        return e
+
+    def measure_tp(tp, *args, reps=5):
+        """(throughput s/call, latency s/call) for a TwoPart or jit fn."""
+        lat = median_time(tp, *args, reps=reps, floor=suspect_floor)
+        thr = throughput_time(tp, *args, floor=suspect_floor)
+        return thr, lat
 
     # --- brute force (BASELINE config 1): measured-best engine ----------
     with algo_section('brute_force'):
         winner, timings = robust_call(
-            lambda: brute_force.tune_search(bf, queries, k, reps=3,
+            lambda: brute_force.tune_search(bfs[0], queries, k, reps=3,
                                             suspect_floor_s=suspect_floor),
             "engine autotune")
-        # all lanes pass the index as a jit ARGUMENT (not closure):
-        # baked index constants exceed remote-compile request limits at
-        # memory scale (observed HTTP 413 at 500k)
         sfn = jax.jit(lambda q, idx: brute_force.search(idx, q, k,
                                                         algo=winner))
-        dt = median_time(sfn, queries, bf, floor=suspect_floor)
-        if dt is not None:
+        tp = TwoPart(sfn, bfs, offsets, k)
+        thr, lat = measure_tp(tp, queries)
+        if thr is not None:
             add_entry("raft_brute_force", f"raft_brute_force.{winner}",
-                      nq / dt, 1.0, 0.0,
+                      thr, lat, 1.0, 0.0,
                       {"engine_timings_ms":
                        {kk: round(v * 1e3, 1) for kk, v in timings.items()}})
-        # bf16 storage: half the scan HBM traffic (the exact path's
-        # bandwidth bound); recall measured against the f32 ground truth.
-        # Optional variant — skipped in hurry mode.
+        # bf16 storage: half the scan HBM traffic; recall measured
+        # against the f32 ground truth. Skipped in hurry mode.
         if not hurry:
-            bf16i = robust_call(
-                lambda: brute_force.build(data, dtype=jnp.bfloat16),
-                "brute bf16 build")
+            bf16s = robust_call(
+                lambda: [brute_force.build(p, dtype=jnp.bfloat16)
+                         for p in parts], "brute bf16 build")
             hfn = jax.jit(lambda q, idx: brute_force.search(
                 idx, q, k, algo="matmul"))
-            dt = median_time(hfn, queries, bf16i, floor=suspect_floor)
-            if dt is not None:
+            tph = TwoPart(hfn, bf16s, offsets, k)
+            thr, lat = measure_tp(tph, queries)
+            if thr is not None:
                 rec = robust_call(
-                    lambda: device_recall(hfn(queries, bf16i)[1], gt),
+                    lambda: device_recall(tph(queries)[1], gt),
                     "brute bf16 recall")
                 add_entry("raft_brute_force", "raft_brute_force.matmul.bf16",
-                          nq / dt, rec, 0.0)
+                          thr, lat, rec, 0.0)
+            del bf16s
 
-    # --- ivf_flat (config 2: n_lists=1024, probe sweep) -----------------
+    # --- ivf_flat (config 2: n_lists=1024/part, probe sweep) ------------
+    flat_best = None
     with algo_section('ivf_flat'):
-        flat_best = None
         t0 = time.perf_counter()
-        fi = robust_call(lambda: ivf_flat.build(
-            data, ivf_flat.IndexParams(n_lists=1024, seed=0)), "ivf_flat build")
-        jax.block_until_ready(jax.tree.leaves(fi))
+        fis = robust_call(lambda: [
+            ivf_flat.build(p, ivf_flat.IndexParams(n_lists=1024, seed=0))
+            for p in parts], "ivf_flat build")
+        jax.block_until_ready(jax.tree.leaves(fis))
         flat_build = time.perf_counter() - t0
-        ivf_flat.prepare_scan(fi)   # scan prep out of the timed search graph
+        for fi in fis:
+            ivf_flat.prepare_scan(fi)
         log(f"# ivf_flat built in {flat_build:.0f}s")
+
         def measure_flat(probes):
             nonlocal flat_best
             sp = ivf_flat.SearchParams(n_probes=probes)
-            # index as jit ARGUMENT (not closure): see the ivf_pq lane
             fn = jax.jit(lambda q, idx, s=sp: ivf_flat.search(idx, q, k, s))
-            dt = median_time(fn, queries, fi, floor=suspect_floor)
-            if dt is None:
+            tp = TwoPart(fn, fis, offsets, k)
+            thr, lat = measure_tp(tp, queries)
+            if thr is None:
                 return None
-            rec = robust_call(lambda: device_recall(fn(queries, fi)[1], gt),
+            rec = robust_call(lambda: device_recall(tp(queries)[1], gt),
                               "ivf_flat recall")
             add_entry("raft_ivf_flat",
                       f"raft_ivf_flat.nlist1024.nprobe{probes}",
-                      nq / dt, rec, flat_build)
-            # update the headline candidate AS measured: a later-probe
-            # failure swallowed by algo_section must not discard an
-            # already-measured qualifying point
-            if rec >= 0.95 and (flat_best is None or nq / dt > flat_best[0]):
-                flat_best = (nq / dt, rec, f"nprobe{probes}")
+                      thr, lat, rec, flat_build)
+            if rec >= 0.95 and (flat_best is None
+                                or nq / thr > flat_best[0]):
+                flat_best = (nq / thr, rec, f"nprobe{probes}")
             return rec
 
-        # the BASELINE config-2 anchor (nprobe=20) is always measured;
-        # then walk the probe count DOWN while recall holds ≥0.95 (fewer
-        # probes = proportionally less list scanning = the headline
-        # lever), or UP if the anchor misses the target
+        # config-2 anchor (nprobe=20) always measured; walk DOWN while
+        # recall holds >=0.95, or UP if the anchor misses
         best_probes = 20
         rec20 = measure_flat(20)
         if not hurry and rec20 is not None:
@@ -413,76 +542,80 @@ def main():
                     r = measure_flat(probes)
                     if r is not None and r >= 0.95:
                         break
-        # bf16 list storage at the best qualifying probe count: half the
-        # list-scan HBM traffic for ~1e-3 relative distance error.
-        # Optional variant — skipped in hurry mode.
+        # bf16 list storage at the best qualifying probe count
         if not hurry:
             t0 = time.perf_counter()
-            fih = robust_call(lambda: ivf_flat.build(
-                data, ivf_flat.IndexParams(n_lists=1024, seed=0,
-                                           dtype="bfloat16")),
-                "ivf_flat bf16 build")
-            jax.block_until_ready(jax.tree.leaves(fih))
+            fihs = robust_call(lambda: [
+                ivf_flat.build(p, ivf_flat.IndexParams(
+                    n_lists=1024, seed=0, dtype="bfloat16"))
+                for p in parts], "ivf_flat bf16 build")
+            jax.block_until_ready(jax.tree.leaves(fihs))
             bf16_build = time.perf_counter() - t0
-            ivf_flat.prepare_scan(fih)
+            for fi in fihs:
+                ivf_flat.prepare_scan(fi)
             fnh = jax.jit(lambda q, idx: ivf_flat.search(
                 idx, q, k, ivf_flat.SearchParams(n_probes=best_probes)))
-            dt = median_time(fnh, queries, fih, floor=suspect_floor)
-            if dt is not None:
+            tph = TwoPart(fnh, fihs, offsets, k)
+            thr, lat = measure_tp(tph, queries)
+            if thr is not None:
                 rec = robust_call(
-                    lambda: device_recall(fnh(queries, fih)[1], gt),
+                    lambda: device_recall(tph(queries)[1], gt),
                     "ivf_flat bf16 recall")
                 add_entry("raft_ivf_flat",
                           f"raft_ivf_flat.nlist1024.nprobe{best_probes}"
                           ".bf16",
-                          nq / dt, rec, bf16_build)
-                if rec >= 0.95 and nq / dt > (flat_best or (0,))[0]:
-                    flat_best = (nq / dt, rec, f"nprobe{best_probes}.bf16")
+                          thr, lat, rec, bf16_build)
+                if rec >= 0.95 and nq / thr > (flat_best or (0,))[0]:
+                    flat_best = (nq / thr, rec, f"nprobe{best_probes}.bf16")
+            del fihs
 
-    # --- ivf_pq (config 3: pq_dim=64) + refine --------------------------
+    # --- ivf_pq (config 3) + refine -------------------------------------
+    # kernel round 4: pq_bits=4 with pq_dim=d (same 512 code bits/row as
+    # pq64x8 but an 8x narrower one-hot decode) + int8-quantized LUT (the
+    # fp8-LUT role, double-rate MXU) + bf16 refine corpus (half the
+    # gather traffic). See scratch/exp_hard_tune.py for the sweep.
     with algo_section('ivf_pq'):
         t0 = time.perf_counter()
-        pi = robust_call(lambda: ivf_pq.build(
-            data, ivf_pq.IndexParams(n_lists=1024, pq_dim=64, seed=0)),
-            "ivf_pq build")
-        jax.block_until_ready(jax.tree.leaves(pi))
+        pis = robust_call(lambda: [
+            ivf_pq.build(p, ivf_pq.IndexParams(
+                n_lists=1024, pq_dim=min(d, 128), pq_bits=4, seed=0))
+            for p in parts], "ivf_pq build")
+        jax.block_until_ready(jax.tree.leaves(pis))
         pq_build = time.perf_counter() - t0
-        ivf_pq.prepare_scan(pi)     # scan prep out of the timed search graph
+        for pi in pis:
+            ivf_pq.prepare_scan(pi)
         log(f"# ivf_pq built in {pq_build:.0f}s")
-        # sweep the refine ratio (the recall axis once probes stop binding —
-        # measured: recall plateaus in n_probes at fixed candidate count)
-        # and a reduced-probe point (the QPS axis, as in the ivf_flat walk)
-        def measure_pq(probes, ratio):
-            sp = ivf_pq.SearchParams(n_probes=probes)
+        parts_bf16 = [jnp.asarray(p, jnp.bfloat16) for p in parts]
+        jax.block_until_ready(parts_bf16)
 
-            # index + corpus ride as jit ARGUMENTS (the Index pytree
-            # carries its scan-prep cache): closure-baking them as HLO
-            # constants exceeds the tunnel's remote-compile request
-            # limit at 500k rows (observed HTTP 413). Queries stay the
-            # FIRST argument — measure()'s anti-replay perturbation
-            # keys off args[0] being a float array.
-            def pq_refined(q, idx, dd, s=sp, r=ratio):
-                _, cand = ivf_pq.search(idx, q, r * k, s)
+        def pq_refined_tp(probes, ratio):
+            """Per-part scan + per-part bf16 refine, exact merge (refine
+            before merge == refine after merge for top-k)."""
+            sp = ivf_pq.SearchParams(n_probes=probes, lut_dtype="int8")
+
+            def body(q, idx, dd):
+                _, cand = ivf_pq.search(idx, q, ratio * k, sp)
                 return refine.refine(dd, q, cand, k)
 
-            fn = jax.jit(pq_refined)
-            dt = median_time(fn, queries, pi, data, floor=suspect_floor)
-            if dt is None:
+            return TwoPart(jax.jit(body), pis, offsets, k,
+                           extras=[(pb,) for pb in parts_bf16])
+
+        def measure_pq(probes, ratio):
+            tp = pq_refined_tp(probes, ratio)
+            thr, lat = measure_tp(tp, queries)
+            if thr is None:
                 return None
             rec = robust_call(
-                lambda: device_recall(fn(queries, pi, data)[1], gt),
-                "ivf_pq recall")
+                lambda: device_recall(tp(queries)[1], gt), "ivf_pq recall")
             add_entry("raft_ivf_pq",
-                      f"raft_ivf_pq.nlist1024.pq64.nprobe{probes}"
-                      f".refine{ratio}",
-                      nq / dt, rec, pq_build)
+                      f"raft_ivf_pq.nlist1024.pq{min(d, 128)}x4.int8"
+                      f".nprobe{probes}.refine{ratio}",
+                      thr, lat, rec, pq_build)
             return rec
 
         rec_a = measure_pq(20, 2)
         if not hurry:
             if rec_a is None:
-                # a transient anchor failure must not zero the lane:
-                # still record the secondary operating points
                 measure_pq(10, 2)
                 measure_pq(20, 4)
             elif rec_a >= 0.95:
@@ -490,59 +623,54 @@ def main():
                 if rec_a < 0.995:
                     measure_pq(20, 4)
             else:
-                # at bigger corpora the anchor misses 0.95 (bigger lists
-                # per probe, same candidate count): walk recall up via
-                # refine ratio first (cheap), then probes
                 for probes, ratio in ((20, 4), (50, 4)):
                     r = measure_pq(probes, ratio)
                     if r is not None and r >= 0.95:
                         break
+        del parts_bf16
 
     # --- cagra (config 4: graph_degree=64) ------------------------------
     with algo_section('cagra'):
         remaining = budget_s - (time.perf_counter() - t_start)
-        # full-corpus CAGRA builds only when the budget clearly allows
-        # (a 500k optimize pass alone is ~15 min through the tunnel);
-        # mid/small scales cap the graph corpus at 100k
-        cagra_n = n if remaining > 1200 and scale == "full" else \
+        # round 4: optimize()/seeds rework + ivf_pq candidate graph make
+        # a 500k build feasible; still budget-gated. One part only — the
+        # graph index demonstrates single-index scaling (the sharded form
+        # is dryrun_multichip's job).
+        cagra_n = part_n if remaining > 900 and part_n >= 500_000 else \
             min(n, 100_000 if scale != "micro" else 20_000)
         cagra_env = os.environ.get("RAFT_TPU_BENCH_CAGRA_N")
         if cagra_env:
             cagra_n = int(cagra_env)
         else:
-            # budget gate scaled to the corpus actually being built (100k
-            # builds have taken 500-1300s in degraded windows; small builds
-            # are cheap) — a recorded three-algo result beats dying
-            # mid-build. An explicit CAGRA_N override always runs: the
-            # operator asked for this data point.
             need_s = 700 if cagra_n > 50_000 else 120
             from raft_tpu.core.errors import expects as _expects
             _expects(remaining > need_s,
                      "budget skip: %.0fs left < %ds needed for a %d-row "
                      "cagra build", remaining, need_s, cagra_n)
         cdata = data[:cagra_n]
-        if cagra_n != n:
-            # corpus as a jit argument (not closure) like every other
-            # lane: a 500k+ CAGRA_N override must not 413 the section
-            cgt_fn = jax.jit(lambda q, cd: brute_force.search(
-                brute_force.build(cd), q, k, algo="matmul"))
-            _, cgt = cgt_fn(queries, cdata)
-        else:
+        if cagra_n == n:
             cgt = gt
+        elif cagra_n == part_n:
+            # part A's ground truth: rerun the part-A search fn
+            cgt_fn = jax.jit(lambda q, idx: brute_force.search(
+                idx, q, k, algo="matmul")[1])
+            cgt = robust_call(lambda: jnp.concatenate(
+                [cgt_fn(queries[c0 : c0 + 1000], bfs[0])
+                 for c0 in range(0, nq, 1000)]), "cagra part gt")
+        else:
+            cgt_fn = jax.jit(lambda q, cd: brute_force.search(
+                brute_force.build(cd), q, k, algo="matmul")[1])
+            cgt = robust_call(lambda: cgt_fn(queries, cdata), "cagra gt")
         t0 = time.perf_counter()
         ci = robust_call(lambda: cagra.build(cdata, cagra.IndexParams(
             graph_degree=64, intermediate_graph_degree=96, seed=0)),
             "cagra build")
         jax.block_until_ready(jax.tree.leaves(ci))
         cagra_build = time.perf_counter() - t0
-        cagra.prepare_search(ci)    # bf16 traversal copy out of the timed graph
+        cagra.prepare_search(ci)
         log(f"# cagra built ({cagra_n} rows) in {cagra_build:.0f}s")
-        # sweep (itopk, search_width, max_iterations): the covering seed
-        # set (one GEMM) plus a few gather-bound hops is the operating
-        # regime — measured sweep 2026-07-31 (seeds=1558, 100k corpus):
-        # (16,8,mi2) 58.6k @ 0.956, (32,4,mi3) 58.6k @ 0.959,
-        # (32,4,mi5) 47.0k @ 0.972, (64,4,mi8) 29.6k @ 0.982;
-        # vs 31.8k @ 0.948 for the best random-seeded point
+        # sweep (itopk, search_width, max_iterations); measured sweep
+        # 2026-07-31 (see bench.py history): covering seeds + few hops
         sweep = (((32, 4, 5),) if hurry
                  else ((16, 8, 2), (32, 4, 3), (32, 4, 5), (64, 4, 8)))
         opener = sweep[0]
@@ -550,37 +678,44 @@ def main():
             sp = cagra.SearchParams(itopk_size=itopk, search_width=width,
                                     max_iterations=mi)
             fn = jax.jit(lambda q, idx, s=sp: cagra.search(idx, q, k, s))
-            dt = median_time(fn, queries, ci, reps=3, floor=suspect_floor)
-            if dt is None:
+            lat = median_time(fn, queries, ci, reps=3, floor=suspect_floor)
+            thr = throughput_time(fn, queries, ci, floor=suspect_floor)
+            if thr is None:
                 continue
             rec = robust_call(lambda: device_recall(fn(queries, ci)[1], cgt),
                               "cagra recall")
             add_entry("raft_cagra",
                       f"raft_cagra.degree64.itopk{itopk}.w{width}"
                       f".mi{mi or 'auto'}",
-                      nq / dt, rec, cagra_build, {"corpus_n": cagra_n})
-            # never break on the low-recall opener: the baseline-comparable
-            # ≥0.95-recall anchor must always be measured
+                      thr, lat, rec, cagra_build, {"corpus_n": cagra_n})
             if rec >= 0.995 and (itopk, width, mi) != opener:
                 break
 
+    # --- dataset IO: exercise the raft-ann-bench fbin loader ------------
+    try:
+        dataset_io = exercise_fbin_io(data)
+        log(f"# fbin round-trip: {dataset_io}")
+    except Exception as e:  # noqa: BLE001
+        dataset_io = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
     # --- roofline: report utilization against the measured chip peak ----
-    # never let the probe kill the run: after an earlier section OOMs,
-    # the backend can stay resource-exhausted, and losing the JSON line
-    # over a diagnostic probe would discard every recorded measurement
     log("# probing roofline")
     try:
-        peaks = roofline.probe(quick=True)
+        # micro is the CPU harness smoke: the amortized 8192-wide matmul
+        # loops are minutes of host time there and probe nothing real
+        peaks = roofline.probe(quick=True) if scale != "micro" else {}
     except Exception as e:  # noqa: BLE001
         log(f"# roofline probe failed ({type(e).__name__}: {e}); "
             "omitting utilization")
         peaks = {}
-    bf_entries = [e for e in entries if e["algo"] == "raft_brute_force"]
-    if bf_entries and peaks.get("matmul_f32_tflops"):
-        gemm_tflops = 2.0 * nq * n * d / (nq / bf_entries[0]["qps"]) / 1e12
+    # utilization of the f32 matmul entry specifically (the bf16 variant
+    # divides by the bf16 peak)
+    util = -1.0
+    bf_f32 = [e for e in entries if e["algo"] == "raft_brute_force"
+              and ".bf16" not in e["name"]]
+    if bf_f32 and peaks.get("matmul_f32_tflops"):
+        gemm_tflops = (2.0 * n * d * bf_f32[0]["qps"]) / 1e12
         util = gemm_tflops / max(peaks["matmul_f32_tflops"], 1e-9)
-    else:
-        util = -1.0
 
     # headline: BASELINE config 2 (ivf_flat QPS @ recall>=0.95)
     if flat_best is not None:
@@ -602,24 +737,27 @@ def main():
         "vs_baseline": round(value / BASELINE_QPS["raft_ivf_flat"], 3),
         "recall": round(rec, 4),
         "recall_target_met": met,
-        "corpus": {"n": n, "d": d, "nq": nq, "k": k,
-                   "kind": "clustered-gaussian-synthetic"},
+        "corpus": {"n": n, "d": d, "nq": nq, "k": k, "parts": n_parts,
+                   "kind": "low-intrinsic-dim-clustered-synthetic",
+                   "mixture_scale": CORPUS_SCALE,
+                   "intrinsic_d": CORPUS_INTRINSIC_D,
+                   "clusters": CORPUS_CLUSTERS,
+                   "queries": "fresh-mixture-samples"},
+        "qps_methodology": "pipelined throughput (GBench items_per_second "
+                           "analog); latency_ms = per-call-blocked median",
         "entries": entries,
+        "dataset_io": dataset_io,
         "roofline": peaks,
         "bf_gemm_utilization_of_measured_peak": round(util, 4),
-        # how many timings tripped the plausibility floor and were
-        # re-measured through a fresh executable (ops.autotune.measure)
         "timing_floor_trips": _autotune.suspect_events,
+        "baselines": {a: b["derivation"] for a, b in BASELINES.items()},
         # BASELINE config 5 (multi-node sharded ivf_pq) has no QPS here:
         # one physical chip. Its correctness path runs elsewhere.
         "sharded_config5": {
             "status": "validated-functionally",
             "evidence": "8-device CPU-mesh tests (tests/test_sharded_ann"
-                        ".py) + driver dryrun_multichip (sharded brute "
-                        "force AND ivf_pq steps); no multi-chip hardware "
-                        "for QPS"},
-        "baseline_note": "derived A100 estimates (see bench.py); RAFT "
-                         "24.02 publishes plots, not tables",
+                        ".py) + driver dryrun_multichip (brute force, "
+                        "ivf_pq AND cagra recall-checked vs exact)"},
     }
     print(json.dumps(out))
 

@@ -5,10 +5,16 @@ the device actually in use (matmul TFLOP/s, HBM stream GB/s), because
 assumed per-generation limits (e.g. v5e datasheet numbers) can be off by
 orders of magnitude under remote/tunneled or simulated backends.
 
-Methodology: ``ops.autotune.measure`` — one blocking ``block_until_ready``
-per call (backends can elide never-awaited dispatches, making
-block-once-after-N timing meaningless), median of ``reps`` calls. Inputs
-are generated on device — host↔device transfer never enters the timing.
+Methodology: every probe runs its hot op ``iters`` times INSIDE one
+compiled program (``lax.fori_loop`` with an iteration-dependent,
+non-foldable carry), so the device window is hundreds of milliseconds and
+the tunnel's ~90 ms dispatch round trip (see ``dispatch_us``) amortizes
+away — a single 8192³ matmul is ~6 ms of MXU time and would otherwise
+read as ~12 TFLOP/s on a chip whose true bf16 peak is an order of
+magnitude higher. Timing is ``ops.autotune.measure`` (per-call blocked,
+median); inputs are generated on device — host↔device transfer never
+enters the timing. The loop carry feeds every iteration from the previous
+one, so no iteration can be elided or hoisted.
 """
 from __future__ import annotations
 
@@ -22,37 +28,62 @@ from ..ops.autotune import measure as _median_time
 __all__ = ["probe", "matmul_tflops", "hbm_stream_gbps", "dispatch_us"]
 
 
-def matmul_tflops(n: int = 8192, dtype=jnp.bfloat16, reps: int = 7) -> float:
-    """Sustained TFLOP/s of one n×n×n matmul (result consumed on device)."""
+def matmul_tflops(n: int = 8192, dtype=jnp.bfloat16, reps: int = 5,
+                  iters: int = 32) -> float:
+    """Sustained TFLOP/s of ``iters`` chained n×n×n matmuls in one program.
+
+    The chain c ← c @ (b/√n) keeps magnitudes stable (b ~ N(0,1), so
+    b/√n has unit spectral scale in expectation) and makes every matmul
+    depend on the previous one — XLA cannot drop or reorder iterations.
+    """
     key = jax.random.PRNGKey(0)
     a = jax.random.normal(key, (n, n), jnp.float32).astype(dtype)
-    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32).astype(dtype)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32)
+    bs = (b / jnp.sqrt(float(n))).astype(dtype)
 
     @jax.jit
-    def f(a, b):
-        return jax.lax.dot_general(
-            a, b, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+    def f(a, bs):
+        def body(_, c):
+            return jax.lax.dot_general(
+                c, bs, (((1,), (0,)), ((), ())),
+                preferred_element_type=dtype)
+        return jax.lax.fori_loop(0, iters, body, a)
 
-    dt = _median_time(f, a, b, reps=reps)
-    return 2.0 * n ** 3 / dt / 1e12
+    dt = _median_time(f, a, bs, reps=reps)
+    return 2.0 * n ** 3 * iters / dt / 1e12
 
 
-def hbm_stream_gbps(mbytes: int = 1024, reps: int = 7) -> float:
-    """Sustained HBM read GB/s on a streaming f32 sum reduction."""
+def hbm_stream_gbps(mbytes: int = 1024, reps: int = 5,
+                    iters: int = 32) -> float:
+    """Sustained HBM GB/s on a chained read+write stream.
+
+    Each iteration reads and rewrites the full buffer with an
+    iteration-dependent scale (not constant-foldable across the loop), so
+    traffic per iteration is 2 × buffer bytes.
+    """
     n = (mbytes << 20) // 4
     x = jax.random.normal(jax.random.PRNGKey(2), (n,), jnp.float32)
 
     @jax.jit
     def f(x):
-        return jnp.sum(x)
+        def body(i, c):
+            # one-ulp-scale, i-dependent factor: must exceed f32's
+            # 2^-24 so the multiply actually changes values (1 + 1e-9
+            # rounds to exactly 1.0f and the loop would be a bitwise
+            # identity a value-analyzing backend could elide)
+            return c * (1.0 + (2.0 ** -23) * (i + 1).astype(jnp.float32))
+        return jax.lax.fori_loop(0, iters, body, x)
 
     dt = _median_time(f, x, reps=reps)
-    return 4.0 * n / dt / 1e9
+    return 2.0 * 4.0 * n * iters / dt / 1e9
 
 
 def dispatch_us(reps: int = 11) -> float:
-    """Median round-trip of a trivial dispatch (1-element add + sync)."""
+    """Median round-trip of a trivial dispatch (1-element add + sync).
+
+    Deliberately NOT amortized: this is the per-call overhead number the
+    amortized probes are defending against, reported so readers can judge
+    how much of any per-call latency is transport."""
     x = jnp.zeros((8, 128), jnp.float32)
 
     @jax.jit
@@ -63,16 +94,17 @@ def dispatch_us(reps: int = 11) -> float:
 
 
 def probe(quick: bool = False) -> Dict[str, float]:
-    """Measure this device's effective peaks. ~4 compiles, a few seconds
-    of runtime (plus compile time) on a healthy backend."""
-    reps = 3 if quick else 7
+    """Measure this device's effective peaks. ~4 compiles; the amortized
+    loops put a few hundred ms of device work behind each dispatch."""
+    reps = 3 if quick else 5
+    iters = 16 if quick else 32
     return {
         "matmul_bf16_tflops": round(matmul_tflops(dtype=jnp.bfloat16,
-                                                  reps=reps), 1),
+                                                  reps=reps, iters=iters), 1),
         "matmul_f32_tflops": round(matmul_tflops(dtype=jnp.float32,
-                                                 reps=reps), 1),
+                                                 reps=reps, iters=iters), 1),
         "hbm_stream_gbps": round(hbm_stream_gbps(
-            mbytes=256 if quick else 1024, reps=reps), 1),
+            mbytes=256 if quick else 1024, reps=reps, iters=iters), 1),
         "dispatch_us": round(dispatch_us(), 1),
     }
 

@@ -4,15 +4,19 @@ Reference: raft/matrix/detail/select_radix.cuh (radix "AIR top-k") and
 select_warpsort.cuh (bitonic warp queues), with a heuristic auto-choice
 (select_k-inl.cuh:48-72). Used by brute force, IVF-Flat, IVF-PQ and CAGRA.
 
-TPU design: the workhorse is XLA's `lax.top_k`, which lowers to an optimized
-TPU sort network — the role the warpsort family plays on GPU. For the shapes
-where a two-pass approach wins (huge rows, small k), `algo="radix"`
-bucket-filters candidates first (the AIR-top-k idea) before running top_k on
-the survivors. `algo="auto"` consults the on-device measurement cache
-(populate with ``tune_select_k`` — the measured analog of the reference's
-per-arch ``choose_select_k_algorithm`` table, select_k-inl.cuh:48-72),
-falling back to a heuristic recorded from an on-chip sweep: radix wins for
-very wide rows with small k (see ``_AUTO_RADIX``).
+TPU design: the workhorse is XLA's ``lax.top_k``, which lowers to an
+optimized TPU partial-sort — the role the warpsort family plays on GPU.
+The reference's second engine (radix/AIR top-k) does NOT transfer: it is
+built on fast shared-memory histograms, and a histogram on TPU lowers to
+either a scatter-add (serialized) or a (n, 256) one-hot contraction whose
+FLOPs exceed the sort it would replace; a bucket pre-filter that merely
+masks values feeds the same-shape input to ``lax.top_k`` and cannot win
+(its cost is shape-dependent). An on-chip sweep confirmed this: every
+(rows, n, k) class measured within dispatch noise of plain top_k
+(bench_select_k_sweep.json at the repo root). ``SelectAlgo.RADIX`` is
+therefore kept for API parity but documented as an alias of TOPK; the
+measured sweep is the evidence the reference encodes in its per-arch
+``choose_select_k_algorithm`` table.
 """
 from __future__ import annotations
 
@@ -29,11 +33,17 @@ __all__ = ["SelectAlgo", "select_k", "tune_select_k"]
 
 
 class SelectAlgo(enum.Enum):
-    """Mirror of raft/matrix/select_k_types.hpp:36."""
+    """Mirror of raft/matrix/select_k_types.hpp:36.
+
+    On TPU every name maps to the same sort-based engine (see module
+    docstring for the measured justification); the enum exists so
+    reference callers porting ``select_k(..., SelectAlgo::kRadix...)``
+    keep working.
+    """
 
     AUTO = "auto"
     TOPK = "topk"        # direct lax.top_k (warpsort analog)
-    RADIX = "radix"      # two-pass threshold filter + top_k (AIR analog)
+    RADIX = "radix"      # alias of TOPK on TPU (no histogram engine)
 
 
 def _topk_smallest(values: jax.Array, k: int, select_min: bool):
@@ -42,61 +52,22 @@ def _topk_smallest(values: jax.Array, k: int, select_min: bool):
     return (-vals if select_min else vals), idxs
 
 
-def _radix_two_pass(values: jax.Array, k: int, select_min: bool):
-    """Histogram-threshold pre-filter, then exact top-k over survivors.
-
-    A simplified AIR-top-k: one 256-bucket histogram pass bounds the k-th
-    value's bucket; only candidates at or beyond that bucket go through the
-    final sort. On TPU the benefit appears for very wide rows (len >> 16k)
-    where the full sort's O(n log n) dominates; the histogram is one
-    scan + cumsum.
-    """
-    v = -values if select_min else values  # selecting largest of v
-    n = v.shape[-1]
-    lo = jnp.min(v, axis=-1, keepdims=True)
-    hi = jnp.max(v, axis=-1, keepdims=True)
-    scale = jnp.where(hi > lo, 255.0 / (hi - lo), 0.0)
-    buckets = ((v - lo) * scale).astype(jnp.int32)  # 0..255, higher = larger
-    hist = jax.vmap(lambda b: jnp.bincount(b, length=256))(
-        buckets.reshape(-1, n)).reshape(*v.shape[:-1], 256)
-    # count of elements in buckets >= b
-    tail = jnp.cumsum(hist[..., ::-1], axis=-1)[..., ::-1]
-    # smallest bucket whose tail count >= k: all top-k live at or above it
-    thresh_bucket = jnp.argmax((tail >= k).astype(jnp.int32) *
-                               jnp.arange(256, dtype=jnp.int32), axis=-1)
-    keep = buckets >= thresh_bucket[..., None]
-    neg_inf = jnp.array(-jnp.inf, v.dtype)
-    vals, idxs = jax.lax.top_k(jnp.where(keep, v, neg_inf), k)
-    return (-vals if select_min else vals), idxs
-
-
-def _auto_choice(n: int, k: int) -> "SelectAlgo":
-    """auto = the cached on-device measurement for this (n, k) class, else
-    topk. The untuned fallback is deliberately NOT radix: on TPU the
-    bucket pre-filter masks values but cannot shrink lax.top_k's input
-    (its cost is shape-dependent), so radix only wins where a recorded
-    measurement says the masked sort is cheaper on that hardware — run
-    ``tune_select_k`` to populate the cache; a recorded on-chip sweep
-    ships in bench_select_k_sweep.json at the repo root."""
-    from ..ops import autotune
-
-    hit = autotune.lookup(autotune.shape_bucket("select_k", n=n, k=k))
-    if hit in ("topk", "radix"):
-        return SelectAlgo(hit)
-    return SelectAlgo.TOPK
-
-
 def tune_select_k(rows: int, n: int, k: int, select_min: bool = True,
                   reps: int = 5):
-    """Measure topk vs radix for this shape class on the current device and
-    cache the winner for ``algo="auto"`` (call eagerly, not under jit)."""
+    """Measure the top-k engine for this shape class on the current device
+    and cache it for ``algo="auto"`` (call eagerly, not under jit).
+
+    With a single engine this is a calibration probe, not a contest: it
+    records the measured per-call cost so regressions in the backend's
+    sort lowering are visible across processes (the reference's
+    ``choose_select_k_algorithm`` table role, select_k-inl.cuh:48-72).
+    """
     from ..ops import autotune
 
     x = jax.random.normal(jax.random.PRNGKey(0), (rows, n), jnp.float32)
     key = autotune.shape_bucket("select_k", n=n, k=k)
     cands = {
         "topk": jax.jit(lambda v: _topk_smallest(v, k, select_min)),
-        "radix": jax.jit(lambda v: _radix_two_pass(v, k, select_min)),
     }
     return autotune.tune_best(key, cands, x, reps=reps, force=True)
 
@@ -119,12 +90,7 @@ def select_k(
     algo = SelectAlgo(algo) if not isinstance(algo, SelectAlgo) else algo
     n = values.shape[-1]
     expects(0 < k <= n, "k=%d out of range for row length %d", k, n)
-    if algo is SelectAlgo.AUTO:
-        algo = _auto_choice(n, k)
-    if algo is SelectAlgo.RADIX and k < n:
-        vals, idxs = _radix_two_pass(values, k, select_min)
-    else:
-        vals, idxs = _topk_smallest(values, k, select_min)
+    vals, idxs = _topk_smallest(values, k, select_min)
     if indices is not None:
         idxs = jnp.take_along_axis(indices, idxs, axis=-1)
     return vals, idxs.astype(jnp.int32) if idxs.dtype != jnp.int32 else idxs

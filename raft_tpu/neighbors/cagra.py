@@ -215,19 +215,27 @@ def build_knn_graph(dataset, k: int, metric=DistanceType.L2Expanded,
         return graph
 
     n_lists = max(16, min(1024, int(np.sqrt(n) * 2)))
-    pq_dim = ivf_pq_mod._default_pq_dim(dim)
+    # pq_bits=4 at pq_dim=dim: same code bits/row as pq_dim=dim/2 @ 8-bit
+    # but an 8x narrower one-hot decode; int8 LUT doubles the MXU decode
+    # rate (the round-4 scan rework — candidate quality is recovered by
+    # the exact refine below)
+    pq_dim = min(dim, 4 * ivf_pq_mod._default_pq_dim(dim))
     index = ivf_pq_mod.build(dataset, ivf_pq_mod.IndexParams(
-        n_lists=n_lists, pq_dim=pq_dim, metric=mt, seed=seed))
-    n_probes = max(16, min(n_lists, n_lists // 4))
+        n_lists=n_lists, pq_dim=pq_dim, pq_bits=4, metric=mt, seed=seed))
+    # candidate recall, not search recall, is the bar here (refine +
+    # optimize()'s detour pruning tolerate imperfect candidates):
+    # a quarter-of-corpus probe sweep would be minutes per batch at 500k
+    n_probes = max(16, min(64, n_lists // 8))
     gpu_k = min(n, k * 2 + 1)  # refine_rate=2 + room for the self match
+    dataset_bf16 = jnp.asarray(dataset, jnp.bfloat16)  # half the gather
+    sp = ivf_pq_mod.SearchParams(n_probes, lut_dtype="int8")
 
     for b0 in range(0, n, batch):
         hi = min(b0 + batch, n)
         idx_rows = (np.arange(b0, b0 + batch) % n).astype(np.int32)
         qb = dataset[idx_rows]
-        _, cand = ivf_pq_mod.search(index, qb, gpu_k,
-                                    ivf_pq_mod.SearchParams(n_probes))
-        _, ref = refine_mod.refine(dataset, qb, cand, k + 1, mt)
+        _, cand = ivf_pq_mod.search(index, qb, gpu_k, sp)
+        _, ref = refine_mod.refine(dataset_bf16, qb, cand, k + 1, mt)
         out = np.asarray(drop_self(ref, jnp.asarray(idx_rows)))
         graph[b0:hi] = out[: hi - b0]
     return graph
@@ -265,26 +273,28 @@ def _drop_self_pad(ref, rows, *, k: int, n: int):
     return jnp.where(n_ok > 0, out, (rows[:, None] + 1) % n).astype(jnp.int32)
 
 
-def _detour_counts(graph_sorted, graph_j, batch_nodes):
+def _detour_counts(graph_j, batch_nodes):
     """(b, d0) detour counts for a batch of nodes (kern_prune analog).
 
     Edge (i, N_i[b]) is detourable through N_i[a] (a < b, i.e. a closer
-    neighbor) if the graph has the edge N_i[a] → N_i[b]. Membership is a
-    searchsorted probe into pre-sorted adjacency rows — O(d0² log d0) per
-    node instead of the O(d0³) all-pairs comparison, which dominated
-    optimize() wall time at build scale.
+    neighbor) if the graph has the edge N_i[a] → N_i[b]. Membership is an
+    all-compare with the equality reduction over the adjacency minor axis
+    — O(d0³) VPU compares per node, but every op is a dense vector op
+    XLA fuses into the reduction (order-insensitive: no pre-sorted
+    adjacency needed). The O(d0² log d0) searchsorted alternative is
+    asymptotically better and catastrophically slower here: its
+    per-bisection-step ``take_along_axis`` lowers to per-ELEMENT gathers
+    (~470M scalar loads per batch, measured 12.3 s/batch vs <0.5 s for
+    this form — full optimize 277.8 s → 37.3 s at 100k).
     """
     nbrs = graph_j[batch_nodes]                       # (B, d0)
     b, d0 = nbrs.shape
-    nbr_rows = graph_sorted[nbrs]                     # (B, d0, d0) sorted
-    rows2 = nbr_rows.reshape(b * d0, d0)
-    tgts2 = jnp.broadcast_to(nbrs[:, None, :], (b, d0, d0)).reshape(
-        b * d0, d0)
-    pos = jax.vmap(jnp.searchsorted)(rows2, tgts2)    # (B*d0, d0)
-    hit = jnp.take_along_axis(rows2, jnp.minimum(pos, d0 - 1),
-                              axis=1) == tgts2
-    adj = hit.reshape(b, d0, d0)                      # adj[x, a, b]
-    tri = jnp.tril(jnp.ones((d0, d0), bool), k=-1).T  # a < b strictly
+    nbr_rows = graph_j[nbrs]                          # (B, d0, d0)
+    # adj[x, a, t] = any_c nbr_rows[x, a, c] == nbrs[x, t]; the 4-D
+    # broadcast never materializes — XLA fuses compare into the c-reduce
+    adj = jnp.any(nbr_rows[:, :, :, None] == nbrs[:, None, None, :],
+                  axis=2)                             # (B, a, t)
+    tri = jnp.tril(jnp.ones((d0, d0), bool), k=-1).T  # a < t strictly
     return jnp.sum(adj & tri[None], axis=1)           # (B, d0)
 
 
@@ -306,13 +316,13 @@ def _merge_tail_batch(kept, cand, rows, tail_w: int):
 
 
 @partial(jax.jit, static_argnames=("graph_degree",))
-def _prune_batch(graph_sorted, graph_j, nodes, graph_degree: int):
+def _prune_batch(graph_j, nodes, graph_degree: int):
     """One node-batch of detour counting + rank-composite prune
     (kern_prune analog): count, argsort the (detours, rank) key, keep
     the best ``graph_degree`` — all on device, only the (B, degree)
     result leaves the chip."""
     d0 = graph_j.shape[1]
-    detours = _detour_counts(graph_sorted, graph_j, nodes)
+    detours = _detour_counts(graph_j, nodes)
     # composite key (detours ≤ d0 ≤ 512 keeps it well inside int32)
     key = detours * d0 + jnp.arange(d0, dtype=jnp.int32)[None, :]
     order = jnp.argsort(key, axis=1, stable=True)[:, :graph_degree]
@@ -345,6 +355,26 @@ def _rev_group_jit(pruned, keep_fwd: int, rev_cap: int):
 
 
 
+def _rev_group_host(pruned: np.ndarray, keep_fwd: int,
+                    rev_cap: int) -> np.ndarray:
+    """Host mirror of :func:`_rev_group_jit` for node counts where the
+    one monolithic device sort is unrehearsed (large fused programs have
+    crashed the tunneled TPU worker; a 32M-element np.argsort is ~2 s)."""
+    n = pruned.shape[0]
+    tgt = pruned[:, :keep_fwd].T.reshape(-1).astype(np.int64)
+    src = np.tile(np.arange(n, dtype=np.int32), keep_fwd)
+    tgt = np.where((tgt >= 0) & (tgt < n), tgt, n)
+    so = np.argsort(tgt, kind="stable")
+    ts, cs = tgt[so], src[so]
+    counts = np.bincount(ts, minlength=n + 1)
+    seg_start = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pos = np.arange(len(ts)) - seg_start[ts]
+    keep = (pos < rev_cap) & (ts < n)
+    rev = np.full((n, rev_cap), -1, np.int32)
+    rev[ts[keep], pos[keep].astype(np.int64)] = cs[keep]
+    return rev
+
+
 @tracing.annotate("raft_tpu::cagra::optimize")
 def optimize(knn_graph: np.ndarray, graph_degree: int,
              batch: int = 2048) -> np.ndarray:
@@ -363,24 +393,33 @@ def optimize(knn_graph: np.ndarray, graph_degree: int,
     n, d0 = knn_graph.shape
     expects(graph_degree <= d0, "graph_degree %d > intermediate %d",
             graph_degree, d0)
-    # bound the ~4 live (B, d0, d0) membership intermediates (rows,
-    # broadcast targets, searchsorted positions, hits) to ~1 GB total
+    # bound the live membership working set — the (B, d0, d0) adjacency
+    # gather (int32) plus the (B, d0, d0) adj/hit planes; the 4-D
+    # broadcast compare itself fuses into its reduction and never
+    # materializes (measured: see _detour_counts)
     batch = max(256, min(batch * 8, (1 << 30) // max(d0 * d0 * 16, 1)))
     batch = min(batch, n)
     keep_fwd = graph_degree - graph_degree // 2
     tail_w = graph_degree - keep_fwd
     graph_j = jnp.asarray(knn_graph)
-    graph_sorted = jnp.sort(graph_j, axis=1)
 
     pruned = np.zeros((n, graph_degree), np.int32)
     for b0 in range(0, n, batch):
         hi = min(b0 + batch, n)
         nodes = jnp.asarray(np.arange(b0, b0 + batch) % n)
         pruned[b0:hi] = np.asarray(_prune_batch(
-            graph_sorted, graph_j, nodes, graph_degree))[: hi - b0]
+            graph_j, nodes, graph_degree))[: hi - b0]
 
     pruned_j = jnp.asarray(pruned)
-    rev = _rev_group_jit(pruned_j, keep_fwd, graph_degree)
+    import os as _os
+    rev_jit_edges = int(_os.environ.get("RAFT_TPU_REV_JIT_EDGES",
+                                        str(20 << 20)))
+    if n * keep_fwd > rev_jit_edges:
+        # scale guard (rehearsed to 500k nodes on device): beyond it the
+        # stable argsort+scatter over all n*keep_fwd edges runs on host
+        rev = jnp.asarray(_rev_group_host(pruned, keep_fwd, graph_degree))
+    else:
+        rev = _rev_group_jit(pruned_j, keep_fwd, graph_degree)
 
     # interleave reverse and forward-tail candidates 1:1 (rev first)
     fwd_tail = jnp.full((n, graph_degree), -1, jnp.int32)
@@ -432,8 +471,15 @@ def build(dataset, params: IndexParams | None = None) -> Index:
         n_seed = n_seed if n > 4 * n_seed else 0
     else:
         # explicit request: honor it, clamped so the seed set stays a
-        # strict covering subset
+        # strict covering subset; requests below search()'s 64-row
+        # eligibility threshold would build dead weight (search ignores
+        # smaller seed sets), so clamp them to 0 and say so
         n_seed = min(p.seed_nodes, n // 4)
+        if 0 < n_seed < 64:
+            rlog.log_warn(
+                "cagra.build: seed_nodes=%d is below the 64-row search "
+                "threshold; skipping seed construction", n_seed)
+            n_seed = 0
     seeds = (_covering_seeds(dataset, n_seed, mt, p.seed)
              if n_seed > 0 else None)
     rlog.log_info(
@@ -443,19 +489,27 @@ def build(dataset, params: IndexParams | None = None) -> Index:
 
 
 def _covering_seeds(dataset, s: int, mt, seed: int) -> jax.Array:
-    """(s,) sorted unique dataset row ids nearest to balanced-kmeans
-    centroids: the shared traversal seed set (one small GEMM scores it
-    for every query at search time).
+    """(s,) sorted unique dataset row ids nearest to kmeans centroids:
+    the shared traversal seed set (one small GEMM scores it for every
+    query at search time).
 
-    The centroid→row step always uses L2: the seed set must cover the
-    *geometry* of the corpus — under InnerProduct a max-IP pick would
-    collapse onto a few high-norm rows and cover nothing."""
-    from ..cluster import kmeans_balanced
+    Coverage needs *spread*, not balanced partition quality, so the
+    centroids come from a fixed-iteration Lloyd over a bounded subsample
+    — one compiled executable (a full balanced-kmeans here was 125 s of
+    the 100k build, >10x the phase's usefulness). The centroid→row step
+    always uses L2: the seed set must cover the *geometry* of the corpus
+    — under InnerProduct a max-IP pick would collapse onto a few
+    high-norm rows and cover nothing."""
     from . import brute_force as bf_mod
+    from .ivf_pq import _kmeans_fixed
 
-    cent = kmeans_balanced.fit(
-        jnp.asarray(dataset), s,
-        kmeans_balanced.BalancedKMeansParams(seed=seed))
+    dataset = np.asarray(dataset, np.float32)
+    n = len(dataset)
+    rng = np.random.default_rng(seed)
+    t = min(n, max(8 * s, 20_000))
+    rows = rng.choice(n, size=t, replace=False)
+    cent = _kmeans_fixed(jnp.asarray(dataset[rows]), s, 10,
+                         jax.random.PRNGKey(seed))
     index = bf_mod.build(dataset, DistanceType.L2Expanded)
     _, ids = bf_mod.search(index, cent, 1, algo="matmul")
     return jnp.asarray(np.unique(np.asarray(ids[:, 0])), jnp.int32)
@@ -730,6 +784,10 @@ def load(path) -> Index:
     expects(version in (1, _SERIAL_VERSION),
             "unsupported version %d", version)
     seeds = arrs.get("seed_nodes")
+    if seeds is not None:
+        # canonicalize at the boundary: the search-time collision probe
+        # (jnp.searchsorted) requires sorted unique ids — an externally
+        # edited file with unsorted seeds would silently degrade dedup
+        seeds = jnp.asarray(np.unique(np.asarray(seeds)), jnp.int32)
     return Index(jnp.asarray(arrs["dataset"]), jnp.asarray(arrs["graph"]),
-                 DistanceType(meta["metric"]),
-                 None if seeds is None else jnp.asarray(seeds))
+                 DistanceType(meta["metric"]), seeds)

@@ -92,11 +92,38 @@ class SearchParams:
     """Mirror of ivf_pq::search_params (ivf_pq_types.hpp:146).
 
     The reference's lut_dtype/internal_distance_dtype knobs select smem LUT
-    precision; here `lut_dtype` selects the LUT compute dtype (bf16 halves
-    VMEM traffic on TPU, fp32 is exact)."""
+    precision; here `lut_dtype` selects the scan compute dtype:
+    ``jnp.float32`` exact, ``jnp.bfloat16`` (default, the fp16-LUT role),
+    or ``jnp.int8`` / ``"int8"`` (the fp8-LUT role: per-subspace
+    symmetrically-quantized codebook, int8 MXU decode at double rate —
+    pair with refine for full recall)."""
 
     n_probes: int = 20
-    lut_dtype: jnp.dtype = jnp.bfloat16
+    lut_dtype: jnp.dtype | str = jnp.bfloat16
+
+
+def _lut_mode(lut_dtype) -> str:
+    """SearchParams.lut_dtype → kernel mode string. Unknown names raise —
+    a typo must not silently downgrade precision."""
+    if isinstance(lut_dtype, str):
+        s = lut_dtype.lower()
+        if s in ("int8", "i8", "fp8"):
+            return "int8"
+        if s in ("f32", "float32", "fp32"):
+            return "f32"
+        expects(s in ("bf16", "bfloat16", "fp16", "f16"),
+                "unknown lut_dtype %r (use float32 / bfloat16 / int8)",
+                lut_dtype)
+        return "bf16"
+    dt = jnp.dtype(lut_dtype)
+    if dt == jnp.int8:
+        return "int8"
+    if dt == jnp.float32:
+        return "f32"
+    expects(dt in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)),
+            "unknown lut_dtype %r (use float32 / bfloat16 / int8)",
+            lut_dtype)
+    return "bf16"
 
 
 @jax.tree_util.register_pytree_node_class
@@ -497,7 +524,6 @@ def _search_pallas(index: Index, q, k, n_probes, lut_dtype, precision,
     coarse_metric = "ip" if mt is DistanceType.InnerProduct else "l2"
     _, probed = fused_knn(q_rot, index.centers_rot, n_probes,
                           metric=coarse_metric, precision=precision)
-    lut_bf16 = jnp.dtype(lut_dtype) != jnp.float32
     interpret = jax.default_backend() != "tpu"
     vals, rows = _ivf_pq_scan_jit(
         cache["codes_p"], cache["norms_p"], pen_p, index.centers_rot,
@@ -506,7 +532,7 @@ def _search_pallas(index: Index, q, k, n_probes, lut_dtype, precision,
         jnp.asarray(index.list_sizes, jnp.int32), q_rot, k, lmax,
         index.pq_dim, index.pq_book_size,
         "ip" if mt is DistanceType.InnerProduct else "l2",
-        lut_bf16, interpret, precision)
+        _lut_mode(lut_dtype), interpret, precision)
     ids = jnp.where(rows >= 0,
                     jnp.take(index.source_ids, jnp.maximum(rows, 0)), -1)
     if mt is DistanceType.L2SqrtExpanded:
@@ -543,11 +569,11 @@ def search(
     expects(index.size > 0, "index is empty")
     n_probes = min(p.n_probes, index.n_lists)
 
-    # wide PQ shapes need the bf16 LUT mode in the kernel (an f32 one-hot
-    # block would bust VMEM); an explicit f32-LUT request there keeps the
-    # exact gather path rather than silently downgrading precision
+    # wide PQ shapes need the bf16/int8 LUT modes in the kernel (an f32
+    # one-hot block would bust VMEM); an explicit f32-LUT request there
+    # keeps the exact gather path rather than silently downgrading
     wide_needs_bf16 = (index.pq_dim * index.pq_book_size >= 8192 and
-                       jnp.dtype(p.lut_dtype) == jnp.float32)
+                       _lut_mode(p.lut_dtype) == "f32")
     use_pallas = (algo == "pallas" or
                   (algo == "auto" and
                    index.codebook_kind is CodebookGen.PER_SUBSPACE and
@@ -659,7 +685,10 @@ def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
                    - 2.0 * jnp.einsum("mpsl,mpbl->mpsb", rs, books, precision="highest")
                    + cb2[:, :, None, :])
         const = jnp.zeros((m, n_probes), jnp.float32)
-    lut = lut.astype(lut_dtype)
+    # the gather path has no int8 formulation (scores are gathered, not
+    # GEMMed); int8 requests ride its bf16 LUT instead
+    mode = _lut_mode(lut_dtype)
+    lut = lut.astype(jnp.float32 if mode == "f32" else jnp.bfloat16)
 
     # stage 3: score packed codes via one flat gather per subspace
     rows, valid, probe_of = _candidate_rows(probed, offsets_j, sizes_j,

@@ -6,7 +6,10 @@ against the original dataset and keep the best k (device kernel
 detail/refine_device.cuh; host/OpenMP path detail/refine_host-inl.hpp).
 
 TPU design: one gather + batched dot products + select_k; -1 candidate ids
-(padding from upstream searches) are masked out.
+(padding from upstream searches) are masked out. The gather is the cost
+(random ~d·4-byte rows bound by HBM latency, not FLOPs), so a ``bfloat16``
+dataset is kept bf16 through the gather and contracted with f32
+accumulation — callers wanting cheaper refine pass a bf16 corpus copy.
 """
 from __future__ import annotations
 
@@ -33,7 +36,9 @@ def refine(
     metric: DistanceType | str = DistanceType.L2Expanded,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact re-rank: (m, c) candidate ids → (m, k) distances + ids."""
-    x = jnp.asarray(dataset, jnp.float32)
+    x = jnp.asarray(dataset)
+    if x.dtype != jnp.bfloat16:
+        x = x.astype(jnp.float32)
     q = jnp.asarray(queries, jnp.float32)
     cand = jnp.asarray(candidates, jnp.int32)
     mt = canonical_metric(metric)
@@ -48,17 +53,28 @@ def refine(
     valid = cand >= 0
     rows = jnp.where(valid, cand, 0)
     vecs = x[rows]                                   # (m, c, d)
-    ip = jnp.einsum("mcd,md->mc", vecs, q, precision="highest")
+    bf16 = vecs.dtype == jnp.bfloat16
+    if bf16:
+        ip = jnp.einsum("mcd,md->mc", vecs, q.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32)
+    else:
+        ip = jnp.einsum("mcd,md->mc", vecs, q, precision="highest")
+
+    def row_norms2():
+        if bf16:
+            return jnp.einsum("mcd,mcd->mc", vecs, vecs,
+                              preferred_element_type=jnp.float32)
+        return jnp.sum(vecs * vecs, axis=2)
+
     if mt is DistanceType.InnerProduct:
         dist = -ip
     elif mt is DistanceType.CosineExpanded:
         qn = jnp.sqrt(jnp.maximum(jnp.sum(q * q, axis=1, keepdims=True), 1e-30))
-        vn = jnp.sqrt(jnp.maximum(jnp.sum(vecs * vecs, axis=2), 1e-30))
+        vn = jnp.sqrt(jnp.maximum(row_norms2(), 1e-30))
         dist = 1.0 - ip / (qn * vn)
     else:
         q2 = jnp.sum(q * q, axis=1, keepdims=True)
-        v2 = jnp.sum(vecs * vecs, axis=2)
-        dist = jnp.maximum(q2 + v2 - 2.0 * ip, 0.0)
+        dist = jnp.maximum(q2 + row_norms2() - 2.0 * ip, 0.0)
         if mt is DistanceType.L2SqrtExpanded:
             dist = jnp.sqrt(dist)
 

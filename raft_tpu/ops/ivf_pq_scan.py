@@ -14,7 +14,9 @@ GEMM — FLOP-rich but exactly the dense shape the MXU wants, while the
 dataset stays PQ-compressed in HBM (the point of PQ: DEEP-1B-class
 corpora that raw f32 cannot hold). Row norms ||c + dec||² precompute at
 build like brute-force norms. The one-hot/LUT GEMM runs in bf16 when the
-caller asks for the reference's fp16-LUT mode (lut_dtype), f32 otherwise.
+caller asks for the reference's fp16-LUT mode (lut_dtype), f32 when exact,
+or int8 (the fp8-LUT role: per-subspace symmetric codebook quantization,
+double-rate MXU int8 decode with exact int32 accumulation).
 """
 from __future__ import annotations
 
@@ -100,7 +102,7 @@ def decoded_row_norms(codes, centers_rot, codebooks, list_offsets
 
 
 def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, pen_ref, cent_ref,
-            cb_ref, codes_ref, ov_ref, oi_ref, codes_vmem, sem,
+            cb_ref, scl_ref, codes_ref, ov_ref, oi_ref, codes_vmem, sem,
             *, k: int, kp: int, lmax: int, pq_dim: int, book: int,
             metric: str, precision: str, has_pen: bool):
     g = pl.program_id(0)
@@ -114,7 +116,8 @@ def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, pen_ref, cent_ref,
     copy.start()
     q = qb_ref[0]                                    # (QG, rot_pad)
     pqb = pq_dim * book
-    lut_t = cb_ref.dtype                             # bf16 = fp16-LUT mode
+    lut_t = cb_ref.dtype        # bf16 = fp16-LUT mode; int8 = fp8-LUT role
+    int8_mode = lut_t == jnp.int8
     qc = jax.lax.dot_general(
         q, cent_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -127,7 +130,14 @@ def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, pen_ref, cent_ref,
     # large pq_dim. One-hot chunks are sized to ~4 MB; at very large lmax
     # this unrolls more GEMM pairs (compile-time cost), the accepted
     # tradeoff for a bounded VMEM footprint.
-    itemsize = 2 if lut_t == jnp.bfloat16 else 4
+    #
+    # int8 mode (role of the reference's fp8 smem LUT,
+    # ivf_pq_types.hpp:110-146): CB arrives pre-quantized with
+    # per-subspace symmetric scales; the one-hot is int8 too, so the
+    # decode GEMM runs on the MXU's double-rate int8 path and accumulates
+    # exactly in int32. The per-ROW scale vector (subspaces are disjoint
+    # row/column blocks of CB) rescales the decoded chunk before scoring.
+    itemsize = lut_t.itemsize
     chunk = max(128, min(lmax, ((4 << 20) // (pqb * itemsize)) // 128 * 128))
     scale = -2.0 if metric == "l2" else -1.0
     terms = []
@@ -138,9 +148,15 @@ def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, pen_ref, cent_ref,
         codes_rep = pltpu.repeat(codes_c, book, axis=1)  # (cw, pqb)
         j = jax.lax.broadcasted_iota(jnp.int32, (cw, pqb), 1)
         oh = (codes_rep == j // pq_dim).astype(lut_t)
-        decoded = jax.lax.dot_general(
-            oh, cb_ref[:], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)      # (cw, rot_pad)
+        if int8_mode:
+            dec_i = jax.lax.dot_general(
+                oh, cb_ref[:], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)    # (cw, rot_pad)
+            decoded = dec_i.astype(jnp.float32) * scl_ref[:]
+        else:
+            decoded = jax.lax.dot_general(
+                oh, cb_ref[:], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (cw, rot_pad)
         terms.append(scale * jax.lax.dot_general(
             q, decoded, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -186,10 +202,10 @@ def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, pen_ref, cent_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("k", "lmax", "n_groups", "pq_dim", "book", "metric",
-                     "lut_bf16", "interpret", "precision", "has_pen"))
+                     "interpret", "precision", "has_pen"))
 def _scan_groups(qblocks, qnorms, dn_slices, pen_slices, gcenters, cb_matrix,
-                 codes, goffs, gsizes, k, lmax, n_groups, pq_dim, book,
-                 metric, lut_bf16, interpret, precision, has_pen):
+                 scale_row, codes, goffs, gsizes, k, lmax, n_groups, pq_dim,
+                 book, metric, interpret, precision, has_pen):
     kp = round_up_to(k, 128)
     rot_pad = qblocks.shape[2]
     kern = functools.partial(_kernel, k=k, kp=kp, lmax=lmax, pq_dim=pq_dim,
@@ -211,6 +227,7 @@ def _scan_groups(qblocks, qnorms, dn_slices, pen_slices, gcenters, cb_matrix,
             pl.BlockSpec((1, 1, rot_pad), lambda g, o, s: (g, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),     # CB matrix (whole)
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # int8 row scales
             pl.BlockSpec(memory_space=pl.ANY),      # codes stay in HBM
         ],
         out_specs=[
@@ -233,7 +250,7 @@ def _scan_groups(qblocks, qnorms, dn_slices, pen_slices, gcenters, cb_matrix,
         ],
         interpret=interpret,
     )(goffs, gsizes, qblocks, qnorms, dn_slices, pen_slices, gcenters,
-      cb_matrix, codes)
+      cb_matrix, scale_row, codes)
 
 
 def ivf_pq_scan(
@@ -250,7 +267,7 @@ def ivf_pq_scan(
     pq_dim: int,
     book: int,
     metric: str = "l2",
-    lut_bf16: bool = True,
+    lut_mode: str = "bf16",     # "f32" | "bf16" | "int8"
     interpret: Optional[bool] = None,
     precision: str = "highest",
     penalty: Optional[jax.Array] = None,   # (n,) f32: +inf excludes a row
@@ -266,7 +283,7 @@ def ivf_pq_scan(
                         (0, scan_window(lmax)))
     return _ivf_pq_scan_jit(codes_p, norms_p, pen_p, centers_rot, cb_matrix,
                             probed, offsets, sizes, q_rot, k, lmax, pq_dim,
-                            book, metric, lut_bf16, interpret, precision)
+                            book, metric, lut_mode, interpret, precision)
 
 
 @functools.partial(jax.jit, static_argnames=("lmax", "pq_dim"))
@@ -283,17 +300,35 @@ def pad_codes_for_scan(codes, row_norms2, lmax: int, pq_dim: int):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "lmax", "pq_dim", "book", "metric", "lut_bf16",
+    static_argnames=("k", "lmax", "pq_dim", "book", "metric", "lut_mode",
                      "interpret", "precision"))
 def _ivf_pq_scan_jit(codes_p, norms_p, pen_p, centers_rot, cb_matrix, probed,
                      offsets, sizes, q_rot, k, lmax, pq_dim, book, metric,
-                     lut_bf16, interpret, precision):
+                     lut_mode, interpret, precision):
     m, p = probed.shape
     n_lists = offsets.shape[0]
     rot_dim = q_rot.shape[1]
     rot_pad = cb_matrix.shape[0]
     lmax_pad = scan_window(lmax)
-    if lut_bf16:
+    scale_row = jnp.ones((1, rot_pad), jnp.float32)
+    if lut_mode == "int8":
+        # fp8-LUT role (ivf_pq_types.hpp:110-146): per-subspace symmetric
+        # quantization of the block-diagonal CB. Column b*pq_dim+s and row
+        # s*pq_len+l both belong to subspace s and CB is block-diagonal in
+        # s, so a per-COLUMN-subspace quantize + per-ROW-subspace rescale
+        # round-trips exactly (up to the int8 rounding itself).
+        pq_len = rot_dim // pq_dim
+        absmax = jnp.max(jnp.abs(cb_matrix).reshape(rot_pad, book, pq_dim),
+                         axis=(0, 1))                    # (pq_dim,)
+        scales = jnp.maximum(absmax, 1e-12) / 127.0
+        cb_matrix = jnp.clip(
+            jnp.round(cb_matrix.reshape(rot_pad, book, pq_dim)
+                      / scales[None, None, :]), -127, 127
+        ).astype(jnp.int8).reshape(rot_pad, pq_dim * book)
+        scale_row = jnp.pad(jnp.repeat(scales, pq_len),
+                            (0, rot_pad - rot_dim),
+                            constant_values=1.0)[None, :]
+    elif lut_mode == "bf16":
         # fp16-LUT mode: cast here so the kernel's operand dtypes match
         cb_matrix = cb_matrix.astype(jnp.bfloat16)
     q = jnp.pad(jnp.asarray(q_rot, jnp.float32),
@@ -317,8 +352,8 @@ def _ivf_pq_scan_jit(codes_p, norms_p, pen_p, centers_rot, cb_matrix, probed,
         pen = jax.vmap(lambda o: jax.lax.dynamic_slice(
             pen_p, (o,), (lmax_pad,)))(goffs_al)[:, None, :]
 
-    gv, gi = _scan_groups(qblocks, qn, dn, pen, gcenters, cb_matrix, codes_p,
-                          goffs, gsizes, k, lmax_pad, int(n_groups),
-                          pq_dim, book, metric, lut_bf16, interpret,
+    gv, gi = _scan_groups(qblocks, qn, dn, pen, gcenters, cb_matrix,
+                          scale_row, codes_p, goffs, gsizes, k, lmax_pad,
+                          int(n_groups), pq_dim, book, metric, interpret,
                           precision, pen_p is not None)
     return merge_pairs(gv, gi, flat, order, m, p, k)

@@ -116,6 +116,17 @@ class TestCagra:
         assert graph.shape == (2000, 16)
         assert (graph != np.arange(2000)[:, None]).all()
 
+    def test_rev_group_host_matches_jit(self):
+        """The host fallback (scale guard for the monolithic device sort)
+        must reproduce _rev_group_jit bit-for-bit."""
+        rng = np.random.default_rng(7)
+        n, keep_fwd, cap = 500, 8, 16
+        pruned = rng.integers(-1, n, size=(n, 16)).astype(np.int32)
+        want = np.asarray(cagra._rev_group_jit(
+            jnp.asarray(pruned), keep_fwd, cap))
+        got = cagra._rev_group_host(pruned, keep_fwd, cap)
+        np.testing.assert_array_equal(got, want)
+
     def test_knn_graph_brute_exact(self, dataset, knn_oracle):
         """The brute path must produce the exact kNN graph."""
         sub = dataset[:2000]

@@ -58,6 +58,36 @@ def test_allgatherv_and_gatherv(mesh):
     assert float(np.asarray(jax.jit(shmap)())) == 8.0
 
 
+def test_allgatherv_counts_masked_reduction(mesh):
+    """The padded-dense contract's load-bearing half: padding slots hold
+    garbage (NaN here), and a counts-masked reduction over the gathered
+    axis must still produce the exact ragged answer (the raft-dask
+    comms_utils.pyx:42-78 allgatherv consumer pattern)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    comms = AxisComms(axis, size=8)
+    counts = [3, 1, 2, 3, 1, 2, 3, 1]
+    # true ragged sum: each rank contributes counts[r] rows of value r+1
+    want = sum((r + 1) * c for r, c in enumerate(counts))
+
+    def body():
+        rank = comms.get_rank()
+        row = jnp.where(jnp.arange(3) < jnp.asarray(counts)[rank],
+                        (rank + 1).astype(jnp.float32), jnp.nan)
+        g, c = comms.allgatherv(row, counts)
+        # unmasked reduction would be NaN — the mask is what the
+        # contract requires of callers
+        mask = jnp.arange(3)[None, :] < c[:, None]
+        return jnp.sum(jnp.where(mask, g, 0.0))
+
+    shmap = jax.shard_map(body, mesh=mesh, in_specs=(), out_specs=P(),
+                          check_vma=False)
+    got = float(np.asarray(jax.jit(shmap)()))
+    assert got == float(want), (got, want)
+
+
 def test_multicast_sendrecv(mesh):
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P

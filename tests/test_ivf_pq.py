@@ -103,6 +103,31 @@ class TestIvfPq:
         _, want = naive_knn(dataset, queries, 10)
         assert calc_recall(np.asarray(idx), want) >= 0.4
 
+    def test_int8_lut_mode(self, dataset, queries):
+        """fp8-LUT role (ivf_pq_types.hpp:110-146): the int8-quantized
+        codebook scan must track the bf16 scan's recall closely."""
+        index = ivf_pq.build(dataset, ivf_pq.IndexParams(
+            n_lists=32, pq_dim=16, seed=0))
+        _, want = naive_knn(dataset, queries, 10)
+        _, idx_bf = ivf_pq.search(index, queries, k=10, algo="pallas",
+                                  params=ivf_pq.SearchParams(16))
+        _, idx_i8 = ivf_pq.search(
+            index, queries, k=10, algo="pallas",
+            params=ivf_pq.SearchParams(16, lut_dtype="int8"))
+        r_bf = calc_recall(np.asarray(idx_bf), want)
+        r_i8 = calc_recall(np.asarray(idx_i8), want)
+        assert r_i8 >= r_bf - 0.03, (r_i8, r_bf)
+
+    def test_int8_lut_pq_bits_4(self, dataset, queries):
+        """int8 LUT composes with the 16-entry (pq_bits=4) codebooks."""
+        index = ivf_pq.build(dataset, ivf_pq.IndexParams(
+            n_lists=32, pq_dim=32, pq_bits=4, seed=0))
+        _, idx = ivf_pq.search(
+            index, queries, k=10, algo="pallas",
+            params=ivf_pq.SearchParams(32, lut_dtype="int8"))
+        _, want = naive_knn(dataset, queries, 10)
+        assert calc_recall(np.asarray(idx), want) >= 0.5
+
     def test_non_divisible_dim_pads(self, queries):
         rng = np.random.default_rng(3)
         data = rng.standard_normal((5000, 30)).astype(np.float32)
@@ -238,6 +263,17 @@ class TestRefine:
         dist, idx = refine.refine(dataset, queries, cand, k=18)
         assert (np.asarray(idx)[:, -1] == -1).all()
         assert np.isinf(np.asarray(dist)[:, -1]).all()
+
+    def test_refine_bf16_dataset(self, dataset, queries):
+        """A bf16 corpus copy (half the gather traffic) must re-rank to
+        near-identical top-k."""
+        import jax.numpy as jnp
+
+        _, cand = naive_knn(dataset, queries, 30)
+        _, idx = refine.refine(jnp.asarray(dataset, jnp.bfloat16),
+                               queries, cand, k=10)
+        _, want_i = naive_knn(dataset, queries, 10)
+        assert calc_recall(np.asarray(idx), want_i) >= 0.98
 
     def test_refine_inner_product(self, dataset, queries):
         _, cand = naive_knn(dataset, queries, 30, "inner_product")

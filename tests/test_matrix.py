@@ -119,8 +119,8 @@ class TestSelectKAutoDispatch:
         monkeypatch.setattr(autotune, "_MEM_CACHE", {})
         monkeypatch.setattr(autotune, "_DISK_LOADED", False)
         winner, timings = tune_select_k(rows=32, n=4096, k=8, reps=2)
-        assert winner in ("topk", "radix")
-        assert set(timings) == {"topk", "radix"}
+        assert winner == "topk"      # single engine on TPU (see select_k.py)
+        assert set(timings) == {"topk"}
         key = autotune.shape_bucket("select_k", n=4096, k=8)
         assert autotune.lookup(key) == winner
 
