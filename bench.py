@@ -8,20 +8,26 @@ real recall frontier), plus brute-force QPS and an on-device roofline
 probe so kernel throughput is reported against the measured peak of the
 chip actually in use.
 
-Two timings per entry (see raft_tpu/ops/autotune.py):
+Two timings per entry:
 
 * ``latency_ms`` — per-call-blocked median: every call pays the full
-  dispatch round trip (~90 ms through the axon tunnel).
-* ``qps`` — pipelined throughput: ``measure_throughput`` keeps several
-  value-distinct, data-chained calls in flight and blocks once, so
-  dispatch overlaps device compute. This matches the reference harness's
-  ``items_per_second`` (Google Benchmark runs iterations back-to-back
-  with one wall clock: cpp/bench/ann/src/common/benchmark.hpp:337 and
-  docs/source/raft_ann_benchmarks.md:429); per-call blocking would bill
-  every iteration the tunnel RTT that no serving system pays.
+  dispatch round trip (~90 ms through the axon tunnel). Reported for
+  context; dropped (-1) when the backend window lies about it.
+* ``qps`` — the VALUE-READ PIPELINED WALL (``measure_wall``): N calls on
+  content-distinct query permutations dispatched back-to-back (dispatch
+  overlaps compute — the reference harness's ``items_per_second``
+  semantics, cpp/bench/ann/src/common/benchmark.hpp:337), every output
+  folded into a scalar accumulator, and the window closed by a host-side
+  ``float()`` of that accumulator. The value read is load-bearing: this
+  backend's lying modes extend to ``block_until_ready`` itself (observed
+  returning in 0.8 ms for a 2.56 TFLOP batch on content-distinct
+  inputs), and a host value transitively dependent on every output
+  cannot materialize before the compute ran.
 
-Both modes defend against elision/replay with per-call input
-perturbation + real data dependencies and a physical plausibility floor.
+Every timing is additionally gated by a per-lane PHYSICAL floor —
+FLOPs/(datasheet peak) for GEMM lanes, grouped-scan bytes/(HBM peak) for
+list scans — because lying windows have produced numbers just above any
+generic floor. Measurements below the floor are discarded, not recorded.
 All data is generated ON DEVICE (host<->device transfers through remote
 tunnels are slow and would pollute build/search timings); recall is
 computed on device against exact ground truth and only scalars leave the
@@ -155,27 +161,6 @@ def median_time(fn, *args, reps=5, tries=3, floor=0.0):
             return None
         except Exception as e:  # noqa: BLE001 - transport/compile flakes
             log(f"# measurement attempt {t + 1}/{tries} failed: "
-                f"{type(e).__name__}: {e}")
-            if t + 1 < tries:
-                time.sleep(15 * (t + 1))
-    return None
-
-
-def throughput_time(fn, *args, depth=10, reps=3, tries=3, floor=0.0):
-    """Pipelined steady-state seconds/call (the QPS number; see module
-    docstring). Same failure policy as median_time."""
-    from raft_tpu.ops.autotune import (TimingUnreliableError,
-                                       measure_throughput)
-
-    for t in range(tries):
-        try:
-            return measure_throughput(fn, *args, depth=depth, reps=reps,
-                                      suspect_floor_s=floor)
-        except TimingUnreliableError as e:
-            log(f"# throughput unreliable (no retry): {e}")
-            return None
-        except Exception as e:  # noqa: BLE001
-            log(f"# throughput attempt {t + 1}/{tries} failed: "
                 f"{type(e).__name__}: {e}")
             if t + 1 < tries:
                 time.sleep(15 * (t + 1))
@@ -455,10 +440,81 @@ def main():
             f"{e['latency_ms']}ms) recall={recall:.4f}")
         return e
 
-    def measure_tp(tp, *args, reps=5):
-        """(throughput s/call, latency s/call) for a TwoPart or jit fn."""
-        lat = median_time(tp, *args, reps=reps, floor=suspect_floor)
-        thr = throughput_time(tp, *args, floor=suspect_floor)
+    # physically-derived per-lane plausibility floors (seconds/call): the
+    # generic ~2 ms floor misses lies that land just above it (observed:
+    # a "2.49 ms" 500k brute-force batch = 514 TFLOP/s, then a "4.0 ms"
+    # 1M batch = 640 TFLOP/s after a 2x-peak floor — the lying window
+    # scales its answers). Floors are therefore the DATASHEET peaks
+    # themselves (v5e: 197 TFLOP/s bf16, 819 GB/s HBM): no real call can
+    # beat them, and real calls run several-fold above (measured roofline
+    # ~86 TFLOP/s / ~72 GB/s), so the floors stay far from honest
+    # timings.
+    def floor_brute():
+        return max(suspect_floor, 2.0 * nq * n * d / 197e12)
+
+    def floor_ivf(probes, row_bytes):
+        # the query-grouped scan DMAs each probed list ONCE per 128-query
+        # group (ops/ivf_scan.py pack_pairs), so kernel traffic scales
+        # with (pairs/128) list windows — NOT per-query row counts; a
+        # per-query model here once rejected an honest 92 ms measurement
+        # with a 122 ms "floor"
+        groups = nq * probes / 128.0
+        window_rows = 1.5 * (part_n / 1024)   # imbalance slack
+        scanned = groups * window_rows * row_bytes * n_parts
+        return max(suspect_floor, scanned / 819e9)
+
+    def measure_wall(tp, *args, floor=0.0, what="", calls: int = 10):
+        """THE throughput measurement: pipelined, content-distinct,
+        value-read wall.
+
+        ``calls`` query sets with genuinely different CONTENT
+        (device-side permutations) are dispatched back-to-back (no
+        per-call blocking — dispatch overlaps compute, GBench
+        items_per_second semantics), every call's output feeds a scalar
+        accumulator, and the window closes with a host-side ``float()``
+        of that accumulator. The value read is the load-bearing part:
+        this backend's lying modes extend to READINESS itself
+        (block_until_ready returned in 0.8 ms for a 2.56 TFLOP batch
+        even on content-distinct inputs), and a host value transitively
+        dependent on every output cannot materialize before the compute
+        actually ran. The single read's round trip amortizes over
+        ``calls``. Results below the lane's physical floor are
+        discarded — no honest number exists in that window."""
+        try:
+            # calls+1 permutations: the warm-up runs on a THROWAWAY set so
+            # no timed call repeats content the backend has already served
+            perms = [jnp.take(queries,
+                              jax.random.permutation(
+                                  jax.random.PRNGKey(100 + i), nq), axis=0)
+                     for i in range(calls + 1)]
+            jax.block_until_ready(perms)
+            d0 = tp(perms.pop(), *args[1:])[0]      # warm/compile
+            float(jnp.sum(jnp.where(jnp.isfinite(d0[:, 0]), d0[:, 0], 0.0)))
+            t0 = time.perf_counter()
+            acc = None
+            for p in perms:
+                d = tp(p, *args[1:])[0]
+                s = jnp.sum(jnp.where(jnp.isfinite(d[:, 0]), d[:, 0], 0.0))
+                acc = s if acc is None else acc + s
+            _ = float(acc)                          # forced value read
+            dt = (time.perf_counter() - t0) / calls
+        except Exception as e:  # noqa: BLE001
+            log(f"# {what} wall measurement failed: "
+                f"{type(e).__name__}: {e}")
+            return None
+        if dt < floor:
+            log(f"# {what} wall {dt*1e3:.1f}ms below the physical floor "
+                f"{floor*1e3:.1f}ms; lane unmeasurable in this window")
+            return None
+        return dt
+
+    def measure_tp(tp, *args, reps=5, floor=None, what=""):
+        """(throughput s/call, latency s/call). Throughput is the
+        value-read pipelined wall; latency is the per-call-blocked
+        median (reported for context, dropped when the window lies)."""
+        floor = suspect_floor if floor is None else floor
+        lat = median_time(tp, *args, reps=reps, floor=floor)
+        thr = measure_wall(tp, *args, floor=floor, what=what)
         return thr, lat
 
     # --- brute force (BASELINE config 1): measured-best engine ----------
@@ -470,7 +526,8 @@ def main():
         sfn = jax.jit(lambda q, idx: brute_force.search(idx, q, k,
                                                         algo=winner))
         tp = TwoPart(sfn, bfs, offsets, k)
-        thr, lat = measure_tp(tp, queries)
+        thr, lat = measure_tp(tp, queries, floor=floor_brute(),
+                              what="brute f32")
         if thr is not None:
             add_entry("raft_brute_force", f"raft_brute_force.{winner}",
                       thr, lat, 1.0, 0.0,
@@ -485,7 +542,8 @@ def main():
             hfn = jax.jit(lambda q, idx: brute_force.search(
                 idx, q, k, algo="matmul"))
             tph = TwoPart(hfn, bf16s, offsets, k)
-            thr, lat = measure_tp(tph, queries)
+            thr, lat = measure_tp(tph, queries, floor=floor_brute(),
+                                  what="brute bf16")
             if thr is not None:
                 rec = robust_call(
                     lambda: device_recall(tph(queries)[1], gt),
@@ -512,7 +570,9 @@ def main():
             sp = ivf_flat.SearchParams(n_probes=probes)
             fn = jax.jit(lambda q, idx, s=sp: ivf_flat.search(idx, q, k, s))
             tp = TwoPart(fn, fis, offsets, k)
-            thr, lat = measure_tp(tp, queries)
+            thr, lat = measure_tp(tp, queries,
+                                  floor=floor_ivf(probes, d * 4),
+                                  what=f"ivf_flat np{probes}")
             if thr is None:
                 return None
             rec = robust_call(lambda: device_recall(tp(queries)[1], gt),
@@ -556,7 +616,9 @@ def main():
             fnh = jax.jit(lambda q, idx: ivf_flat.search(
                 idx, q, k, ivf_flat.SearchParams(n_probes=best_probes)))
             tph = TwoPart(fnh, fihs, offsets, k)
-            thr, lat = measure_tp(tph, queries)
+            thr, lat = measure_tp(tph, queries,
+                                  floor=floor_ivf(best_probes, d * 2),
+                                  what="ivf_flat bf16")
             if thr is not None:
                 rec = robust_call(
                     lambda: device_recall(tph(queries)[1], gt),
@@ -602,7 +664,10 @@ def main():
 
         def measure_pq(probes, ratio):
             tp = pq_refined_tp(probes, ratio)
-            thr, lat = measure_tp(tp, queries)
+            thr, lat = measure_tp(tp, queries,
+                                  floor=floor_ivf(probes,
+                                                  min(d, 128) // 2 + 4),
+                                  what=f"ivf_pq np{probes} r{ratio}")
             if thr is None:
                 return None
             rec = robust_call(
@@ -623,7 +688,14 @@ def main():
                 if rec_a < 0.995:
                     measure_pq(20, 4)
             else:
-                for probes, ratio in ((20, 4), (50, 4)):
+                # diagnose WHICH axis binds: if doubling refine doesn't
+                # move recall, it is probe-limited (low-intrinsic-dim
+                # corpora) and the probe walk should keep the cheap r2
+                r4 = measure_pq(20, 4)
+                quant_limited = (r4 is not None and rec_a is not None
+                                 and r4 > rec_a + 0.01)
+                ratio = 4 if quant_limited else 2
+                for probes in (50, 100):
                     r = measure_pq(probes, ratio)
                     if r is not None and r >= 0.95:
                         break
@@ -678,8 +750,8 @@ def main():
             sp = cagra.SearchParams(itopk_size=itopk, search_width=width,
                                     max_iterations=mi)
             fn = jax.jit(lambda q, idx, s=sp: cagra.search(idx, q, k, s))
-            lat = median_time(fn, queries, ci, reps=3, floor=suspect_floor)
-            thr = throughput_time(fn, queries, ci, floor=suspect_floor)
+            thr, lat = measure_tp(fn, queries, ci, reps=3,
+                                  what=f"cagra itopk{itopk}")
             if thr is None:
                 continue
             rec = robust_call(lambda: device_recall(fn(queries, ci)[1], cgt),
@@ -743,8 +815,11 @@ def main():
                    "intrinsic_d": CORPUS_INTRINSIC_D,
                    "clusters": CORPUS_CLUSTERS,
                    "queries": "fresh-mixture-samples"},
-        "qps_methodology": "pipelined throughput (GBench items_per_second "
-                           "analog); latency_ms = per-call-blocked median",
+        "qps_methodology": "value-read pipelined wall over content-"
+                           "distinct query permutations (GBench "
+                           "items_per_second analog; host float() of an "
+                           "all-outputs accumulator closes the window); "
+                           "latency_ms = per-call-blocked median",
         "entries": entries,
         "dataset_io": dataset_io,
         "roofline": peaks,
@@ -757,7 +832,10 @@ def main():
             "status": "validated-functionally",
             "evidence": "8-device CPU-mesh tests (tests/test_sharded_ann"
                         ".py) + driver dryrun_multichip (brute force, "
-                        "ivf_pq AND cagra recall-checked vs exact)"},
+                        "ivf_pq AND cagra recall-checked vs exact) + "
+                        "2-process jax.distributed DCN smoke "
+                        "(RAFT_TPU_DIST_TEST=1 tests/test_distributed.py"
+                        ", passed 2026-07-31)"},
     }
     print(json.dumps(out))
 

@@ -61,8 +61,11 @@ def hbm_stream_gbps(mbytes: int = 1024, reps: int = 5,
     iteration-dependent scale (not constant-foldable across the loop), so
     traffic per iteration is 2 × buffer bytes.
     """
+    # (rows, 1024) rather than flat (n,): 1-D buffers lane-tile poorly
+    # and understate streaming bandwidth
     n = (mbytes << 20) // 4
-    x = jax.random.normal(jax.random.PRNGKey(2), (n,), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(2), (n // 1024, 1024),
+                          jnp.float32)
 
     @jax.jit
     def f(x):
@@ -75,7 +78,7 @@ def hbm_stream_gbps(mbytes: int = 1024, reps: int = 5,
         return jax.lax.fori_loop(0, iters, body, x)
 
     dt = _median_time(f, x, reps=reps)
-    return 2.0 * 4.0 * n * iters / dt / 1e9
+    return 2.0 * 4.0 * (n // 1024) * 1024 * iters / dt / 1e9
 
 
 def dispatch_us(reps: int = 11) -> float:

@@ -1,13 +1,14 @@
 """On-device select_k cost sweep across (rows, n, k).
 
-Produces the recorded measurement behind ``select_k``'s dispatch notes
-(the measured analog of the reference's per-arch
+Produces the recorded measurement behind ``select_k``'s single-engine
+design note (the measured analog of the reference's per-arch
 ``choose_select_k_algorithm`` table, matrix/detail/select_k-inl.cuh:48-72):
-every point runs ``tune_select_k`` — per-call-blocked medians — and lands
-in the ops.autotune cache. The historical sweep (bench_select_k_sweep.json
-at the repo root) measured a masked-input "radix" pre-filter tying plain
-top_k within dispatch noise at every point, which is why select_k now
-ships a single sort-based engine (see matrix/select_k.py).
+every point runs ``tune_select_k`` — per-call-blocked medians — purely as
+a calibration record (nothing dispatches on it; every algo name maps to
+the same engine). The historical sweep (bench_select_k_sweep.json at the
+repo root) measured a masked-input "radix" pre-filter tying plain top_k
+within dispatch noise at every point, which is why select_k ships a
+single sort-based engine (see matrix/select_k.py).
 
 Run: ``python -m raft_tpu.bench.select_k_sweep [out.json]`` on the target
 device.

@@ -12,7 +12,7 @@ from .kvp import KeyValuePair
 from .resources import DeviceResources, Resources, device_resources_manager
 from .interop import (as_device_array, auto_convert_output, convert_output,
                       output_as, set_output_as)
-from . import logging, operators, serialize, tracing
+from . import logging, operators, raft_format, serialize, tracing
 
 __all__ = [
     "Bitset",
@@ -32,6 +32,7 @@ __all__ = [
     "set_output_as",
     "logging",
     "operators",
+    "raft_format",
     "serialize",
     "tracing",
 ]

@@ -1,0 +1,437 @@
+"""RAFT-native index file interop: load (and write) pylibraft-serialized
+IVF-Flat / IVF-PQ / CAGRA index files.
+
+The reference serializes indexes as a STREAM OF NUMPY FRAMES — each
+scalar and mdspan is one complete ``.npy`` blob (magic + header + raw
+bytes): core/detail/mdspan_numpy_serializer.hpp (``serialize_scalar``
+writes a 0-d array, ``serialize_mdspan`` an n-d one). Python's
+``np.lib.format.read_array`` consumes exactly one frame, so a file is a
+sequence of ``read_array`` calls mirroring the C++ field order:
+
+* IVF-PQ  — detail/ivf_pq_serialize.cuh:60-87 (version 3): version,
+  size, dim, pq_bits, pq_dim, conservative_memory_allocation, metric,
+  codebook_kind, n_lists; pq_centers, centers [n_lists, dim_ext],
+  centers_rot, rotation_matrix; list_sizes u32; then per list: size
+  scalar + interleaved code array + indices.
+* IVF-Flat — detail/ivf_flat_serialize.cuh:59-92 (version 4): version,
+  size, dim, n_lists, metric, adaptive_centers, conservative, centers,
+  has_norms(+norms), list_sizes; per-list interleaved rows + indices.
+* CAGRA — detail/cagra/cagra_serialize.cuh:61-82 (version 4): version,
+  size, dim, graph_degree, metric, graph [n, degree], include_dataset
+  (+dataset).
+
+List payloads use the reference's interleaved group layout
+(ivf_pq_types.hpp:166-214 / ivf_flat_types.hpp:114-166): rows grouped by
+``kIndexGroupSize``=32, components chunked by a 16-byte vector
+(``kIndexGroupVecLen``; PQ codes are a little-endian bitfield inside
+each 16-byte chunk — detail/ivf_pq_codepacking.cuh bitfield_view_t).
+The decoders below invert that layout with vectorized numpy; the
+writers produce files the reference can load, tested by round-trip.
+"""
+from __future__ import annotations
+
+import io
+from typing import BinaryIO, Optional, Tuple
+
+import numpy as np
+
+from .errors import expects
+from ..distance.distance_types import DistanceType
+
+__all__ = [
+    "load_raft_ivf_pq", "save_raft_ivf_pq",
+    "load_raft_ivf_flat", "save_raft_ivf_flat",
+    "load_raft_cagra", "save_raft_cagra",
+]
+
+_GROUP = 32          # kIndexGroupSize
+_VEC = 16            # kIndexGroupVecLen (bytes)
+
+# reference enum values (distance/distance_types.hpp:26-66), stored as
+# u2 scalars in the files
+_METRIC_BY_INT = {
+    0: DistanceType.L2Expanded,
+    1: DistanceType.L2SqrtExpanded,
+    2: DistanceType.CosineExpanded,
+    3: DistanceType.L1,
+    4: DistanceType.L2Unexpanded,
+    5: DistanceType.L2SqrtUnexpanded,
+    6: DistanceType.InnerProduct,
+    7: DistanceType.Linf,
+    8: DistanceType.Canberra,
+    9: DistanceType.LpUnexpanded,
+    10: DistanceType.CorrelationExpanded,
+    11: DistanceType.JaccardExpanded,
+    12: DistanceType.HellingerExpanded,
+    13: DistanceType.Haversine,
+    14: DistanceType.BrayCurtis,
+    15: DistanceType.JensenShannon,
+    16: DistanceType.HammingUnexpanded,
+    17: DistanceType.KLDivergence,
+    18: DistanceType.RusselRaoExpanded,
+    19: DistanceType.DiceExpanded,
+    100: DistanceType.Precomputed,
+}
+_INT_BY_METRIC = {m: i for i, m in _METRIC_BY_INT.items()}
+
+
+def _read(f: BinaryIO):
+    """One npy frame (scalar frames come back as python scalars)."""
+    arr = np.lib.format.read_array(f, allow_pickle=False)
+    if arr.ndim == 0:
+        return arr[()]
+    return arr
+
+
+def _write(f: BinaryIO, value, dtype=None) -> None:
+    """One npy frame, mirroring serialize_scalar/serialize_mdspan."""
+    arr = np.asarray(value, dtype=dtype)
+    np.lib.format.write_array(f, arr, allow_pickle=False)
+
+
+def _open(path_or_file, mode: str):
+    if hasattr(path_or_file, "read") or hasattr(path_or_file, "write"):
+        return path_or_file, False
+    return open(path_or_file, mode), True
+
+
+# --------------------------------------------------------------------------
+# interleaved list payload codecs
+# --------------------------------------------------------------------------
+
+def _unpack_interleaved_rows(data: np.ndarray, size: int) -> np.ndarray:
+    """(ngroups, nchunks, 32, veclen) interleaved rows → (size, dim)."""
+    ngroups, nchunks, g, veclen = data.shape
+    rows = data.transpose(0, 2, 1, 3).reshape(ngroups * g, nchunks * veclen)
+    return rows[:size]
+
+
+def _pack_interleaved_rows(rows: np.ndarray, veclen: int) -> np.ndarray:
+    """(size, dim) → (ngroups, dim//veclen, 32, veclen) interleaved."""
+    size, dim = rows.shape
+    expects(dim % veclen == 0, "dim %d not a multiple of veclen %d",
+            dim, veclen)
+    ngroups = -(-size // _GROUP)
+    pad = np.zeros((ngroups * _GROUP, dim), rows.dtype)
+    pad[:size] = rows
+    return np.ascontiguousarray(
+        pad.reshape(ngroups, _GROUP, dim // veclen, veclen)
+        .transpose(0, 2, 1, 3))
+
+
+def _unpack_interleaved_pq(data: np.ndarray, size: int, pq_dim: int,
+                           pq_bits: int) -> np.ndarray:
+    """Interleaved bitfield codes → (size, pq_dim) u8.
+
+    ``data``: (ngroups, nchunks, 32, 16) u8; each 16-byte chunk holds
+    ``(16*8)//pq_bits`` codes as a little-endian bitfield."""
+    ngroups, nchunks, g, v = data.shape
+    pq_chunk = (v * 8) // pq_bits
+    rows = data.transpose(0, 2, 1, 3).reshape(ngroups * g, nchunks, v)
+    rows = rows[:size]
+    bits = np.unpackbits(rows, axis=2, bitorder="little")  # (size, nc, 128)
+    weights = (1 << np.arange(pq_bits, dtype=np.uint16))
+    codes = np.zeros((size, pq_dim), np.uint8)
+    for j in range(pq_dim):
+        c, within = divmod(j, pq_chunk)
+        sl = bits[:, c, within * pq_bits : (within + 1) * pq_bits]
+        codes[:, j] = (sl.astype(np.uint16) * weights).sum(1).astype(np.uint8)
+    return codes
+
+
+def _pack_interleaved_pq(codes: np.ndarray, pq_bits: int) -> np.ndarray:
+    """(size, pq_dim) u8 → interleaved bitfield (inverse of the above)."""
+    size, pq_dim = codes.shape
+    pq_chunk = (_VEC * 8) // pq_bits
+    nchunks = -(-pq_dim // pq_chunk)
+    ngroups = -(-size // _GROUP)
+    bits = np.zeros((ngroups * _GROUP, nchunks, _VEC * 8), np.uint8)
+    for j in range(pq_dim):
+        c, within = divmod(j, pq_chunk)
+        for b in range(pq_bits):
+            bits[:size, c, within * pq_bits + b] = (codes[:, j] >> b) & 1
+    packed = np.packbits(bits, axis=2, bitorder="little")  # (rows, nc, 16)
+    return np.ascontiguousarray(
+        packed.reshape(ngroups, _GROUP, nchunks, _VEC).transpose(0, 2, 1, 3))
+
+
+# --------------------------------------------------------------------------
+# IVF-PQ
+# --------------------------------------------------------------------------
+
+def load_raft_ivf_pq(path_or_file):
+    """pylibraft-serialized ``.ivf_pq`` file → :class:`ivf_pq.Index`."""
+    import jax.numpy as jnp
+
+    from ..neighbors import ivf_pq
+
+    f, close = _open(path_or_file, "rb")
+    try:
+        ver = int(_read(f))
+        expects(ver == 3, "unsupported RAFT ivf_pq serialization version "
+                "%d (expected 3, RAFT 24.02)", ver)
+        n = int(_read(f))
+        dim = int(_read(f))
+        pq_bits = int(_read(f))
+        pq_dim = int(_read(f))
+        _conservative = bool(_read(f))
+        metric = _METRIC_BY_INT[int(_read(f))]
+        kind = ivf_pq.CodebookGen(int(_read(f)))
+        n_lists = int(_read(f))
+
+        pq_centers = _read(f)           # PER_SUBSPACE: (pq_dim, len, book)
+        _centers = _read(f)             # (n_lists, dim_ext) — unused here
+        centers_rot = _read(f)          # (n_lists, rot_dim)
+        rotation = _read(f)             # (rot_dim, dim)
+        list_sizes = np.asarray(_read(f), np.int64)
+
+        codes_parts, ids_parts = [], []
+        for label in range(n_lists):
+            sz = int(_read(f))
+            expects(sz == int(list_sizes[label]),
+                    "list %d size mismatch (%d vs %d)", label, sz,
+                    int(list_sizes[label]))
+            if sz == 0:
+                continue
+            data = _read(f)
+            inds = _read(f)
+            codes_parts.append(_unpack_interleaved_pq(data, sz, pq_dim,
+                                                      pq_bits))
+            ids_parts.append(np.asarray(inds[:sz], np.int64))
+        codes = (np.concatenate(codes_parts) if codes_parts
+                 else np.zeros((0, pq_dim), np.uint8))
+        ids = (np.concatenate(ids_parts) if ids_parts
+               else np.zeros((0,), np.int64))
+        expects(len(codes) == n, "row count mismatch (%d vs %d)",
+                len(codes), n)
+        expects(ids.size == 0 or ids.max() < 2 ** 31,
+                "source ids exceed int32 (raft_tpu stores int32 ids)")
+
+        offsets = np.zeros(n_lists + 1, np.int64)
+        np.cumsum(list_sizes, out=offsets[1:])
+        # reference pq_centers: (pq_dim|n_lists, pq_len, book) — ours is
+        # (pq_dim|n_lists, book, pq_len)
+        codebooks = np.ascontiguousarray(pq_centers.transpose(0, 2, 1))
+        return ivf_pq.Index(
+            jnp.asarray(codes), jnp.asarray(ids, jnp.int32),
+            jnp.asarray(centers_rot), jnp.asarray(codebooks),
+            jnp.asarray(rotation), offsets, metric, pq_bits, kind,
+            list_sizes_arr=list_sizes)
+    finally:
+        if close:
+            f.close()
+
+
+def save_raft_ivf_pq(index, path_or_file) -> None:
+    """:class:`ivf_pq.Index` → a file pylibraft's deserializer accepts
+    (version-3 layout above)."""
+    from ..neighbors.ivf_pq import CodebookGen
+
+    f, close = _open(path_or_file, "wb")
+    try:
+        sizes = index.list_sizes
+        _write(f, np.int32(3))
+        _write(f, np.int64(index.size))
+        _write(f, np.uint32(index.dim))
+        _write(f, np.uint32(index.pq_bits))
+        _write(f, np.uint32(index.pq_dim))
+        _write(f, np.bool_(False))      # conservative_memory_allocation
+        _write(f, np.array(_INT_BY_METRIC[index.metric], np.uint16))
+        _write(f, np.int32(index.codebook_kind.value))
+        _write(f, np.uint32(index.n_lists))
+
+        cb = np.asarray(index.codebooks, np.float32)      # (s|L, book, len)
+        _write(f, np.ascontiguousarray(cb.transpose(0, 2, 1)))
+        centers_rot = np.asarray(index.centers_rot, np.float32)
+        # centers in the original space, extended layout [n_lists,
+        # dim_ext]; raft_tpu keeps everything rotated, so back-project
+        rot = np.asarray(index.rotation, np.float32)
+        centers = centers_rot @ rot
+        # reference dim_ext() = round_up(dim + 1, 8) (ivf_pq_types.hpp:280)
+        dim_ext = -(-(index.dim + 1) // 8) * 8
+        cent_ext = np.zeros((index.n_lists, dim_ext), np.float32)
+        cent_ext[:, : index.dim] = centers
+        cent_ext[:, index.dim] = (centers * centers).sum(1)
+        _write(f, cent_ext)
+        _write(f, centers_rot)
+        _write(f, rot)
+        _write(f, np.asarray(sizes, np.uint32))
+
+        codes = np.asarray(index.codes, np.uint8)
+        ids = np.asarray(index.source_ids, np.int64)
+        offsets = np.asarray(index.list_offsets)
+        for label in range(index.n_lists):
+            sz = int(sizes[label])
+            _write(f, np.uint32(sz))
+            if sz == 0:
+                continue
+            lo = int(offsets[label])
+            _write(f, _pack_interleaved_pq(codes[lo : lo + sz],
+                                           index.pq_bits))
+            _write(f, ids[lo : lo + sz])
+    finally:
+        if close:
+            f.close()
+
+
+# --------------------------------------------------------------------------
+# IVF-Flat
+# --------------------------------------------------------------------------
+
+def load_raft_ivf_flat(path_or_file):
+    """pylibraft-serialized ``.ivf_flat`` file → :class:`ivf_flat.Index`."""
+    import jax.numpy as jnp
+
+    from ..neighbors import ivf_flat
+
+    f, close = _open(path_or_file, "rb")
+    try:
+        ver = int(_read(f))
+        expects(ver == 4, "unsupported RAFT ivf_flat serialization "
+                "version %d (expected 4, RAFT 24.02)", ver)
+        n = int(_read(f))
+        dim = int(_read(f))
+        n_lists = int(_read(f))
+        metric = _METRIC_BY_INT[int(_read(f))]
+        _adaptive = bool(_read(f))
+        _conservative = bool(_read(f))
+        centers = _read(f)
+        has_norms = bool(_read(f))
+        center_norms = _read(f) if has_norms else None
+        list_sizes = np.asarray(_read(f), np.int64)
+
+        rows_parts, ids_parts = [], []
+        for label in range(n_lists):
+            sz = int(_read(f))
+            if sz == 0:
+                continue
+            data = _read(f)
+            inds = _read(f)
+            rows_parts.append(_unpack_interleaved_rows(data, sz))
+            ids_parts.append(np.asarray(inds[:sz], np.int64))
+        rows = (np.concatenate(rows_parts) if rows_parts
+                else np.zeros((0, dim), np.float32))
+        ids = (np.concatenate(ids_parts) if ids_parts
+               else np.zeros((0,), np.int64))
+        expects(len(rows) == n, "row count mismatch (%d vs %d)",
+                len(rows), n)
+        expects(ids.size == 0 or ids.max() < 2 ** 31,
+                "source ids exceed int32 (raft_tpu stores int32 ids)")
+
+        offsets = np.zeros(n_lists + 1, np.int64)
+        np.cumsum(list_sizes, out=offsets[1:])
+        rows_f = np.asarray(rows, np.float32)
+        cn = (np.asarray(center_norms, np.float32) if center_norms is
+              not None else (centers * centers).sum(1).astype(np.float32))
+        return ivf_flat.Index(
+            jnp.asarray(rows), jnp.asarray((rows_f * rows_f).sum(1)),
+            jnp.asarray(ids, jnp.int32), jnp.asarray(centers),
+            jnp.asarray(cn), offsets, metric,
+            list_sizes_arr=list_sizes)
+    finally:
+        if close:
+            f.close()
+
+
+def save_raft_ivf_flat(index, path_or_file) -> None:
+    """:class:`ivf_flat.Index` → a version-4 reference-layout file.
+
+    Only float32 storage round-trips (the reference's T is the original
+    dtype; raft_tpu's bf16/int8 modes have no reference file analog)."""
+    from ..neighbors._list_layout import gather_dense
+
+    f, close = _open(path_or_file, "wb")
+    try:
+        (rows_j, ids_j), _ = gather_dense(
+            (index.data, index.source_ids), index.list_offsets,
+            index.list_sizes)
+        rows = np.asarray(rows_j)
+        ids = np.asarray(ids_j)
+        expects(rows.dtype == np.float32,
+                "only float32 ivf_flat indexes serialize to the RAFT "
+                "format (got %s)", rows.dtype)
+        dim = index.dim
+        # reference calculate_veclen (ivf_flat_types.hpp:385-395): f32
+        # veclen = 16/sizeof(T) = 4, falling straight to 1 when dim is
+        # not a multiple of it
+        veclen = 4 if dim % 4 == 0 else 1
+        sizes = index.list_sizes
+        _write(f, np.int32(4))
+        _write(f, np.int64(index.size))
+        _write(f, np.uint32(dim))
+        _write(f, np.uint32(index.n_lists))
+        _write(f, np.array(_INT_BY_METRIC[index.metric], np.uint16))
+        _write(f, np.bool_(False))      # adaptive_centers
+        _write(f, np.bool_(index.conservative_memory))
+        _write(f, np.asarray(index.centers, np.float32))
+        _write(f, np.bool_(True))
+        _write(f, np.asarray(index.center_norms, np.float32))
+        _write(f, np.asarray(sizes, np.uint32))
+        off = 0
+        for label in range(index.n_lists):
+            sz = int(sizes[label])
+            _write(f, np.uint32(sz))
+            if sz == 0:
+                continue
+            _write(f, _pack_interleaved_rows(rows[off : off + sz], veclen))
+            _write(f, np.asarray(ids[off : off + sz], np.int64))
+            off += sz
+    finally:
+        if close:
+            f.close()
+
+
+# --------------------------------------------------------------------------
+# CAGRA
+# --------------------------------------------------------------------------
+
+def load_raft_cagra(path_or_file, dataset: Optional[np.ndarray] = None):
+    """pylibraft-serialized ``.cagra`` file → :class:`cagra.Index`.
+
+    Files written with ``include_dataset=False`` need ``dataset``."""
+    import jax.numpy as jnp
+
+    from ..neighbors import cagra
+
+    f, close = _open(path_or_file, "rb")
+    try:
+        ver = int(_read(f))
+        expects(ver == 4, "unsupported RAFT cagra serialization version "
+                "%d (expected 4, RAFT 24.02)", ver)
+        n = int(_read(f))
+        dim = int(_read(f))
+        _degree = int(_read(f))
+        metric = _METRIC_BY_INT[int(_read(f))]
+        graph = np.asarray(_read(f), np.int32)
+        include_dataset = bool(_read(f))
+        if include_dataset:
+            dataset = _read(f)
+        expects(dataset is not None,
+                "file has no dataset (include_dataset=false); pass one")
+        expects(dataset.shape == (n, dim), "dataset shape mismatch %s",
+                tuple(dataset.shape))
+        return cagra.Index(jnp.asarray(dataset, jnp.float32),
+                           jnp.asarray(graph), metric, None)
+    finally:
+        if close:
+            f.close()
+
+
+def save_raft_cagra(index, path_or_file, include_dataset: bool = True
+                    ) -> None:
+    """:class:`cagra.Index` → a version-4 reference-layout file."""
+    f, close = _open(path_or_file, "wb")
+    try:
+        n, degree = index.graph.shape
+        _write(f, np.int32(4))
+        _write(f, np.int64(n))
+        _write(f, np.uint32(index.dataset.shape[1]))
+        _write(f, np.uint32(degree))
+        _write(f, np.array(_INT_BY_METRIC[index.metric], np.uint16))
+        _write(f, np.asarray(index.graph, np.uint32))
+        _write(f, np.bool_(include_dataset))
+        if include_dataset:
+            _write(f, np.asarray(index.dataset, np.float32))
+    finally:
+        if close:
+            f.close()

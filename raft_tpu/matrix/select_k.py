@@ -54,14 +54,15 @@ def _topk_smallest(values: jax.Array, k: int, select_min: bool):
 
 def tune_select_k(rows: int, n: int, k: int, select_min: bool = True,
                   reps: int = 5):
-    """Measure the top-k engine for this shape class on the current device
-    and cache it for ``algo="auto"`` (call eagerly, not under jit).
+    """Calibration probe for the (single) top-k engine — call eagerly,
+    not under jit.
 
-    With a single engine this is a calibration probe, not a contest: it
-    records the measured per-call cost so regressions in the backend's
-    sort lowering are visible across processes (the reference's
-    ``choose_select_k_algorithm`` table role, select_k-inl.cuh:48-72).
-    """
+    With one engine nothing dispatches on the result: the recorded
+    timing exists so regressions in the backend's sort lowering are
+    visible across runs (the measurement role of the reference's
+    ``choose_select_k_algorithm`` table, select_k-inl.cuh:48-72), not to
+    steer ``algo="auto"`` — every algo name maps to the same engine on
+    TPU (see module docstring)."""
     from ..ops import autotune
 
     x = jax.random.normal(jax.random.PRNGKey(0), (rows, n), jnp.float32)

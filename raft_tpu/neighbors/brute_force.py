@@ -288,8 +288,12 @@ def tune_search(index: Index, queries, k: int, reps: int = 5,
     cands = {"matmul": _engine("matmul"), "scan": _engine("scan")}
     if index.metric in _PALLAS_METRICS and jax.default_backend() == "tpu":
         cands["pallas"] = _engine("pallas")
+    # value_read: engine choice must not be steered by a backend that
+    # lies about readiness (observed: block_until_ready returning in
+    # ~1 ms for TFLOP-scale batches) — each rep closes with a host read
     return autotune.tune_best(key, cands, q, reps=reps, force=True,
-                              suspect_floor_s=suspect_floor_s)
+                              suspect_floor_s=suspect_floor_s,
+                              value_read=True)
 
 
 def _search_pallas(index: Index, q, k, filter, valid_rows, precision):

@@ -96,7 +96,13 @@ class SearchParams:
     ``jnp.float32`` exact, ``jnp.bfloat16`` (default, the fp16-LUT role),
     or ``jnp.int8`` / ``"int8"`` (the fp8-LUT role: per-subspace
     symmetrically-quantized codebook, int8 MXU decode at double rate —
-    pair with refine for full recall)."""
+    pair with refine for full recall).
+
+    There is deliberately no ``internal_distance_dtype`` knob: the MXU
+    accumulates every LUT mode in f32/int32 natively, so the reference's
+    fp16-internal-distance speed/accuracy trade (ivf_pq_types.hpp:110-146)
+    costs nothing to skip on TPU — internal distances are always full
+    precision here."""
 
     n_probes: int = 20
     lut_dtype: jnp.dtype | str = jnp.bfloat16

@@ -118,7 +118,21 @@ def record(key: str, choice: str) -> None:
     save_cache()
 
 
-def _timed_reps(fn: Callable, args, reps: int, out0):
+def _value_read(out) -> None:
+    """Force a host-side value read of the output: some backends lie
+    about ``block_until_ready`` itself (buffers report ready before the
+    compute ran), and only a host value transitively dependent on the
+    output is proof of completion. Costs one tiny dispatch + round trip."""
+    import jax.numpy as jnp
+
+    leaves = [l for l in jax.tree_util.tree_leaves(out)
+              if isinstance(l, jax.Array)]
+    if leaves:
+        x = leaves[0].ravel()[:1].astype(jnp.float32)
+        float(jnp.where(jnp.isfinite(x), x, 0.0)[0])
+
+
+def _timed_reps(fn: Callable, args, reps: int, out0, value_read=False):
     import jax.numpy as jnp
 
     out = out0
@@ -140,13 +154,16 @@ def _timed_reps(fn: Callable, args, reps: int, out0):
         t0 = time.perf_counter()
         out = fn(*args_r)
         jax.block_until_ready(out)
+        if value_read:
+            _value_read(out)
         ts.append(time.perf_counter() - t0)
     ts.sort()
     return ts[len(ts) // 2]
 
 
 def measure(fn: Callable, *args, reps: int = 5, out0=None,
-            suspect_floor_s: float = 0.0) -> float:
+            suspect_floor_s: float = 0.0,
+            value_read: bool = False) -> float:
     """Median seconds per call, one blocking sync per call (see module
     docstring for why per-call blocking is load-bearing).
 
@@ -178,7 +195,7 @@ def measure(fn: Callable, *args, reps: int = 5, out0=None,
         out0 = fn(*args)
         jax.block_until_ready(out0)      # compile + warm
 
-    med = _timed_reps(fn, args, reps, out0)
+    med = _timed_reps(fn, args, reps, out0, value_read=value_read)
     if suspect_floor_s and med < suspect_floor_s:
         global suspect_events
         suspect_events += 1
@@ -195,7 +212,8 @@ def measure(fn: Callable, *args, reps: int = 5, out0=None,
             fresh = _fresh_executable(fn)
             out0 = fresh(*args)
             jax.block_until_ready(out0)      # fresh compile + warm
-            med2 = _timed_reps(fresh, args, reps, out0)
+            med2 = _timed_reps(fresh, args, reps, out0,
+                               value_read=value_read)
         except Exception as e:  # noqa: BLE001 - compile died / not re-jittable
             # classify as unreliable (cause chained): the suspect median
             # already tripped the floor, and retrying a fresh compile in
@@ -276,6 +294,13 @@ def measure_throughput(fn: Callable, *args, depth: int = 6, reps: int = 3,
     ``measure`` (compared against wall/depth); a trip re-measures through
     a fresh executable and raises :class:`TimingUnreliableError` when the
     backend window is lying. Returns median-of-``reps`` seconds per call.
+
+    CAVEAT: on backends whose lying extends to ``block_until_ready``
+    itself (buffers reporting ready before compute ran — observed on the
+    axon tunnel), this can still under-report; a recorded benchmark
+    should close its window with a host-side VALUE read of a scalar
+    dependent on every output (see bench.py ``measure_wall``, the
+    recorded-QPS methodology there).
     """
     import jax.numpy as jnp
 
@@ -345,7 +370,8 @@ def measure_throughput(fn: Callable, *args, depth: int = 6, reps: int = 3,
 def tune_best(key: str, candidates: Mapping[str, Callable], *args,
               reps: int = 5,
               force: bool = False,
-              suspect_floor_s: float = 0.0) -> Tuple[str, Dict[str, float]]:
+              suspect_floor_s: float = 0.0,
+              value_read: bool = False) -> Tuple[str, Dict[str, float]]:
     """Measure every candidate on device, record + return the winner.
 
     Returns (winner name, {name: median seconds}). Failures (e.g. a kernel
@@ -364,7 +390,8 @@ def tune_best(key: str, candidates: Mapping[str, Callable], *args,
     for name, fn in candidates.items():
         try:
             timings[name] = measure(fn, *args, reps=reps,
-                                    suspect_floor_s=suspect_floor_s)
+                                    suspect_floor_s=suspect_floor_s,
+                                    value_read=value_read)
         except TimingUnreliableError as e:
             unreliable_names.append(name)
             rlog.log_warn("autotune %s: candidate %s unmeasurable: %s",
