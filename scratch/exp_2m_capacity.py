@@ -1,0 +1,137 @@
+"""PQ capacity demo: 2M rows on one chip via 4x500k parts (the corpus
+at which raw f32 storage pressures HBM and PQ's 8x compression is the
+point — the reference's DEEP-1B positioning), plus a CAGRA mid-point
+sweep at 500k for a better 0.95-recall anchor. Value-read walls."""
+import json, os, sys, time
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/raft_tpu_xla_cache")
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+import numpy as np
+from raft_tpu.neighbors import brute_force, cagra, ivf_pq, refine
+
+def log(m): print(m, file=sys.stderr, flush=True)
+
+n, d, nq, k, part_n = 2_000_000, 128, 10_000, 10, 500_000
+di = 16
+kw, kc, kx, ka, kq, kp, ke, kf = jax.random.split(jax.random.PRNGKey(5), 8)
+w = jax.random.normal(kw, (di, d)); w = w / jnp.linalg.norm(w, axis=1, keepdims=True)
+cz = jax.random.normal(kc, (200, di))
+z = cz[jax.random.randint(ka, (n,), 0, 200)] + jax.random.normal(kx, (n, di))
+data = z @ w + 0.1 * jax.random.normal(ke, (n, d))
+qz = cz[jax.random.randint(kq, (nq,), 0, 200)] + jax.random.normal(kp, (nq, di))
+queries = qz @ w + 0.1 * jax.random.normal(kf, (nq, d))
+jax.block_until_ready((data, queries))
+parts = [data[i*part_n:(i+1)*part_n] for i in range(4)]
+offsets = [i * part_n for i in range(4)]
+log("# 2M corpus ready")
+
+out = {}
+
+# ground truth: 4-part exact with one executable
+bfs = [brute_force.build(p, metric="sqeuclidean") for p in parts]
+gt_fn = jax.jit(lambda q, idx: brute_force.search(idx, q, k, algo="matmul"))
+merge = jax.jit(lambda dv, iv: brute_force.knn_merge_parts(dv, iv, True))
+def exact(qs):
+    ds, is_ = [], []
+    for bfi, off in zip(bfs, offsets):
+        dd, ii = gt_fn(qs, bfi)
+        ds.append(dd); is_.append(jnp.where(ii >= 0, ii + off, -1))
+    return merge(jnp.stack(ds), jnp.stack(is_))
+gt = jnp.concatenate([jax.block_until_ready(exact(queries[c:c+1000])[1])
+                      for c in range(0, nq, 1000)])
+log("# gt done")
+
+def recall(ids):
+    hit = jnp.any(ids[:, :, None] == gt[:, None, :], axis=2) & (gt >= 0)
+    return float(jnp.sum(hit) / jnp.sum(gt >= 0))
+
+# 4-part PQ build
+t0 = time.perf_counter()
+pis = [ivf_pq.build(p, ivf_pq.IndexParams(n_lists=1024, pq_dim=128,
+                                          pq_bits=4, seed=0))
+       for p in parts]
+jax.block_until_ready(jax.tree.leaves(pis))
+build_s = time.perf_counter() - t0
+for pi in pis:
+    ivf_pq.prepare_scan(pi)
+parts_bf16 = [jnp.asarray(p, jnp.bfloat16) for p in parts]
+jax.block_until_ready(parts_bf16)
+log(f"# 4x500k pq built in {build_s:.0f}s")
+
+code_bytes = sum(int(np.prod(pi.codes.shape)) for pi in pis)
+raw_bytes = n * d * 4
+log(f"# compression: {raw_bytes/1e9:.2f} GB raw f32 -> "
+    f"{code_bytes/1e9:.2f} GB codes (+norms/books)")
+
+def pq_tp(probes, ratio):
+    sp = ivf_pq.SearchParams(n_probes=probes, lut_dtype="int8")
+    def body(q, idx, dd):
+        _, cand = ivf_pq.search(idx, q, ratio * k, sp)
+        return refine.refine(dd, q, cand, k)
+    fn = jax.jit(body)
+    def tp(q, *_):
+        ds, is_ = [], []
+        for pi, pb, off in zip(pis, parts_bf16, offsets):
+            dd, ii = fn(q, pi, pb)
+            ds.append(dd); is_.append(jnp.where(ii >= 0, ii + off, -1))
+        return merge(jnp.stack(ds), jnp.stack(is_))
+    return tp
+
+def wall(tp, calls=4):
+    perms = [jnp.take(queries, jax.random.permutation(
+        jax.random.PRNGKey(100 + i), nq), axis=0) for i in range(calls + 1)]
+    jax.block_until_ready(perms)
+    d0 = tp(perms.pop())[0]
+    float(jnp.sum(jnp.where(jnp.isfinite(d0[:, 0]), d0[:, 0], 0.0)))
+    t0 = time.perf_counter()
+    acc = None
+    for p in perms:
+        dd = tp(p)[0]
+        s = jnp.sum(jnp.where(jnp.isfinite(dd[:, 0]), dd[:, 0], 0.0))
+        acc = s if acc is None else acc + s
+    _ = float(acc)
+    return (time.perf_counter() - t0) / calls
+
+for probes, ratio in ((20, 2), (50, 2)):
+    tp = pq_tp(probes, ratio)
+    dt = wall(tp)
+    r = recall(tp(queries)[1])
+    out[f"pq2M_np{probes}_r{ratio}"] = dict(
+        ms=dt*1e3, qps=nq/dt, recall=r, build_s=build_s,
+        corpus_n=n, code_gb=code_bytes/1e9, raw_gb=raw_bytes/1e9)
+    log(f"# pq 2M np{probes} r{ratio}: {dt*1e3:.1f}ms ({nq/dt:,.0f} qps) "
+        f"r={r:.4f}")
+
+# free 2M structures before cagra
+del bfs, pis, parts_bf16, data, parts
+
+# --- CAGRA mid-point sweep at 500k ---
+cdata = np.asarray(z[:part_n] @ w + 0.0)   # rebuild part-A-like corpus
+del z
+cdata = jnp.asarray(cdata) + 0.1 * jax.random.normal(ke, (part_n, d))
+jax.block_until_ready(cdata)
+cgt_bfi = brute_force.build(cdata, metric="sqeuclidean")
+cgt = jnp.concatenate([
+    jax.block_until_ready(gt_fn(queries[c:c+1000], cgt_bfi)[1])
+    for c in range(0, nq, 1000)])
+def crecall(ids):
+    hit = jnp.any(ids[:, :, None] == cgt[:, None, :], axis=2) & (cgt >= 0)
+    return float(jnp.sum(hit) / jnp.sum(cgt >= 0))
+t0 = time.perf_counter()
+ci = cagra.build(np.asarray(cdata), cagra.IndexParams(
+    graph_degree=64, intermediate_graph_degree=96, seed=0))
+jax.block_until_ready(jax.tree.leaves(ci))
+log(f"# cagra 500k built in {time.perf_counter()-t0:.0f}s")
+cagra.prepare_search(ci)
+for itopk, width, mi in ((32, 4, 4), (48, 4, 5), (24, 6, 4), (32, 6, 4)):
+    sp = cagra.SearchParams(itopk_size=itopk, search_width=width,
+                            max_iterations=mi)
+    fn = jax.jit(lambda q, idx, s=sp: cagra.search(idx, q, k, s))
+    dt = wall(lambda p, *_: fn(p, ci))
+    r = crecall(fn(queries, ci)[1])
+    out[f"cagra_itopk{itopk}_w{width}_mi{mi}"] = dict(
+        ms=dt*1e3, qps=nq/dt, recall=r)
+    log(f"# cagra itopk{itopk} w{width} mi{mi}: {dt*1e3:.1f}ms "
+        f"({nq/dt:,.0f} qps) r={r:.4f}")
+
+print(json.dumps(out, indent=1))

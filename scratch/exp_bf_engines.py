@@ -1,0 +1,51 @@
+"""Honest (value-read wall) brute-force engine race at the 500k part
+shape: matmul vs pallas fused vs scan, plus matmul workspace variants.
+The earlier autotune pick used readiness-lying timings."""
+import os, sys, time
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/raft_tpu_xla_cache")
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+import numpy as np
+from raft_tpu.neighbors import brute_force
+
+def log(m): print(m, file=sys.stderr, flush=True)
+
+n, d, nq, k = 500_000, 128, 10_000, 10
+k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+data = jax.random.normal(k1, (n, d), jnp.float32)
+queries = jax.random.normal(k2, (nq, d), jnp.float32)
+jax.block_until_ready((data, queries))
+bfi = brute_force.build(data, metric="sqeuclidean")
+bfi16 = brute_force.build(data, dtype=jnp.bfloat16)
+log("# built")
+
+def wall(tp, calls=4):
+    perms = [jnp.take(queries, jax.random.permutation(
+        jax.random.PRNGKey(100 + i), nq), axis=0) for i in range(calls + 1)]
+    jax.block_until_ready(perms)
+    d0 = tp(perms.pop())[0]
+    float(jnp.sum(jnp.where(jnp.isfinite(d0[:, 0]), d0[:, 0], 0.0)))
+    t0 = time.perf_counter()
+    acc = None
+    for p in perms:
+        dd = tp(p)[0]
+        s = jnp.sum(jnp.where(jnp.isfinite(dd[:, 0]), dd[:, 0], 0.0))
+        acc = s if acc is None else acc + s
+    _ = float(acc)
+    return (time.perf_counter() - t0) / calls
+
+for name, algo, idx, ws in (
+        ("matmul", "matmul", bfi, None),
+        ("matmul.ws4096", "matmul", bfi, 4096),
+        ("pallas", "pallas", bfi, None),
+        ("scan", "scan", bfi, None),
+        ("matmul.bf16", "matmul", bfi16, None),
+        ("pallas.bf16", "pallas", bfi16, None)):
+    kw = {"workspace_mb": ws} if ws else {}
+    fn = jax.jit(lambda q, ii, a=algo, kww=tuple(sorted(kw.items())):
+                 brute_force.search(ii, q, k, algo=a, **dict(kww)))
+    try:
+        dt = wall(lambda p, f=fn, ii=idx: f(p, ii))
+        log(f"# {name}: {dt*1e3:.1f}ms/call ({nq/dt:,.0f} qps)")
+    except Exception as e:
+        log(f"# {name}: FAIL {type(e).__name__}: {e}")

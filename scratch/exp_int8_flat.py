@@ -1,0 +1,65 @@
+"""Does int8 IVF-Flat storage (quarter scan traffic, per-row scales)
+hold recall >=0.95 on the hard corpus at 500k? Value-read walls."""
+import json, os, sys, time
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/raft_tpu_xla_cache")
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+import numpy as np
+from raft_tpu.neighbors import brute_force, ivf_flat
+
+def log(m): print(m, file=sys.stderr, flush=True)
+
+n, d, nq, k, di = 500_000, 128, 10_000, 10, 16
+kw, kc, kx, ka, kq, kp, ke, kf = jax.random.split(jax.random.PRNGKey(0), 8)
+w = jax.random.normal(kw, (di, d)); w = w / jnp.linalg.norm(w, axis=1, keepdims=True)
+cz = jax.random.normal(kc, (200, di))
+z = cz[jax.random.randint(ka, (n,), 0, 200)] + jax.random.normal(kx, (n, di))
+data = z @ w + 0.1 * jax.random.normal(ke, (n, d))
+qz = cz[jax.random.randint(kq, (nq,), 0, 200)] + jax.random.normal(kp, (nq, di))
+queries = qz @ w + 0.1 * jax.random.normal(kf, (nq, d))
+jax.block_until_ready((data, queries))
+bfi = brute_force.build(data, metric="sqeuclidean")
+gt_fn = jax.jit(lambda q, idx: brute_force.search(idx, q, k, algo="matmul")[1])
+gt = jnp.concatenate([jax.block_until_ready(gt_fn(queries[c:c+1000], bfi))
+                      for c in range(0, nq, 1000)])
+log("# gt done")
+
+def recall(ids):
+    hit = jnp.any(ids[:, :, None] == gt[:, None, :], axis=2) & (gt >= 0)
+    return float(jnp.sum(hit) / jnp.sum(gt >= 0))
+
+def wall(tp, calls=6):
+    perms = [jnp.take(queries, jax.random.permutation(
+        jax.random.PRNGKey(100 + i), nq), axis=0) for i in range(calls + 1)]
+    jax.block_until_ready(perms)
+    d0 = tp(perms.pop())[0]
+    float(jnp.sum(jnp.where(jnp.isfinite(d0[:, 0]), d0[:, 0], 0.0)))
+    t0 = time.perf_counter()
+    acc = None
+    for p in perms:
+        dd = tp(p)[0]
+        s = jnp.sum(jnp.where(jnp.isfinite(dd[:, 0]), dd[:, 0], 0.0))
+        acc = s if acc is None else acc + s
+    _ = float(acc)
+    return (time.perf_counter() - t0) / calls
+
+out = {}
+for dtype in ("int8", "bfloat16"):
+    t0 = time.perf_counter()
+    fi = ivf_flat.build(data, ivf_flat.IndexParams(n_lists=1024, seed=0,
+                                                   dtype=dtype))
+    jax.block_until_ready(jax.tree.leaves(fi))
+    bs = time.perf_counter() - t0
+    ivf_flat.prepare_scan(fi)
+    log(f"# {dtype} built {bs:.0f}s")
+    for probes in (20, 30, 50):
+        fn = jax.jit(lambda q, idx, p=probes: ivf_flat.search(
+            idx, q, k, ivf_flat.SearchParams(n_probes=p)))
+        dt = wall(lambda p, f=fn: f(p, fi))
+        r = recall(fn(queries, fi)[1])
+        out[f"flat_{dtype}_np{probes}"] = dict(ms=dt*1e3, qps=nq and nq/dt,
+                                               recall=r, build_s=bs)
+        log(f"# flat {dtype} np{probes}: {dt*1e3:.1f}ms ({nq/dt:,.0f} qps) "
+            f"r={r:.4f}")
+
+print(json.dumps(out, indent=1))

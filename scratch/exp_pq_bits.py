@@ -1,0 +1,96 @@
+"""Round-4 experiment: PQ scan cost vs pq_bits / lut mode on the real chip.
+
+Latency (per-call-blocked median) AND pipelined throughput (the tunnel's
+~90-110 ms dispatch floor dominates per-call numbers at these corpus
+sizes) for:
+  - flat np5 (the bar: PQ must beat this)
+  - pq64  b8  bf16/int8 (current bench config + fp8-LUT role)
+  - pq128 b4  bf16/int8 (same 512 bits/row, 8x narrower one-hot)
+all with refine r2 at nprobe=20, plus scan-only variants.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/raft_tpu_xla_cache")
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq, refine
+from raft_tpu.ops.autotune import measure, measure_throughput
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+n, d, nq, k = 200_000, 128, 10_000, 10
+kc, kx, ka, kq, kp = jax.random.split(jax.random.PRNGKey(0), 5)
+centers = jax.random.normal(kc, (2000, d), jnp.float32) * 4.0
+assign = jax.random.randint(ka, (n,), 0, 2000)
+data = centers[assign] + jax.random.normal(kx, (n, d), jnp.float32)
+# fresh mixture queries (NOT corpus perturbations): real recall frontier
+qassign = jax.random.randint(kq, (nq,), 0, 2000)
+queries = centers[qassign] + jax.random.normal(kp, (nq, d), jnp.float32)
+jax.block_until_ready((data, queries))
+log("# corpus ready")
+
+bfi = brute_force.build(data, metric="sqeuclidean")
+gt_fn = jax.jit(lambda q, idx: brute_force.search(idx, q, k, algo="matmul")[1])
+gt = jax.block_until_ready(gt_fn(queries, bfi))
+log("# gt done")
+
+def recall(ids):
+    hit = jnp.any(ids[:, :, None] == gt[:, None, :], axis=2) & (gt >= 0)
+    return float(jnp.sum(hit) / jnp.sum(gt >= 0))
+
+out = {}
+
+def bench_fn(tag, fn, *args):
+    try:
+        lat = measure(fn, *args, reps=5, suspect_floor_s=0.002)
+        thr = measure_throughput(fn, *args, depth=6, reps=3,
+                                 suspect_floor_s=0.002)
+        rec = recall(fn(*args)[1])
+    except Exception as e:
+        log(f"# {tag} failed: {type(e).__name__}: {e}")
+        return
+    out[tag] = dict(lat_ms=lat*1e3, thr_ms=thr*1e3, lat_qps=nq/lat,
+                    thr_qps=nq/thr, recall=rec)
+    log(f"# {tag}: lat {lat*1e3:.1f}ms ({nq/lat:,.0f}qps) "
+        f"thr {thr*1e3:.1f}ms ({nq/thr:,.0f}qps) r={rec:.4f}")
+
+# --- ivf_flat np5: the bar ---
+t0 = time.perf_counter()
+fi = ivf_flat.build(data, ivf_flat.IndexParams(n_lists=1024, seed=0))
+jax.block_until_ready(jax.tree.leaves(fi))
+ivf_flat.prepare_scan(fi)
+log(f"# flat built {time.perf_counter()-t0:.0f}s")
+for probes in (5, 20):
+    fn = jax.jit(lambda q, idx, p=probes: ivf_flat.search(
+        idx, q, k, ivf_flat.SearchParams(n_probes=p)))
+    bench_fn(f"flat_np{probes}", fn, queries, fi)
+
+# --- ivf_pq configs ---
+for name, pqd, bits in (("pq64b8", 64, 8), ("pq128b4", 128, 4)):
+    t0 = time.perf_counter()
+    pi = ivf_pq.build(data, ivf_pq.IndexParams(
+        n_lists=1024, pq_dim=pqd, pq_bits=bits, seed=0))
+    jax.block_until_ready(jax.tree.leaves(pi))
+    build_s = time.perf_counter() - t0
+    ivf_pq.prepare_scan(pi)
+    log(f"# {name} built {build_s:.0f}s")
+    for lut in ("bf16", "int8"):
+        def fn_body(q, idx, dd, lu=lut):
+            _, cand = ivf_pq.search(
+                idx, q, 2 * k, ivf_pq.SearchParams(n_probes=20, lut_dtype=lu))
+            return refine.refine(dd, q, cand, k)
+        bench_fn(f"{name}_{lut}_np20_r2", jax.jit(fn_body), queries, pi, data)
+    # scan-only int8 to isolate kernel cost
+    sfn = jax.jit(lambda q, idx: ivf_pq.search(
+        idx, q, k, ivf_pq.SearchParams(n_probes=20, lut_dtype="int8")))
+    bench_fn(f"{name}_int8_scanonly_np20", sfn, queries, pi)
+
+print(json.dumps(out, indent=1))

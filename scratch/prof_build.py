@@ -1,0 +1,65 @@
+"""Profile CAGRA *build* phases at 100k on the real chip: where do
+optimize()'s 219 s and seeds' 125 s actually go?"""
+import time, sys, os
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/raft_tpu_xla_cache")
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+import numpy as np
+from raft_tpu.neighbors import cagra
+
+n, d, d0, deg = 100_000, 128, 96, 64
+k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+data = jax.random.normal(k1, (n, d), jnp.float32)
+# realistic-ish knn graph: random but sorted-by-closeness shape is
+# irrelevant for cost profiling
+knn = np.asarray(jax.random.randint(k2, (n, d0), 0, n, jnp.int32))
+jax.block_until_ready(data)
+print("chip:", jax.devices()[0].device_kind, flush=True)
+
+def t(label, fn):
+    t0 = time.perf_counter()
+    r = fn()
+    jax.block_until_ready(r) if r is not None else None
+    print(f"{label}: {time.perf_counter()-t0:.1f}s", flush=True)
+    return r
+
+graph_j = jnp.asarray(knn)
+graph_sorted = t("sort graph", lambda: jnp.sort(graph_j, axis=1))
+
+batch = max(256, min(2048 * 8, (1 << 30) // (d0 * d0 * 16)))
+batch = min(batch, n)
+print(f"batch={batch} n_batches={-(-n // batch)}", flush=True)
+
+nodes0 = jnp.arange(batch, dtype=jnp.int32)
+# compile
+t("prune_batch compile+run", lambda: cagra._prune_batch(
+    graph_sorted, graph_j, nodes0, deg))
+t("prune_batch steady", lambda: cagra._prune_batch(
+    graph_sorted, graph_j, nodes0 + 1, deg))
+t("prune_batch steady2", lambda: cagra._prune_batch(
+    graph_sorted, graph_j, nodes0 + 2, deg))
+
+# sub-pieces of _detour_counts
+def piece_gather():
+    nbrs = graph_j[nodes0]
+    return graph_sorted[nbrs]
+t("detour gather compile+run", piece_gather)
+t("detour gather steady", piece_gather)
+
+def piece_ss():
+    nbrs = graph_j[nodes0]
+    nbr_rows = graph_sorted[nbrs]
+    rows2 = nbr_rows.reshape(batch * d0, d0)
+    tgts2 = jnp.broadcast_to(nbrs[:, None, :], (batch, d0, d0)).reshape(
+        batch * d0, d0)
+    pos = jax.vmap(jnp.searchsorted)(rows2, tgts2)
+    return pos
+f_ss = jax.jit(piece_ss)
+t("searchsorted compile+run", f_ss)
+t("searchsorted steady", f_ss)
+
+t("full optimize", lambda: cagra.optimize(knn, deg))
+
+# seeds phase
+t("covering_seeds s=1562", lambda: cagra._covering_seeds(
+    np.asarray(data), 1562, cagra.DistanceType.L2Expanded, 0))

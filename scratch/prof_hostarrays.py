@@ -1,0 +1,45 @@
+"""Does a host-origin (np.asarray -> jnp.asarray) array cost a re-upload
+per executable call under the axon tunnel? And which materialization
+idiom fixes it?"""
+import os, sys, time
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/raft_tpu_xla_cache")
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+import numpy as np
+from raft_tpu.neighbors import cagra
+
+n, d0, B, deg = 100_000, 96, 7281, 64
+rng = np.random.default_rng(0)
+knn_host = rng.integers(0, n, size=(n, d0)).astype(np.int32)
+nodes = jnp.arange(B, dtype=jnp.int32)
+print("chip:", jax.devices()[0].device_kind, flush=True)
+
+def t(label, f, *a):
+    r = jax.block_until_ready(f(*a))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = jax.block_until_ready(f(*a))
+        ts.append(time.perf_counter() - t0)
+    print(f"{label}: {min(ts)*1e3:.0f} ms", flush=True)
+    return r
+
+f = jax.jit(lambda gs, g, nd: cagra._prune_batch(gs, g, nd, deg))
+
+# variant 1: plain jnp.asarray of host data
+g1 = jnp.asarray(knn_host)
+gs1 = jnp.sort(g1, axis=1)
+jax.block_until_ready((g1, gs1))
+t("host-origin jnp.asarray", f, gs1, g1, nodes)
+
+# variant 2: explicit device_put
+g2 = jax.device_put(knn_host, jax.devices()[0])
+gs2 = jnp.sort(g2, axis=1)
+jax.block_until_ready((g2, gs2))
+t("device_put", f, gs2, g2, nodes)
+
+# variant 3: force a device-computed copy
+g3 = jax.jit(lambda x: x + 0)(jnp.asarray(knn_host))
+gs3 = jnp.sort(g3, axis=1)
+jax.block_until_ready((g3, gs3))
+t("device-computed copy", f, gs3, g3, nodes)

@@ -1,0 +1,89 @@
+"""Honest (value-varying) decomposition of the prune-batch cost.
+Every rep uses a different nodes window so the tunnel replay cache
+cannot serve it."""
+import os, sys, time
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/raft_tpu_xla_cache")
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+import numpy as np
+from raft_tpu.neighbors import cagra
+
+n, d0, B, deg = 100_000, 96, 7281, 64
+k1 = jax.random.PRNGKey(0)
+graph = jax.random.randint(k1, (n, d0), 0, n, jnp.int32)
+graph_sorted = jnp.sort(graph, axis=1)
+jax.block_until_ready((graph, graph_sorted))
+print("chip:", jax.devices()[0].device_kind, flush=True)
+
+def t(label, f, nargs=1):
+    # warm/compile on window 0, then time distinct windows
+    jax.block_until_ready(f(jnp.arange(B, dtype=jnp.int32)))
+    ts = []
+    for r in range(1, 4):
+        nd = jnp.arange(r, B + r, dtype=jnp.int32)
+        jax.block_until_ready(nd)
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(nd))
+        ts.append(time.perf_counter() - t0)
+    print(f"{label}: {min(ts)*1e3:.0f} ms  (all: "
+          f"{[round(x*1e3) for x in ts]})", flush=True)
+
+# full prune batch
+t("full _prune_batch", lambda nd: cagra._prune_batch(
+    graph_sorted, graph, nd, deg))
+
+# gather stage only
+@jax.jit
+def gather_only(gs, g, nd):
+    nbrs = g[nd]
+    nbr_rows = gs[nbrs]
+    return jnp.sum(nbr_rows, dtype=jnp.int32) + jnp.sum(nbrs)
+t("gather only", lambda nd: gather_only(graph_sorted, graph, nd))
+
+# gather + searchsorted, no detour/argsort tail
+@jax.jit
+def gather_ss(gs, g, nd):
+    nbrs = g[nd]
+    nbr_rows = gs[nbrs]
+    rows2 = nbr_rows.reshape(B * d0, d0)
+    tgts2 = jnp.broadcast_to(nbrs[:, None, :], (B, d0, d0)).reshape(
+        B * d0, d0)
+    rows2, tgts2 = jax.lax.optimization_barrier((rows2, tgts2))
+    pos = jax.vmap(jnp.searchsorted)(rows2, tgts2)
+    return jnp.sum(pos, dtype=jnp.int32)
+t("gather+barrier+searchsorted", lambda nd: gather_ss(
+    graph_sorted, graph, nd))
+
+# same but unrolled binary search
+@jax.jit
+def gather_bin(gs, g, nd):
+    nbrs = g[nd]
+    nbr_rows = gs[nbrs]
+    rows2 = nbr_rows.reshape(B * d0, d0)
+    tgts2 = jnp.broadcast_to(nbrs[:, None, :], (B, d0, d0)).reshape(
+        B * d0, d0)
+    rows2, tgts2 = jax.lax.optimization_barrier((rows2, tgts2))
+    lo = jnp.zeros(tgts2.shape, jnp.int32)
+    hi = jnp.full(tgts2.shape, d0, jnp.int32)
+    for _ in range(8):
+        mid = jnp.minimum((lo + hi) // 2, d0 - 1)
+        vals = jnp.take_along_axis(rows2, mid, axis=1)
+        go = vals < tgts2
+        lo = jnp.where(go, mid + 1, lo)
+        hi = jnp.where(go, hi, mid)
+    return jnp.sum(lo, dtype=jnp.int32)
+t("gather+barrier+unrolled-bin", lambda nd: gather_bin(
+    graph_sorted, graph, nd))
+
+# detour-count tail alone (on device-created random inputs, varying)
+@jax.jit
+def tail_only(hit, det_seed):
+    adj = hit.reshape(B, d0, d0)
+    tri = jnp.tril(jnp.ones((d0, d0), bool), k=-1).T
+    det = jnp.sum(adj & tri[None], axis=1) + det_seed
+    key = det * d0 + jnp.arange(d0, dtype=jnp.int32)[None, :]
+    order = jnp.argsort(key, axis=1, stable=True)[:, :deg]
+    return jnp.sum(order, dtype=jnp.int32)
+hit0 = jax.random.bernoulli(k1, 0.1, (B * d0, d0))
+jax.block_until_ready(hit0)
+t("detour+argsort tail", lambda nd: tail_only(hit0, nd[0]))
