@@ -744,7 +744,8 @@ def main():
         # sweep (itopk, search_width, max_iterations); measured sweep
         # 2026-07-31 (see bench.py history): covering seeds + few hops
         sweep = (((32, 4, 5),) if hurry
-                 else ((16, 8, 2), (32, 4, 3), (32, 4, 5), (64, 4, 8)))
+                 else ((16, 8, 2), (32, 4, 3), (40, 4, 4), (32, 4, 5),
+                       (64, 4, 8)))
         opener = sweep[0]
         for itopk, width, mi in sweep:
             sp = cagra.SearchParams(itopk_size=itopk, search_width=width,

@@ -31,7 +31,7 @@ import jax
 from ..core import logging as rlog
 
 __all__ = ["shape_bucket", "lookup", "record", "measure",
-           "measure_throughput", "tune_best",
+           "measure_throughput", "measure_value_read_wall", "tune_best",
            "cache_path", "load_cache", "save_cache",
            "TimingUnreliableError"]
 
@@ -365,6 +365,39 @@ def measure_throughput(fn: Callable, *args, depth: int = 6, reps: int = 3,
                 f"{suspect_floor_s:.3g}s even on a fresh executable")
         med = max(med, med2)
     return med
+
+
+def measure_value_read_wall(fn: Callable, inputs: Sequence, *args,
+                            warm_input=None) -> float:
+    """Wall seconds/call over ``inputs`` with a VALUE-READ close.
+
+    The strongest timing this library has against lying backends: each
+    call gets a genuinely different first input, calls are dispatched
+    back-to-back (dispatch overlaps compute), every output folds into a
+    scalar accumulator, and the window closes with a host ``float()`` of
+    that accumulator — which cannot materialize before all the compute
+    ran (readiness-level lies included; see bench.py's methodology
+    notes). Pass ``warm_input`` (a throwaway input NOT in ``inputs``) to
+    warm/compile outside the window so no timed call repeats content the
+    backend has already served.
+    """
+    import jax.numpy as jnp
+
+    def fold(out):
+        leaves = [l for l in jax.tree_util.tree_leaves(out)
+                  if isinstance(l, jax.Array)]
+        x = leaves[0].ravel()[:1].astype(jnp.float32)
+        return jnp.where(jnp.isfinite(x), x, 0.0)[0]
+
+    if warm_input is not None:
+        float(fold(fn(warm_input, *args)))
+    t0 = time.perf_counter()
+    acc = None
+    for inp in inputs:
+        s = fold(fn(inp, *args))
+        acc = s if acc is None else acc + s
+    _ = float(acc)
+    return (time.perf_counter() - t0) / len(inputs)
 
 
 def tune_best(key: str, candidates: Mapping[str, Callable], *args,

@@ -78,19 +78,13 @@ def pq_tp(probes, ratio):
     return tp
 
 def wall(tp, calls=4):
+    """Shared value-read wall (see ops/autotune.measure_value_read_wall):
+    content-distinct permutations, warm outside the window."""
+    from raft_tpu.ops.autotune import measure_value_read_wall
     perms = [jnp.take(queries, jax.random.permutation(
         jax.random.PRNGKey(100 + i), nq), axis=0) for i in range(calls + 1)]
     jax.block_until_ready(perms)
-    d0 = tp(perms.pop())[0]
-    float(jnp.sum(jnp.where(jnp.isfinite(d0[:, 0]), d0[:, 0], 0.0)))
-    t0 = time.perf_counter()
-    acc = None
-    for p in perms:
-        dd = tp(p)[0]
-        s = jnp.sum(jnp.where(jnp.isfinite(dd[:, 0]), dd[:, 0], 0.0))
-        acc = s if acc is None else acc + s
-    _ = float(acc)
-    return (time.perf_counter() - t0) / calls
+    return measure_value_read_wall(tp, perms[:-1], warm_input=perms[-1])
 
 for probes, ratio in ((20, 2), (50, 2)):
     tp = pq_tp(probes, ratio)

@@ -58,14 +58,20 @@ def frontier(scale):
     return data, queries, bfi, gt, fi, rec, r5, r20
 
 chosen = None
+first = None
 for scale in (1.5, 2.0, 2.5):
-    data, queries, bfi, gt, fi, rec, r5, r20 = frontier(scale)
+    state = frontier(scale)
+    if first is None:
+        first = state
+    data, queries, bfi, gt, fi, rec, r5, r20 = state
     if r20 < 0.998 and r20 >= 0.9:
         chosen = scale
         break
 if chosen is None:
+    # fall back to the first scale WITHOUT rebuilding corpus/GT/index —
+    # the loop already computed it
     chosen = 1.5
-    data, queries, bfi, gt, fi, rec, r5, r20 = frontier(1.5)
+    data, queries, bfi, gt, fi, rec, r5, r20 = first
 log(f"# chosen corpus scale {chosen}")
 out["chosen_scale"] = chosen
 

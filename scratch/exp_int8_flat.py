@@ -29,19 +29,13 @@ def recall(ids):
     return float(jnp.sum(hit) / jnp.sum(gt >= 0))
 
 def wall(tp, calls=6):
+    """Shared value-read wall (see ops/autotune.measure_value_read_wall):
+    content-distinct permutations, warm outside the window."""
+    from raft_tpu.ops.autotune import measure_value_read_wall
     perms = [jnp.take(queries, jax.random.permutation(
         jax.random.PRNGKey(100 + i), nq), axis=0) for i in range(calls + 1)]
     jax.block_until_ready(perms)
-    d0 = tp(perms.pop())[0]
-    float(jnp.sum(jnp.where(jnp.isfinite(d0[:, 0]), d0[:, 0], 0.0)))
-    t0 = time.perf_counter()
-    acc = None
-    for p in perms:
-        dd = tp(p)[0]
-        s = jnp.sum(jnp.where(jnp.isfinite(dd[:, 0]), dd[:, 0], 0.0))
-        acc = s if acc is None else acc + s
-    _ = float(acc)
-    return (time.perf_counter() - t0) / calls
+    return measure_value_read_wall(tp, perms[:-1], warm_input=perms[-1])
 
 out = {}
 for dtype in ("int8", "bfloat16"):

@@ -32,12 +32,11 @@ print(f"batch={batch} n_batches={-(-n // batch)}", flush=True)
 
 nodes0 = jnp.arange(batch, dtype=jnp.int32)
 # compile
-t("prune_batch compile+run", lambda: cagra._prune_batch(
-    graph_sorted, graph_j, nodes0, deg))
+t("prune_batch compile+run", lambda: cagra._prune_batch(graph_j, nodes0, deg))
 t("prune_batch steady", lambda: cagra._prune_batch(
-    graph_sorted, graph_j, nodes0 + 1, deg))
+    graph_j, nodes0 + 1, deg))
 t("prune_batch steady2", lambda: cagra._prune_batch(
-    graph_sorted, graph_j, nodes0 + 2, deg))
+    graph_j, nodes0 + 2, deg))
 
 # sub-pieces of _detour_counts
 def piece_gather():

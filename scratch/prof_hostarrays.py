@@ -24,22 +24,22 @@ def t(label, f, *a):
     print(f"{label}: {min(ts)*1e3:.0f} ms", flush=True)
     return r
 
-f = jax.jit(lambda gs, g, nd: cagra._prune_batch(gs, g, nd, deg))
+f = jax.jit(lambda g, nd: cagra._prune_batch(g, nd, deg))
 
 # variant 1: plain jnp.asarray of host data
 g1 = jnp.asarray(knn_host)
 gs1 = jnp.sort(g1, axis=1)
 jax.block_until_ready((g1, gs1))
-t("host-origin jnp.asarray", f, gs1, g1, nodes)
+t("host-origin jnp.asarray", f, g1, nodes)
 
 # variant 2: explicit device_put
 g2 = jax.device_put(knn_host, jax.devices()[0])
 gs2 = jnp.sort(g2, axis=1)
 jax.block_until_ready((g2, gs2))
-t("device_put", f, gs2, g2, nodes)
+t("device_put", f, g2, nodes)
 
 # variant 3: force a device-computed copy
 g3 = jax.jit(lambda x: x + 0)(jnp.asarray(knn_host))
 gs3 = jnp.sort(g3, axis=1)
 jax.block_until_ready((g3, gs3))
-t("device-computed copy", f, gs3, g3, nodes)
+t("device-computed copy", f, g3, nodes)

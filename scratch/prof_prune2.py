@@ -29,8 +29,7 @@ def t(label, f, nargs=1):
           f"{[round(x*1e3) for x in ts]})", flush=True)
 
 # full prune batch
-t("full _prune_batch", lambda nd: cagra._prune_batch(
-    graph_sorted, graph, nd, deg))
+t("full _prune_batch", lambda nd: cagra._prune_batch(graph, nd, deg))
 
 # gather stage only
 @jax.jit
