@@ -317,7 +317,8 @@ def prepare_scan(index: Index) -> None:
         from ..ops.ivf_scan import pad_for_scan
 
         index._scan_pad = (lmax,
-                           *pad_for_scan(index.data, index.data_norms, lmax))
+                           *pad_for_scan(index.data, index.data_norms,
+                                         lmax, index.scales))
 
 
 def _search_pallas(index, q, k, n_probes, offsets_j, sizes_j, precision,
@@ -340,13 +341,14 @@ def _search_pallas(index, q, k, n_probes, offsets_j, sizes_j, precision,
     if cache is None or cache[0] != lmax:
         if in_jax_trace():
             # traced: compute inline, never store (leaked tracers)
-            cache = (lmax, *pad_for_scan(index.data, index.data_norms, lmax))
+            cache = (lmax, *pad_for_scan(index.data, index.data_norms,
+                                         lmax, index.scales))
         else:
             prepare_scan(index)
             cache = index._scan_pad
     interpret = jax.default_backend() != "tpu"
-    vals, rows = _ivf_flat_scan_jit(cache[1], cache[2], pen_p, probed,
-                                    offsets_j, sizes_j, q, k, lmax,
+    vals, rows = _ivf_flat_scan_jit(cache[1], cache[2], pen_p, cache[3],
+                                    probed, offsets_j, sizes_j, q, k, lmax,
                                     _PALLAS_METRICS[mt], interpret,
                                     precision)
     ids = jnp.where(rows >= 0,
@@ -391,16 +393,13 @@ def search(
     sizes_np = index.list_sizes
     sizes_j = jnp.asarray(sizes_np, jnp.int32)
 
-    # byte (int8/uint8) storage rides the XLA gather path (fused
-    # dequant); the pallas scan covers f32/bf16 rows
-    expects(not (algo == "pallas" and
-                 index.data.dtype in (jnp.int8, jnp.uint8)),
-            "algo='pallas' supports f32/bf16 storage; int8/uint8 use the "
-            "xla gather path")
-    use_pallas = (index.data.dtype not in (jnp.int8, jnp.uint8) and
-                  (algo == "pallas" or
-                   (algo == "auto" and mt in _PALLAS_METRICS and
-                    jax.default_backend() == "tpu")))
+    # every storage dtype rides the pallas scan: f32/bf16 natively,
+    # int8 via per-row scales applied to the dot in-kernel, uint8 exact
+    # (byte values are representable in bf16; role of the per-dtype
+    # loadAndComputeDist variants, ivf_flat_interleaved_scan-inl.cuh:99)
+    use_pallas = (algo == "pallas" or
+                  (algo == "auto" and mt in _PALLAS_METRICS and
+                   jax.default_backend() == "tpu"))
     if use_pallas:
         expects(mt in _PALLAS_METRICS, "metric %s unsupported by pallas",
                 mt.name)

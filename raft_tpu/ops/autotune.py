@@ -373,13 +373,18 @@ def measure_value_read_wall(fn: Callable, inputs: Sequence, *args,
 
     The strongest timing this library has against lying backends: each
     call gets a genuinely different first input, calls are dispatched
-    back-to-back (dispatch overlaps compute), every output folds into a
-    scalar accumulator, and the window closes with a host ``float()`` of
-    that accumulator — which cannot materialize before all the compute
-    ran (readiness-level lies included; see bench.py's methodology
-    notes). Pass ``warm_input`` (a throwaway input NOT in ``inputs``) to
-    warm/compile outside the window so no timed call repeats content the
-    backend has already served.
+    back-to-back (dispatch overlaps compute), the FIRST array leaf of
+    every output folds into a scalar accumulator, and the window closes
+    with a host ``float()`` of that accumulator — which cannot
+    materialize before the compute feeding those leaves ran
+    (readiness-level lies included; see bench.py's methodology notes).
+    NOTE the guarantee covers the dependency chain of each output's
+    first leaf; when ``fn`` is one jitted executable (the usual case)
+    that is the whole program, but outputs assembled from several
+    independent dispatches are only partially pinned. Pass
+    ``warm_input`` (a throwaway input NOT in ``inputs``) to warm/compile
+    outside the window so no timed call repeats content the backend has
+    already served.
     """
     import jax.numpy as jnp
 

@@ -80,10 +80,10 @@ def merge_pairs(gv, gi, flat, order, m: int, p: int, k: int):
     return out_v, jnp.where(jnp.isfinite(out_v), out_i, -1)
 
 
-def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, pen_ref, data_ref,
-            ov_ref, oi_ref, rows_vmem, sem,
+def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, pen_ref, scl_ref,
+            data_ref, ov_ref, oi_ref, rows_vmem, sem,
             *, k: int, kp: int, lmax: int, metric: str, precision: str,
-            has_pen: bool):
+            has_pen: bool, has_scales: bool):
     g = pl.program_id(0)
     off = offs_ref[g]
     size = sizes_ref[g]
@@ -99,16 +99,28 @@ def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, pen_ref, data_ref,
     copy.wait()
     rows = rows_vmem[:]                             # (lmax, dim_pad)
 
-    if rows.dtype == jnp.bfloat16:
-        # bf16 dataset mode: list rows stream at half the f32 HBM traffic;
-        # accumulate in f32 (ivf_flat per-dtype loadAndComputeDist role)
-        dot = jax.lax.dot_general(q.astype(jnp.bfloat16), rows,
+    if rows.dtype != jnp.float32:
+        # reduced-precision dataset modes (per-dtype loadAndComputeDist
+        # role): bf16 rows stream at half the f32 HBM traffic; int8/uint8
+        # at a quarter, widened in-register — byte values in [-128, 255]
+        # are exact in bf16 (8 significand bits), and int8 rows carry
+        # per-row quantization scales applied to the dot below. All
+        # accumulate f32. Mosaic has no direct byte→bf16 cast, so bytes
+        # widen through int32/f32 (register-only; no extra HBM traffic).
+        rows_b = rows
+        if rows_b.dtype in (jnp.int8, jnp.uint8):
+            rows_b = rows_b.astype(jnp.int32).astype(jnp.float32)
+        dot = jax.lax.dot_general(q.astype(jnp.bfloat16),
+                                  rows_b.astype(jnp.bfloat16),
                                   (((1,), (1,)), ((), ())),
                                   preferred_element_type=jnp.float32)
     else:
         dot = jax.lax.dot_general(q, rows, (((1,), (1,)), ((), ())),
                                   preferred_element_type=jnp.float32,
                                   precision=jax.lax.Precision(precision))
+    if has_scales:
+        # int8 per-row scales: q . dequant(r) == (q . r_int8) * s_r
+        dot = dot * scl_ref[0, 0]
     if metric == "l2":
         dist = jnp.maximum(qn + dn_ref[0, 0] - 2.0 * dot, 0.0)
     elif metric == "cos":
@@ -151,16 +163,19 @@ def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, pen_ref, data_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("k", "lmax", "n_groups", "metric", "interpret",
-                     "precision", "has_pen"))
-def _scan_groups(qblocks, qnorms, dnorm_slices, pen_slices, data, goffs,
-                 gsizes, k: int, lmax: int, n_groups: int, metric: str,
-                 interpret: bool, precision: str, has_pen: bool):
+                     "precision", "has_pen", "has_scales"))
+def _scan_groups(qblocks, qnorms, dnorm_slices, pen_slices, scale_slices,
+                 data, goffs, gsizes, k: int, lmax: int, n_groups: int,
+                 metric: str, interpret: bool, precision: str,
+                 has_pen: bool, has_scales: bool):
     kp = round_up_to(k, 128)
     dim_pad = qblocks.shape[2]
     kern = functools.partial(_kernel, k=k, kp=kp, lmax=lmax,
                              metric=metric, precision=precision,
-                             has_pen=has_pen)
+                             has_pen=has_pen, has_scales=has_scales)
     pen_map = (lambda g, o, s: (g, 0, 0)) if has_pen else (
+        lambda g, o, s: (0, 0, 0))
+    scl_map = (lambda g, o, s: (g, 0, 0)) if has_scales else (
         lambda g, o, s: (0, 0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -173,6 +188,7 @@ def _scan_groups(qblocks, qnorms, dnorm_slices, pen_slices, data, goffs,
             pl.BlockSpec((1, 1, lmax), lambda g, o, s: (g, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, lmax), pen_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, lmax), scl_map, memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),      # data stays in HBM
         ],
         out_specs=[
@@ -194,7 +210,8 @@ def _scan_groups(qblocks, qnorms, dnorm_slices, pen_slices, data, goffs,
             jax.ShapeDtypeStruct((n_groups, _QG, kp), jnp.int32),
         ],
         interpret=interpret,
-    )(goffs, gsizes, qblocks, qnorms, dnorm_slices, pen_slices, data)
+    )(goffs, gsizes, qblocks, qnorms, dnorm_slices, pen_slices,
+      scale_slices, data)
 
 
 def ivf_flat_scan(
@@ -210,21 +227,24 @@ def ivf_flat_scan(
     interpret: Optional[bool] = None,
     precision: str = "highest",
     penalty: Optional[jax.Array] = None,   # (n,) f32: +inf excludes a row
+    scales: Optional[jax.Array] = None,    # (n,) f32: int8 per-row scales
 ) -> Tuple[jax.Array, jax.Array]:
     """Scan probed lists → per-query k best (values, ROW ids into ``data``'s
     sorted order, -1 when fewer than k candidates); caller maps row ids to
-    source ids and applies metric postprocessing. ``penalty`` is indexed in
-    the same sorted row order as ``data`` (sample filters in-kernel).
+    source ids and applies metric postprocessing. ``penalty`` and
+    ``scales`` are indexed in the same sorted row order as ``data``
+    (sample filters / int8 dequantization in-kernel).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    data_p, norms_p = pad_for_scan(data, data_norms, lmax)
+    data_p, norms_p, scales_p = pad_for_scan(data, data_norms, lmax, scales)
     pen_p = None
     if penalty is not None:
         pen_p = jnp.pad(jnp.asarray(penalty, jnp.float32),
                         (0, scan_window(lmax)))
-    return _ivf_flat_scan_jit(data_p, norms_p, pen_p, probed, offsets, sizes,
-                              queries, k, lmax, metric, interpret, precision)
+    return _ivf_flat_scan_jit(data_p, norms_p, pen_p, scales_p, probed,
+                              offsets, sizes, queries, k, lmax, metric,
+                              interpret, precision)
 
 
 def scan_window(lmax: int) -> int:
@@ -234,26 +254,29 @@ def scan_window(lmax: int) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=("lmax",))
-def pad_for_scan(data, data_norms, lmax: int):
+def pad_for_scan(data, data_norms, lmax: int, scales=None):
     """Row/col-pad the dataset for the scan kernel's aligned DMA windows.
 
     A full-dataset copy — call once per index (callers cache the result),
-    not per search. bf16 datasets stay bf16 (the kernel accumulates f32)."""
+    not per search. bf16/int8/uint8 datasets keep their storage dtype (the
+    kernel accumulates f32; int8 rides per-row ``scales``)."""
     lmax_pad = scan_window(lmax)
     dim_pad = round_up_to(data.shape[1], 128)
     data = jnp.asarray(data)
-    if data.dtype != jnp.bfloat16:
+    if data.dtype not in (jnp.bfloat16, jnp.int8, jnp.uint8):
         data = data.astype(jnp.float32)
     data_p = jnp.pad(data, ((0, lmax_pad), (0, dim_pad - data.shape[1])))
     norms_p = jnp.pad(jnp.asarray(data_norms, jnp.float32), (0, lmax_pad))
-    return data_p, norms_p
+    scales_p = (None if scales is None else
+                jnp.pad(jnp.asarray(scales, jnp.float32), (0, lmax_pad)))
+    return data_p, norms_p, scales_p
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("k", "lmax", "metric", "interpret", "precision"))
-def _ivf_flat_scan_jit(data_p, norms_p, pen_p, probed, offsets, sizes,
-                       queries, k: int, lmax: int, metric: str,
+def _ivf_flat_scan_jit(data_p, norms_p, pen_p, scales_p, probed, offsets,
+                       sizes, queries, k: int, lmax: int, metric: str,
                        interpret: bool, precision: str):
     # one jit over grouping + kernel + merge: the grouping chain is ~20
     # gather/sort ops over ~100 MB intermediates, far too hot to dispatch
@@ -288,9 +311,15 @@ def _ivf_flat_scan_jit(data_p, norms_p, pen_p, probed, offsets, sizes,
     else:
         pen = jax.vmap(lambda o: jax.lax.dynamic_slice(
             pen_p, (o,), (lmax_pad,)))(goffs_al)[:, None, :]
+    if scales_p is None:
+        scl = jnp.ones((1, 1, lmax_pad), jnp.float32)
+    else:
+        scl = jax.vmap(lambda o: jax.lax.dynamic_slice(
+            scales_p, (o,), (lmax_pad,)))(goffs_al)[:, None, :]
 
-    gv, gi = _scan_groups(qblocks, qn, dn, pen, data_p, goffs, gsizes, k,
-                          lmax_pad, int(n_groups), metric, interpret,
-                          precision, pen_p is not None)
+    gv, gi = _scan_groups(qblocks, qn, dn, pen, scl, data_p, goffs, gsizes,
+                          k, lmax_pad, int(n_groups), metric, interpret,
+                          precision, pen_p is not None,
+                          scales_p is not None)
 
     return merge_pairs(gv, gi, flat, order, m, p, k)

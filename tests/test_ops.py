@@ -117,6 +117,31 @@ class TestIvfScanParity:
             np.testing.assert_allclose(np.asarray(dp), np.asarray(dx),
                                        rtol=1e-3, atol=1e-3)
 
+    def test_ivf_flat_pallas_byte_dtypes_match_xla(self):
+        """int8 (per-row scales in-kernel) and uint8 (exact bytes) must
+        track the XLA gather path through the pallas scan."""
+        from raft_tpu.neighbors import ivf_flat
+
+        rng = np.random.default_rng(23)
+        data = rng.standard_normal((2000, 40)).astype(np.float32)
+        q = rng.standard_normal((25, 40)).astype(np.float32)
+        bdata = np.round(np.clip(data * 40 + 128, 0, 255)).astype(np.float32)
+        bq = np.round(np.clip(q * 40 + 128, 0, 255)).astype(np.float32)
+        for dtype, dd, qq, id_floor in (("int8", data, q, 0.9),
+                                        ("uint8", bdata, bq, 0.999)):
+            index = ivf_flat.build(dd, ivf_flat.IndexParams(
+                n_lists=16, seed=0, dtype=dtype))
+            dx, ix = ivf_flat.search(index, qq, 8,
+                                     ivf_flat.SearchParams(n_probes=16),
+                                     algo="xla")
+            dp, ip = ivf_flat.search(index, qq, 8,
+                                     ivf_flat.SearchParams(n_probes=16),
+                                     algo="pallas")
+            match = np.mean(np.asarray(ip) == np.asarray(ix))
+            assert match > id_floor, (dtype, match)
+            np.testing.assert_allclose(np.asarray(dp), np.asarray(dx),
+                                       rtol=5e-2, atol=5e-1)
+
     def test_ivf_pq_pallas_matches_xla(self):
         import jax.numpy as jnp
 
