@@ -37,7 +37,7 @@ def refine(
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact re-rank: (m, c) candidate ids → (m, k) distances + ids."""
     x = jnp.asarray(dataset)
-    if x.dtype != jnp.bfloat16:
+    if x.dtype not in (jnp.bfloat16, jnp.uint8):
         x = x.astype(jnp.float32)
     q = jnp.asarray(queries, jnp.float32)
     cand = jnp.asarray(candidates, jnp.int32)
@@ -53,6 +53,10 @@ def refine(
     valid = cand >= 0
     rows = jnp.where(valid, cand, 0)
     vecs = x[rows]                                   # (m, c, d)
+    if vecs.dtype == jnp.uint8:
+        # byte corpora: the win is the quarter-traffic GATHER; widen to
+        # f32 after it so the re-rank stays exact for any f32 queries
+        vecs = vecs.astype(jnp.float32)
     bf16 = vecs.dtype == jnp.bfloat16
     if bf16:
         ip = jnp.einsum("mcd,md->mc", vecs, q.astype(jnp.bfloat16),
