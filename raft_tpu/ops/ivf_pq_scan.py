@@ -59,8 +59,11 @@ def pq_chunk_rows(pq_dim: int, book: int,
     """Row-chunk bound for ops whose per-row cost is a (pq_dim, book)
     f32 plane (the per-subspace encode argmin, and the codebook gather
     that XLA lowers through a one-hot contraction on TPU): an unbounded
-    pass at 500k×pq64×book256 is ~33 GB and exhausts HBM."""
-    return max(4096, budget_bytes // max(pq_dim * book * 4, 1))
+    pass at 500k×pq64×book256 is ~33 GB and exhausts HBM. Also capped at
+    256k rows regardless of the byte budget — small (pq_dim, book)
+    planes otherwise admit half-million-row single-chunk programs that
+    crash the tunnel's compile helper (observed at pq64×book16)."""
+    return max(4096, min(1 << 18, budget_bytes // max(pq_dim * book * 4, 1)))
 
 
 @jax.jit

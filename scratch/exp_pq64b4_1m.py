@@ -1,0 +1,83 @@
+"""Lead check: pq64b4 (half the decode FLOPs and half the code bytes of
+pq128b4) at the 1M two-part bench shape. Recall is probe-limited on this
+corpus, so the coarser codebook may cost nothing after refine."""
+import json, os, sys, time
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/raft_tpu_xla_cache")
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+import numpy as np
+from raft_tpu.neighbors import brute_force, ivf_pq, refine
+
+def log(m): print(m, file=sys.stderr, flush=True)
+
+n, d, nq, k, part_n, di = 1_000_000, 128, 10_000, 10, 500_000, 16
+kw, kc, kx, ka, kq, kp, ke, kf = jax.random.split(jax.random.PRNGKey(0), 8)
+w = jax.random.normal(kw, (di, d)); w = w / jnp.linalg.norm(w, axis=1, keepdims=True)
+cz = jax.random.normal(kc, (200, di))
+z = cz[jax.random.randint(ka, (n,), 0, 200)] + jax.random.normal(kx, (n, di))
+data = z @ w + 0.1 * jax.random.normal(ke, (n, d))
+qz = cz[jax.random.randint(kq, (nq,), 0, 200)] + jax.random.normal(kp, (nq, di))
+queries = qz @ w + 0.1 * jax.random.normal(kf, (nq, d))
+jax.block_until_ready((data, queries))
+parts = [data[:part_n], data[part_n:]]
+offsets = [0, part_n]
+
+bfs = [brute_force.build(p, metric="sqeuclidean") for p in parts]
+gt_fn = jax.jit(lambda q, idx: brute_force.search(idx, q, k, algo="matmul"))
+merge = jax.jit(lambda dv, iv: brute_force.knn_merge_parts(dv, iv, True))
+def exact(qs):
+    ds, is_ = [], []
+    for bfi, off in zip(bfs, offsets):
+        dd, ii = gt_fn(qs, bfi)
+        ds.append(dd); is_.append(jnp.where(ii >= 0, ii + off, -1))
+    return merge(jnp.stack(ds), jnp.stack(is_))
+gt = jnp.concatenate([jax.block_until_ready(exact(queries[c:c+1000])[1])
+                      for c in range(0, nq, 1000)])
+del bfs
+log("# gt done")
+
+def recall(ids):
+    hit = jnp.any(ids[:, :, None] == gt[:, None, :], axis=2) & (gt >= 0)
+    return float(jnp.sum(hit) / jnp.sum(gt >= 0))
+
+def wall(tp, calls=6):
+    from raft_tpu.ops.autotune import measure_value_read_wall
+    perms = [jnp.take(queries, jax.random.permutation(
+        jax.random.PRNGKey(100 + i), nq), axis=0) for i in range(calls + 1)]
+    jax.block_until_ready(perms)
+    return measure_value_read_wall(tp, perms[:-1], warm_input=perms[-1])
+
+parts_bf16 = [jnp.asarray(p, jnp.bfloat16) for p in parts]
+jax.block_until_ready(parts_bf16)
+out = {}
+for name, pqd in (("pq64b4", 64), ("pq128b4", 128)):
+    t0 = time.perf_counter()
+    pis = [ivf_pq.build(p, ivf_pq.IndexParams(n_lists=1024, pq_dim=pqd,
+                                              pq_bits=4, seed=0))
+           for p in parts]
+    jax.block_until_ready(jax.tree.leaves(pis))
+    bs = time.perf_counter() - t0
+    for pi in pis:
+        ivf_pq.prepare_scan(pi)
+    log(f"# {name} built {bs:.0f}s")
+    for probes, ratio in ((20, 2), (20, 4)):
+        sp = ivf_pq.SearchParams(n_probes=probes, lut_dtype="int8")
+        def body(q, idx, dd, s=sp, r=ratio):
+            _, cand = ivf_pq.search(idx, q, r * k, s)
+            return refine.refine(dd, q, cand, k)
+        fn = jax.jit(body)
+        def tp(q, *_):
+            ds, is_ = [], []
+            for pi, pb, off in zip(pis, parts_bf16, offsets):
+                dd, ii = fn(q, pi, pb)
+                ds.append(dd); is_.append(jnp.where(ii >= 0, ii + off, -1))
+            return merge(jnp.stack(ds), jnp.stack(is_))
+        dt = wall(tp)
+        r = recall(tp(queries)[1])
+        out[f"{name}_np{probes}_r{ratio}"] = dict(ms=dt*1e3, qps=nq/dt,
+                                                  recall=r, build_s=bs)
+        log(f"# {name} np{probes} r{ratio}: {dt*1e3:.1f}ms "
+            f"({nq/dt:,.0f} qps) r={r:.4f}")
+    del pis
+
+print(json.dumps(out, indent=1))

@@ -276,8 +276,9 @@ class TestRefine:
         assert calc_recall(np.asarray(idx), want_i) >= 0.98
 
     def test_refine_uint8_dataset(self):
-        """Byte corpora re-rank exactly through the uint8 gather path
-        (quarter traffic; [0,255] exact in bf16)."""
+        """Byte corpora re-rank exactly through the uint8 gather path:
+        quarter-traffic gather, widened to f32 AFTER the gather so the
+        exact f32 contraction still runs."""
         import jax.numpy as jnp
 
         rng = np.random.default_rng(9)
