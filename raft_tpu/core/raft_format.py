@@ -13,20 +13,38 @@ sequence of ``read_array`` calls mirroring the C++ field order:
   codebook_kind, n_lists; pq_centers, centers [n_lists, dim_ext],
   centers_rot, rotation_matrix; list_sizes u32; then per list: size
   scalar + interleaved code array + indices.
-* IVF-Flat — detail/ivf_flat_serialize.cuh:59-92 (version 4): version,
-  size, dim, n_lists, metric, adaptive_centers, conservative, centers,
-  has_norms(+norms), list_sizes; per-list interleaved rows + indices.
-* CAGRA — detail/cagra/cagra_serialize.cuh:61-82 (version 4): version,
-  size, dim, graph_degree, metric, graph [n, degree], include_dataset
-  (+dataset).
+* IVF-Flat — detail/ivf_flat_serialize.cuh:54-92 (version 4): a 4-byte
+  numpy dtype tag for T (``"<f4\\0"`` — serialize:54-57 writes
+  ``dtype_string.resize(4)``), then version, size, dim, n_lists, metric,
+  adaptive_centers, conservative, centers, has_norms(+norms),
+  list_sizes; per list ``ivf::serialize_list`` (ivf_list.hpp:120-148)
+  with ``size_override = Pow2<32>::roundUp(size)``: the ROUNDED size
+  scalar, a 2-D ``(rounded, dim)`` data frame whose raw bytes are the
+  interleaved in-memory layout (make_list_extents is flat —
+  ivf_flat_types.hpp:114-117), and a ``rounded``-long indices frame
+  (padding entries hold kInvalidRecord, ivf_list_types.hpp:33-35).
+* CAGRA — detail/cagra/cagra_serialize.cuh:33-83 (version **3**): the
+  same 4-byte dtype tag, then version, size, dim, graph_degree, metric,
+  graph [n, degree], include_dataset (+dataset).
+
+IVF-PQ files carry NO dtype tag (codes are always u8) and serialize
+lists with the UNROUNDED size and the 4-D interleaved extent
+(ivf_pq_serialize.cuh:85, ivf_pq_types.hpp:204-212).
 
 List payloads use the reference's interleaved group layout
 (ivf_pq_types.hpp:166-214 / ivf_flat_types.hpp:114-166): rows grouped by
 ``kIndexGroupSize``=32, components chunked by a 16-byte vector
 (``kIndexGroupVecLen``; PQ codes are a little-endian bitfield inside
 each 16-byte chunk — detail/ivf_pq_codepacking.cuh bitfield_view_t).
-The decoders below invert that layout with vectorized numpy; the
-writers produce files the reference can load, tested by round-trip.
+The decoders below invert that layout with vectorized numpy. The
+loaders are pinned by byte-level goldens built with an independent
+in-test reimplementation of the reference's write_header; the writers
+are pinned frame-for-frame against those goldens — same field order,
+scalar dtypes, shapes, and payload bytes (tests/test_raft_format.py::
+TestReferenceWireFormat). Whole-file bytes may differ from C++ output
+only in npy header whitespace (numpy emits a trailing ", " in the
+header dict; RAFT's parser tolerates it and vice versa) — self-
+round-trips alone cannot validate a wire format.
 """
 from __future__ import annotations
 
@@ -47,8 +65,9 @@ __all__ = [
 _GROUP = 32          # kIndexGroupSize
 _VEC = 16            # kIndexGroupVecLen (bytes)
 
-# reference enum values (distance/distance_types.hpp:26-66), stored as
-# u2 scalars in the files
+# reference enum values (distance/distance_types.hpp:26-66); enums
+# serialize via their underlying int -> i4 frames
+# (mdspan_numpy_serializer.hpp:147-151)
 _METRIC_BY_INT = {
     0: DistanceType.L2Expanded,
     1: DistanceType.L2SqrtExpanded,
@@ -93,6 +112,31 @@ def _open(path_or_file, mode: str):
     if hasattr(path_or_file, "read") or hasattr(path_or_file, "write"):
         return path_or_file, False
     return open(path_or_file, mode), True
+
+
+def _read_dtype_tag(f: BinaryIO) -> np.dtype:
+    """The 4-byte numpy dtype tag (``"%c%c%u"`` + NUL padding) RAFT puts
+    before the first frame of IVF-Flat / CAGRA files
+    (mdspan_numpy_serializer.hpp:89-94, ivf_flat_serialize.cuh:54-57)."""
+    raw = f.read(4)
+    expects(len(raw) == 4, "truncated dtype tag")
+    try:
+        return np.dtype(raw.rstrip(b"\0").decode("ascii"))
+    except (TypeError, ValueError, UnicodeDecodeError):
+        expects(False, "bad dtype tag %r — not a RAFT-native file (files "
+                "written before the r5 wire-format fix carry no tag)", raw)
+
+
+def _write_dtype_tag(f: BinaryIO, dtype: np.dtype) -> None:
+    dt = np.dtype(dtype)
+    expects(dt.kind in "fiu", "no RAFT dtype tag for %s", dt)
+    byteorder = "|" if dt.itemsize == 1 else "<"
+    tag = f"{byteorder}{dt.kind}{dt.itemsize}".encode("ascii")
+    f.write(tag.ljust(4, b"\0"))
+
+
+def _round_up(n: int, align: int) -> int:
+    return -(-n // align) * align
 
 
 # --------------------------------------------------------------------------
@@ -235,8 +279,8 @@ def save_raft_ivf_pq(index, path_or_file) -> None:
         _write(f, np.uint32(index.dim))
         _write(f, np.uint32(index.pq_bits))
         _write(f, np.uint32(index.pq_dim))
-        _write(f, np.bool_(False))      # conservative_memory_allocation
-        _write(f, np.array(_INT_BY_METRIC[index.metric], np.uint16))
+        _write(f, np.uint8(0))          # conservative_memory_allocation
+        _write(f, np.int32(_INT_BY_METRIC[index.metric]))
         _write(f, np.int32(index.codebook_kind.value))
         _write(f, np.uint32(index.n_lists))
 
@@ -286,6 +330,7 @@ def load_raft_ivf_flat(path_or_file):
 
     f, close = _open(path_or_file, "rb")
     try:
+        dtype = _read_dtype_tag(f)
         ver = int(_read(f))
         expects(ver == 4, "unsupported RAFT ivf_flat serialization "
                 "version %d (expected 4, RAFT 24.02)", ver)
@@ -300,14 +345,31 @@ def load_raft_ivf_flat(path_or_file):
         center_norms = _read(f) if has_norms else None
         list_sizes = np.asarray(_read(f), np.int64)
 
+        # calculate_veclen (ivf_flat_types.hpp:385-395)
+        veclen = max(1, 16 // dtype.itemsize)
+        if dim % veclen != 0:
+            veclen = 1
+
         rows_parts, ids_parts = [], []
         for label in range(n_lists):
-            sz = int(_read(f))
-            if sz == 0:
+            rounded = int(_read(f))   # Pow2<32>::roundUp(list size)
+            if rounded == 0:
                 continue
-            data = _read(f)
-            inds = _read(f)
-            rows_parts.append(_unpack_interleaved_rows(data, sz))
+            sz = int(list_sizes[label])
+            expects(rounded == _round_up(sz, _GROUP),
+                    "list %d rounded size %d inconsistent with "
+                    "list_sizes %d", label, rounded, sz)
+            data = _read(f)           # 2-D (rounded, dim) frame of T whose
+            expects(data.shape == (rounded, dim),
+                    "list %d data frame shape %s != (%d, %d)", label,
+                    tuple(data.shape), rounded, dim)
+            expects(data.dtype == dtype, "list %d frame dtype %s != tag %s",
+                    label, data.dtype, dtype)
+            inds = _read(f)           # rounded-long; tail = kInvalidRecord
+            # raw bytes ARE the interleaved group layout; reinterpret
+            interleaved = np.ascontiguousarray(data).reshape(
+                rounded // _GROUP, dim // veclen, _GROUP, veclen)
+            rows_parts.append(_unpack_interleaved_rows(interleaved, sz))
             ids_parts.append(np.asarray(inds[:sz], np.int64))
         rows = (np.concatenate(rows_parts) if rows_parts
                 else np.zeros((0, dim), np.float32))
@@ -356,25 +418,34 @@ def save_raft_ivf_flat(index, path_or_file) -> None:
         # not a multiple of it
         veclen = 4 if dim % 4 == 0 else 1
         sizes = index.list_sizes
+        _write_dtype_tag(f, np.float32)
         _write(f, np.int32(4))
         _write(f, np.int64(index.size))
         _write(f, np.uint32(dim))
         _write(f, np.uint32(index.n_lists))
-        _write(f, np.array(_INT_BY_METRIC[index.metric], np.uint16))
-        _write(f, np.bool_(False))      # adaptive_centers
-        _write(f, np.bool_(index.conservative_memory))
+        _write(f, np.int32(_INT_BY_METRIC[index.metric]))
+        _write(f, np.uint8(0))          # adaptive_centers
+        _write(f, np.uint8(int(index.conservative_memory)))
         _write(f, np.asarray(index.centers, np.float32))
-        _write(f, np.bool_(True))
+        _write(f, np.uint8(1))
         _write(f, np.asarray(index.center_norms, np.float32))
         _write(f, np.asarray(sizes, np.uint32))
         off = 0
         for label in range(index.n_lists):
             sz = int(sizes[label])
-            _write(f, np.uint32(sz))
+            rounded = _round_up(sz, _GROUP)
+            _write(f, np.uint32(rounded))
             if sz == 0:
                 continue
-            _write(f, _pack_interleaved_rows(rows[off : off + sz], veclen))
-            _write(f, np.asarray(ids[off : off + sz], np.int64))
+            # interleave, then emit as the flat (rounded, dim) frame the
+            # reference memcpys (make_list_extents, ivf_flat_types.hpp:114)
+            packed = _pack_interleaved_rows(rows[off : off + sz], veclen)
+            _write(f, packed.reshape(rounded, dim))
+            # indices padded to rounded with kInvalidRecord (= -1 for
+            # signed IdxT, ivf_list_types.hpp:33-35)
+            inds = np.full(rounded, -1, np.int64)
+            inds[:sz] = ids[off : off + sz]
+            _write(f, inds)
             off += sz
     finally:
         if close:
@@ -395,9 +466,10 @@ def load_raft_cagra(path_or_file, dataset: Optional[np.ndarray] = None):
 
     f, close = _open(path_or_file, "rb")
     try:
+        _dtype = _read_dtype_tag(f)
         ver = int(_read(f))
-        expects(ver == 4, "unsupported RAFT cagra serialization version "
-                "%d (expected 4, RAFT 24.02)", ver)
+        expects(ver == 3, "unsupported RAFT cagra serialization version "
+                "%d (expected 3, RAFT 24.02)", ver)
         n = int(_read(f))
         dim = int(_read(f))
         _degree = int(_read(f))
@@ -419,17 +491,20 @@ def load_raft_cagra(path_or_file, dataset: Optional[np.ndarray] = None):
 
 def save_raft_cagra(index, path_or_file, include_dataset: bool = True
                     ) -> None:
-    """:class:`cagra.Index` → a version-4 reference-layout file."""
+    """:class:`cagra.Index` → a version-3 reference-layout file."""
     f, close = _open(path_or_file, "wb")
     try:
         n, degree = index.graph.shape
-        _write(f, np.int32(4))
-        _write(f, np.int64(n))
+        _write_dtype_tag(f, np.float32)
+        _write(f, np.int32(3))
+        # pylibraft instantiates cagra::index<T, uint32_t> (c_cagra.pxd:117)
+        # so size() serializes as a u4 scalar, unlike ivf_flat's int64
+        _write(f, np.uint32(n))
         _write(f, np.uint32(index.dataset.shape[1]))
         _write(f, np.uint32(degree))
-        _write(f, np.array(_INT_BY_METRIC[index.metric], np.uint16))
+        _write(f, np.int32(_INT_BY_METRIC[index.metric]))
         _write(f, np.asarray(index.graph, np.uint32))
-        _write(f, np.bool_(include_dataset))
+        _write(f, np.uint8(int(include_dataset)))
         if include_dataset:
             _write(f, np.asarray(index.dataset, np.float32))
     finally:

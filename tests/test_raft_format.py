@@ -1,8 +1,17 @@
 """RAFT-native index file interop (core/raft_format.py): round-trips
 through the reference's npy-frame serialization layout
 (detail/ivf_pq_serialize.cuh, ivf_flat_serialize.cuh, cagra_serialize.cuh)
-and unit checks of the interleaved bitfield codecs."""
+and unit checks of the interleaved bitfield codecs.
+
+``TestReferenceWireFormat`` holds BYTE-LEVEL goldens: an independent
+in-test writer reproduces the C++ serializer's exact byte stream
+(write_header, mdspan_numpy_serializer.hpp:316-341: magic, 1.0 version,
+le16 HEADER_LEN, dict WITHOUT numpy's trailing ", ", 64-byte space
+padding + newline) so round-trips cannot self-validate a wrong layout —
+the r4 advisor found exactly that failure mode."""
+import ast
 import io
+import struct
 
 import jax.numpy as jnp
 import numpy as np
@@ -156,3 +165,192 @@ class TestCagraFile:
         loaded = rf.load_raft_cagra(buf, dataset=dataset)
         np.testing.assert_array_equal(np.asarray(loaded.graph),
                                       np.asarray(index.graph))
+
+
+# --------------------------------------------------------------------------
+# byte-level goldens against the C++ wire format
+# --------------------------------------------------------------------------
+
+def cxx_frame(descr: str, shape: tuple, payload: bytes) -> bytes:
+    """One npy frame EXACTLY as the reference's write_header emits it
+    (mdspan_numpy_serializer.hpp:316-341): no trailing comma in the
+    dict, 64-byte-aligned space padding, trailing newline."""
+    if len(shape) == 0:
+        shp = "()"
+    elif len(shape) == 1:
+        shp = "(%d,)" % shape[0]
+    else:
+        shp = "(" + ", ".join(str(s) for s in shape) + ")"
+    d = "{'descr': '%s', 'fortran_order': False, 'shape': %s}" % (descr, shp)
+    preamble = 6 + 2 + 2 + len(d) + 1
+    pad = 64 - preamble % 64
+    body = d + " " * pad + "\n"
+    return (b"\x93NUMPY" + bytes([1, 0])
+            + struct.pack("<H", len(body)) + body.encode("ascii") + payload)
+
+
+def cxx_scalar(value, np_dtype) -> bytes:
+    a = np.asarray(value, np_dtype)
+    return cxx_frame(a.dtype.str if a.dtype.itemsize > 1
+                     else "|" + a.dtype.str[1:], (), a.tobytes())
+
+
+def cxx_mdspan(arr: np.ndarray) -> bytes:
+    dt = arr.dtype
+    descr = dt.str if dt.itemsize > 1 else "|" + dt.str[1:]
+    return cxx_frame(descr, arr.shape, np.ascontiguousarray(arr).tobytes())
+
+
+def interleave_flat_cxx(rows: np.ndarray, veclen: int) -> np.ndarray:
+    """Plain-loop independent encoder of the in-memory interleaved group
+    layout (ivf_flat_types.hpp:114-166): row r, component j lives at
+    [r//32][j//veclen][r%32][j%veclen]. Input is already padded to a
+    multiple of 32 rows; returns the flat (rounded, dim) frame view."""
+    rounded, dim = rows.shape
+    out = np.zeros((rounded // 32, dim // veclen, 32, veclen), rows.dtype)
+    for r in range(rounded):
+        for j in range(dim):
+            out[r // 32, j // veclen, r % 32, j % veclen] = rows[r, j]
+    return out.reshape(rounded, dim)
+
+
+def walk_frames(raw: bytes, offset: int = 0):
+    """Parse a byte stream into [(descr, shape, payload bytes)] without
+    numpy's reader, so header-format differences can't mask a bug."""
+    frames = []
+    i = offset
+    while i < len(raw):
+        assert raw[i : i + 6] == b"\x93NUMPY", f"bad magic at {i}"
+        assert raw[i + 6 : i + 8] == bytes([1, 0])
+        (hlen,) = struct.unpack("<H", raw[i + 8 : i + 10])
+        header = ast.literal_eval(raw[i + 10 : i + 10 + hlen]
+                                  .decode("ascii").strip())
+        shape = header["shape"]
+        n = int(np.prod(shape)) if shape else 1
+        itemsize = int(header["descr"][2:])
+        start = i + 10 + hlen
+        frames.append((header["descr"], shape,
+                       raw[start : start + n * itemsize]))
+        i = start + n * itemsize
+    return frames
+
+
+@pytest.fixture(scope="module")
+def flat_golden():
+    """A reference-style .ivf_flat byte stream built independently:
+    dim=8 (f32 veclen=4), n_lists=3, sizes [5, 0, 37] — exercises the
+    32-row rounding (5→32, 37→64), an empty list, and index padding."""
+    rng = np.random.default_rng(7)
+    dim, n_lists = 8, 3
+    sizes = [5, 0, 37]
+    rows = [rng.standard_normal((s, dim)).astype(np.float32)
+            for s in sizes]
+    ids = [np.arange(100 * i, 100 * i + s, dtype=np.int64)
+           for i, s in enumerate(sizes)]
+    centers = rng.standard_normal((n_lists, dim)).astype(np.float32)
+    norms = (centers * centers).sum(1).astype(np.float32)
+
+    blob = b"<f4\0"                                  # dtype tag
+    blob += cxx_scalar(4, np.int32)                  # version
+    blob += cxx_scalar(sum(sizes), np.int64)         # size (IdxT=int64)
+    blob += cxx_scalar(dim, np.uint32)
+    blob += cxx_scalar(n_lists, np.uint32)
+    blob += cxx_scalar(0, np.int32)                  # metric L2Expanded: i4
+    blob += cxx_scalar(0, np.uint8)                  # adaptive: bool -> u1
+    blob += cxx_scalar(0, np.uint8)                  # conservative
+    blob += cxx_mdspan(centers)
+    blob += cxx_scalar(1, np.uint8)                  # has_norms
+    blob += cxx_mdspan(norms)
+    blob += cxx_mdspan(np.asarray(sizes, np.uint32))
+    for li, s in enumerate(sizes):
+        rounded = -(-s // 32) * 32
+        blob += cxx_scalar(rounded, np.uint32)       # roundUp'd scalar
+        if s == 0:
+            continue
+        padded = np.zeros((rounded, dim), np.float32)
+        padded[:s] = rows[li]
+        blob += cxx_mdspan(interleave_flat_cxx(padded, veclen=4))
+        inds = np.full(rounded, -1, np.int64)        # kInvalidRecord
+        inds[:s] = ids[li]
+        blob += cxx_mdspan(inds)
+    return blob, rows, ids, centers, sizes
+
+
+class TestReferenceWireFormat:
+    def test_flat_load_reference_bytes(self, flat_golden):
+        blob, rows, ids, centers, sizes = flat_golden
+        idx = rf.load_raft_ivf_flat(io.BytesIO(blob))
+        assert idx.n_lists == 3 and idx.size == sum(sizes)
+        np.testing.assert_array_equal(np.asarray(idx.centers), centers)
+        got_rows = np.asarray(idx.data)
+        got_ids = np.asarray(idx.source_ids)
+        off = 0
+        for li, s in enumerate(sizes):
+            lo = int(idx.list_offsets[li])
+            np.testing.assert_array_equal(got_rows[lo : lo + s], rows[li])
+            np.testing.assert_array_equal(got_ids[lo : lo + s],
+                                          ids[li].astype(np.int32))
+            off += s
+
+    def test_flat_save_matches_reference_bytes(self, flat_golden):
+        """save() of the loaded golden reproduces the reference stream
+        frame for frame: same 4-byte tag, same scalar DTYPES (i4 metric,
+        u1 bools, u4 rounded list sizes), same interleaved payload bytes
+        including the kInvalidRecord index padding."""
+        blob, *_ = flat_golden
+        idx = rf.load_raft_ivf_flat(io.BytesIO(blob))
+        buf = io.BytesIO()
+        rf.save_raft_ivf_flat(idx, buf)
+        ours = buf.getvalue()
+        assert ours[:4] == blob[:4] == b"<f4\0"
+        want = walk_frames(blob, offset=4)
+        got = walk_frames(ours, offset=4)
+        assert len(got) == len(want)
+        for k, ((d1, s1, p1), (d2, s2, p2)) in enumerate(zip(want, got)):
+            assert d2 == d1, f"frame {k}: descr {d2} != {d1}"
+            assert tuple(s2) == tuple(s1), f"frame {k}: shape {s2}!={s1}"
+            assert p2 == p1, f"frame {k}: payload differs"
+
+    def test_cagra_load_reference_bytes(self):
+        rng = np.random.default_rng(8)
+        n, dim, degree = 10, 4, 3
+        ds = rng.standard_normal((n, dim)).astype(np.float32)
+        graph = rng.integers(0, n, (n, degree)).astype(np.uint32)
+        blob = b"<f4\0"
+        blob += cxx_scalar(3, np.int32)          # serialization_version=3
+        blob += cxx_scalar(n, np.uint32)         # size: IdxT=uint32
+        blob += cxx_scalar(dim, np.uint32)
+        blob += cxx_scalar(degree, np.uint32)
+        blob += cxx_scalar(0, np.int32)          # metric
+        blob += cxx_mdspan(graph)
+        blob += cxx_scalar(1, np.uint8)          # include_dataset
+        blob += cxx_mdspan(ds)
+        idx = rf.load_raft_cagra(io.BytesIO(blob))
+        np.testing.assert_array_equal(np.asarray(idx.graph), graph)
+        np.testing.assert_array_equal(np.asarray(idx.dataset), ds)
+
+        buf = io.BytesIO()
+        rf.save_raft_cagra(idx, buf)
+        ours = buf.getvalue()
+        assert ours[:4] == b"<f4\0"
+        want = walk_frames(blob, offset=4)
+        got = walk_frames(ours, offset=4)
+        assert len(got) == len(want)
+        for k, ((d1, s1, p1), (d2, s2, p2)) in enumerate(zip(want, got)):
+            assert d2 == d1, f"frame {k}: descr {d2} != {d1}"
+            assert tuple(s2) == tuple(s1)
+            assert p2 == p1, f"frame {k}: payload differs"
+
+    def test_pq_scalar_widths(self, dataset):
+        """IVF-PQ: NO dtype tag; enum/bool scalar frames carry the C++
+        widths (i4 metric + codebook_kind, u1 conservative flag)."""
+        index = ivf_pq.build(dataset, ivf_pq.IndexParams(
+            n_lists=4, pq_dim=8, seed=0))
+        buf = io.BytesIO()
+        rf.save_raft_ivf_pq(index, buf)
+        raw = buf.getvalue()
+        assert raw[:6] == b"\x93NUMPY"            # no tag: frame 0 starts
+        frames = walk_frames(raw)
+        descrs = [f[0] for f in frames[:9]]
+        assert descrs == ["<i4", "<i8", "<u4", "<u4", "<u4",
+                          "|u1", "<i4", "<i4", "<u4"]
