@@ -1,20 +1,36 @@
 """On-device roofline probe: measured peaks, not assumed ones.
 
-The bench reports kernel throughput as a fraction of the *measured* peak of
-the device actually in use (matmul TFLOP/s, HBM stream GB/s), because
-assumed per-generation limits (e.g. v5e datasheet numbers) can be off by
+The bench reports kernel throughput as a fraction of the *measured* peak
+of the device actually in use (matmul TFLOP/s, HBM stream GB/s, random-
+row gather GB/s), because assumed per-generation limits can be off by
 orders of magnitude under remote/tunneled or simulated backends.
 
-Methodology: every probe runs its hot op ``iters`` times INSIDE one
-compiled program (``lax.fori_loop`` with an iteration-dependent,
-non-foldable carry), so the device window is hundreds of milliseconds and
-the tunnel's ~90 ms dispatch round trip (see ``dispatch_us``) amortizes
-away — a single 8192³ matmul is ~6 ms of MXU time and would otherwise
-read as ~12 TFLOP/s on a chip whose true bf16 peak is an order of
-magnitude higher. Timing is ``ops.autotune.measure`` (per-call blocked,
-median); inputs are generated on device — host↔device transfer never
-enters the timing. The loop carry feeds every iteration from the previous
-one, so no iteration can be elided or hoisted.
+Methodology (r5, replacing the r4 single-point probes): every probe runs
+the SAME one-dispatch ``lax.fori_loop`` program at TWO iteration counts
+``(i1, i2)`` and fits the slope
+
+    per_iter_s = (t(i2) - t(i1)) / (i2 - i1)
+
+so every per-dispatch constant — the tunnel's ~100 ms round trip, infeed,
+program setup, clock ramp-up at the window edge — cancels exactly instead
+of polluting the rate. Timing is ``autotune.measure_value_read_wall``
+(content-distinct inputs; the window closes with a host ``float()`` of a
+scalar folded from every output — the repo's strongest anti-replay
+timing). Loop carries feed each iteration from the previous one, so no
+iteration can be elided or hoisted.
+
+This rewrite exists because the r4 probe read 74 GB/s HBM against an
+819 GB/s v5e datasheet: with only 8-64 GB of traffic behind a ~0.15 s
+per-dispatch constant, the constant dominated the division. The slope
+method on the same device reads ~657 GB/s stream / ~175 TFLOP/s bf16 /
+~48 GB/s random-row gather (scratch/exp_hbm_probe_r5.json) — numbers at
+80-89% of datasheet that re-rate every "bandwidth-bound" analysis in the
+repo. The matmul slope must use iteration counts ≥64: below that the
+per-iteration time itself is nonlinear (ramp effects) and a small-iters
+pair over-reads by ~3x.
+
+Reference analog: the tiled brute-force design is sized against real
+measured HBM (detail/knn_brute_force.cuh:61).
 """
 from __future__ import annotations
 
@@ -23,70 +39,119 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
-from ..ops.autotune import measure as _median_time
+from ..ops.autotune import measure_value_read_wall
 
-__all__ = ["probe", "matmul_tflops", "hbm_stream_gbps", "dispatch_us"]
+__all__ = ["probe", "matmul_tflops", "hbm_stream_gbps", "gather_gbps",
+           "dispatch_us"]
 
 
-def matmul_tflops(n: int = 8192, dtype=jnp.bfloat16, reps: int = 5,
-                  iters: int = 32) -> float:
-    """Sustained TFLOP/s of ``iters`` chained n×n×n matmuls in one program.
+def _slope(make_fn, make_inputs, i1: int, i2: int) -> float:
+    """Per-iteration seconds from a two-point fit of t(iters)."""
+    times = {}
+    for iters in (i1, i2):
+        fn = make_fn(iters)
+        ins = make_inputs(3)      # warm + 2 timed, all content-distinct
+        times[iters] = measure_value_read_wall(fn, ins[1:],
+                                               warm_input=ins[0])
+    return (times[i2] - times[i1]) / (i2 - i1)
 
-    The chain c ← c @ (b/√n) keeps magnitudes stable (b ~ N(0,1), so
-    b/√n has unit spectral scale in expectation) and makes every matmul
-    depend on the previous one — XLA cannot drop or reorder iterations.
-    """
-    key = jax.random.PRNGKey(0)
-    a = jax.random.normal(key, (n, n), jnp.float32).astype(dtype)
+
+def matmul_tflops(n: int = 8192, dtype=jnp.bfloat16,
+                  i1: int = 64, i2: int = 192) -> float:
+    """Sustained TFLOP/s of chained n×n×n matmuls, slope-fitted.
+
+    The chain c ← c @ (b/√n) keeps magnitudes stable and makes every
+    matmul depend on the previous one — XLA cannot drop iterations."""
     b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32)
     bs = (b / jnp.sqrt(float(n))).astype(dtype)
 
-    @jax.jit
-    def f(a, bs):
-        def body(_, c):
-            return jax.lax.dot_general(
-                c, bs, (((1,), (0,)), ((), ())),
-                preferred_element_type=dtype)
-        return jax.lax.fori_loop(0, iters, body, a)
+    def make(iters):
+        # bs rides as an ARGUMENT: closing over it would bake a 128-256 MB
+        # HLO constant into the program and trip the tunnel's request-size
+        # limit (HTTP 413)
+        @jax.jit
+        def f(a, bs):
+            def body(_, c):
+                return jax.lax.dot_general(
+                    c, bs, (((1,), (0,)), ((), ())),
+                    preferred_element_type=dtype)
+            return jax.lax.fori_loop(0, iters, body, a)
+        return lambda a: f(a, bs)
 
-    dt = _median_time(f, a, bs, reps=reps)
-    return 2.0 * n ** 3 * iters / dt / 1e12
+    def inputs(m):
+        return [jax.random.normal(jax.random.PRNGKey(20 + j), (n, n),
+                                  jnp.float32).astype(dtype)
+                for j in range(m)]
+
+    return 2.0 * n ** 3 / _slope(make, inputs, i1, i2) / 1e12
 
 
-def hbm_stream_gbps(mbytes: int = 1024, reps: int = 5,
-                    iters: int = 32) -> float:
-    """Sustained HBM GB/s on a chained read+write stream.
+def hbm_stream_gbps(mbytes: int = 1024, i1: int = 64, i2: int = 256
+                    ) -> float:
+    """Sustained HBM GB/s on a chained read+write f32 stream.
 
-    Each iteration reads and rewrites the full buffer with an
-    iteration-dependent scale (not constant-foldable across the loop), so
-    traffic per iteration is 2 × buffer bytes.
-    """
-    # (rows, 1024) rather than flat (n,): 1-D buffers lane-tile poorly
-    # and understate streaming bandwidth
-    n = (mbytes << 20) // 4
-    x = jax.random.normal(jax.random.PRNGKey(2), (n // 1024, 1024),
-                          jnp.float32)
+    Each iteration rescales the full buffer with an iteration-dependent
+    factor large enough to change every f32 value (not elidable)."""
+    rows = (mbytes << 20) // 4 // 1024
+    traffic = 2.0 * 4 * rows * 1024      # read + write per iteration
 
-    @jax.jit
-    def f(x):
-        def body(i, c):
-            # one-ulp-scale, i-dependent factor: must exceed f32's
-            # 2^-24 so the multiply actually changes values (1 + 1e-9
-            # rounds to exactly 1.0f and the loop would be a bitwise
-            # identity a value-analyzing backend could elide)
-            return c * (1.0 + (2.0 ** -23) * (i + 1).astype(jnp.float32))
-        return jax.lax.fori_loop(0, iters, body, x)
+    def make(iters):
+        @jax.jit
+        def f(x):
+            def body(i, c):
+                s = 1.0 + (2.0 ** -6) * (i % 3 + 1).astype(jnp.float32)
+                return c * s
+            return jax.lax.fori_loop(0, iters, body, x)
+        return f
 
-    dt = _median_time(f, x, reps=reps)
-    return 2.0 * 4.0 * (n // 1024) * 1024 * iters / dt / 1e9
+    def inputs(m):
+        return [jax.random.normal(jax.random.PRNGKey(10 + j),
+                                  (rows, 1024), jnp.float32)
+                for j in range(m)]
+
+    return traffic / _slope(make, inputs, i1, i2) / 1e9
+
+
+def gather_gbps(tbl_rows: int = 1 << 20, row_d: int = 128,
+                g_rows: int = 1 << 18, i1: int = 16, i2: int = 64
+                ) -> float:
+    """Effective GB/s of iteration-dependent random-row gathers (the
+    traffic shape of CAGRA hops and IVF-PQ refine)."""
+    tbl = jax.random.normal(jax.random.PRNGKey(3), (tbl_rows, row_d),
+                            jnp.float32)
+
+    def make(iters):
+        @jax.jit
+        def f(x, t):
+            def body(i, c):
+                # the carry folds into the index base so the gather chain
+                # is INPUT-dependent — an index stream derived from the
+                # loop counter alone is value-identical across calls and
+                # a replaying backend could serve it from cache
+                iu = i.astype(jnp.uint32) + c[0].astype(jnp.uint32)
+                base = iu * jnp.uint32(1315423911) + jnp.uint32(2654435761)
+                idx = (base + jnp.arange(g_rows, dtype=jnp.uint32)
+                       * jnp.uint32(2654435761)) % jnp.uint32(tbl_rows)
+                g = jnp.take(t, idx.astype(jnp.int32), axis=0)
+                return c + g.sum(axis=0)
+            return jax.lax.fori_loop(0, iters, body, x)
+
+        return lambda x: f(x, tbl)
+
+    def inputs(m):
+        return [jnp.zeros((row_d,), jnp.float32) + j for j in range(m)]
+
+    return g_rows * row_d * 4 / _slope(make, inputs, i1, i2) / 1e9
 
 
 def dispatch_us(reps: int = 11) -> float:
     """Median round-trip of a trivial dispatch (1-element add + sync).
 
-    Deliberately NOT amortized: this is the per-call overhead number the
-    amortized probes are defending against, reported so readers can judge
-    how much of any per-call latency is transport."""
+    Deliberately NOT amortized: this is the per-call constant the slope
+    probes cancel, reported so readers can judge how much of any
+    per-call latency is transport."""
+    from ..ops.autotune import measure as _median_time
+
     x = jnp.zeros((8, 128), jnp.float32)
 
     @jax.jit
@@ -97,17 +162,22 @@ def dispatch_us(reps: int = 11) -> float:
 
 
 def probe(quick: bool = False) -> Dict[str, float]:
-    """Measure this device's effective peaks. ~4 compiles; the amortized
-    loops put a few hundred ms of device work behind each dispatch."""
-    reps = 3 if quick else 5
-    iters = 16 if quick else 32
+    """Measure this device's effective peaks via slope fits. ~8 compiles;
+    each probe streams seconds of device work so the fit is stable.
+
+    ``quick`` trims the large-iters points (shorter windows, same
+    method); the matmul pair stays ≥64 — see the module docstring."""
+    mm = (64, 128) if quick else (64, 192)
+    st = (64, 160) if quick else (64, 256)
+    ga = (16, 48) if quick else (16, 64)
     return {
         "matmul_bf16_tflops": round(matmul_tflops(dtype=jnp.bfloat16,
-                                                  reps=reps, iters=iters), 1),
+                                                  i1=mm[0], i2=mm[1]), 1),
         "matmul_f32_tflops": round(matmul_tflops(dtype=jnp.float32,
-                                                 reps=reps, iters=iters), 1),
+                                                 i1=mm[0], i2=mm[1]), 1),
         "hbm_stream_gbps": round(hbm_stream_gbps(
-            mbytes=256 if quick else 1024, reps=reps, iters=iters), 1),
+            mbytes=512 if quick else 1024, i1=st[0], i2=st[1]), 1),
+        "gather_gbps": round(gather_gbps(i1=ga[0], i2=ga[1]), 1),
         "dispatch_us": round(dispatch_us(), 1),
     }
 

@@ -18,7 +18,6 @@ is trivial.
 """
 from __future__ import annotations
 
-import dataclasses
 from typing import Tuple
 
 import jax
@@ -55,9 +54,12 @@ def _split_rows(n: int, p: int) -> list[np.ndarray]:
     return np.array_split(np.arange(n), p)
 
 
-def _stack_pad(arrs: list[np.ndarray], pad_value=0) -> np.ndarray:
-    """Stack along a new leading axis, padding dim 0 to the common max."""
-    m = max(a.shape[0] for a in arrs)
+def _stack_pad(arrs: list[np.ndarray], pad_value=0,
+               min_rows: int = 0) -> np.ndarray:
+    """Stack along a new leading axis, padding dim 0 to the common max
+    (or ``min_rows`` if larger — build_cagra uses it to guarantee every
+    shard has at least one padding row for seed-padding sentinels)."""
+    m = max(min_rows, max(a.shape[0] for a in arrs))
     out = np.full((len(arrs), m) + arrs[0].shape[1:], pad_value,
                   arrs[0].dtype)
     for i, a in enumerate(arrs):
@@ -188,7 +190,8 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
 class ShardedCagra:
     """Stacked per-shard CAGRA graphs, leading axis sharded over AXIS."""
 
-    def __init__(self, mesh, data, graphs, bases, counts, n_total, metric):
+    def __init__(self, mesh, data, graphs, bases, counts, n_total, metric,
+                 seeds=None):
         self.mesh = mesh
         self.data = data        # (p, R, d) padded rows
         self.graphs = graphs    # (p, R, deg) LOCAL neighbor ids
@@ -196,6 +199,8 @@ class ShardedCagra:
         self.counts = counts    # (p,) real (unpadded) rows per shard
         self.n_total = n_total
         self.metric = metric
+        self.seeds = seeds      # (p, s) per-shard covering seed rows
+                                # (sorted unique; invalid-id padded)
 
     @property
     def n_shards(self) -> int:
@@ -207,27 +212,54 @@ def build_cagra(dataset, mesh: Mesh,
     """Build one CAGRA graph per shard row block."""
     expects(AXIS in mesh.shape, "mesh must have a %r axis", AXIS)
     p0 = params or cagra.IndexParams()
-    # per-shard covering seed sets would be discarded by search_cagra
-    # (it seeds randomly inside shard_map) — don't pay for them
-    p0 = dataclasses.replace(p0, seed_nodes=0)
     dataset = np.asarray(dataset, np.float32)
     n = len(dataset)
     p = mesh.shape[AXIS]
     parts = _split_rows(n, p)
+    # per-shard COVERING seed sets ride along (stacked + padded): random
+    # seeding alone collapses recall once shards hold >~1k rows — 32
+    # random seeds cover 0.3% of a 10k-row shard and the traversal
+    # strands in the wrong cluster (r5 dryrun: recall 0.27 vs 0.97)
     shards = [cagra.build(dataset[rows], p0) for rows in parts]
     mt = shards[0].metric
 
-    data = _stack_pad([np.asarray(s.dataset) for s in shards])
-    graphs = _stack_pad([np.asarray(s.graph) for s in shards])
-    bases = np.array([r[0] for r in parts], np.int32)
     counts = np.array([len(r) for r in parts], np.int32)
+    seed_sets = [np.asarray(s.seed_nodes)
+                 if s.seed_nodes is not None else np.zeros((0,), np.int32)
+                 for s in shards]
+    n_seed = max(ss.shape[0] for ss in seed_sets)
+    # every shard's seed padding (count_i + pad_i sentinel ids, below)
+    # must land on a real-but-invalid padded row: per-shard seed counts
+    # are data-dependent (np.unique in _covering_seeds), so size the row
+    # capacity to the worst pad, not a fixed slack
+    max_pad = max((n_seed - ss.shape[0] for ss in seed_sets), default=0)
+    cap = int(counts.max()) + max(8, max_pad + 1)
+    data = _stack_pad([np.asarray(s.dataset) for s in shards],
+                      min_rows=cap)
+    graphs = _stack_pad([np.asarray(s.graph) for s in shards],
+                        min_rows=cap)
+    bases = np.array([r[0] for r in parts], np.int32)
+
+    seeds = None
+    if n_seed > 0:
+        # pad each shard's sorted-unique seed list with ascending
+        # INVALID row ids (count_i + j < cap): stays sorted unique, and
+        # the search-time mask (valid rows only) scores them +inf
+        padded = []
+        for i, ss in enumerate(seed_sets):
+            pad = n_seed - ss.shape[0]
+            padded.append(np.concatenate(
+                [ss, counts[i] + np.arange(pad, dtype=np.int32)]))
+        seeds = np.stack(padded).astype(np.int32)
 
     def put(x, spec):
         return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
 
     return ShardedCagra(mesh, put(data, P(AXIS, None, None)),
                         put(graphs, P(AXIS, None, None)),
-                        put(bases, P(AXIS)), put(counts, P(AXIS)), n, mt)
+                        put(bases, P(AXIS)), put(counts, P(AXIS)), n, mt,
+                        seeds=None if seeds is None
+                        else put(seeds, P(AXIS, None)))
 
 
 def search_cagra(index: ShardedCagra, queries, k: int,
@@ -246,13 +278,16 @@ def search_cagra(index: ShardedCagra, queries, k: int,
     select_min = mt is not DistanceType.InnerProduct
     comms = _comms_of(index.mesh, res)
 
-    def local(data, graph, base, count, qq):
+    has_seeds = index.seeds is not None
+
+    def local(data, graph, base, count, qq, *rest):
         # padding rows (beyond this shard's real count) are masked out so
-        # random seeding can't surface them
+        # neither random nor covering seeding can surface them
         valid = jnp.arange(data.shape[1], dtype=jnp.int32) < count[0]
+        seed_rows = rest[0][0] if has_seeds else None
         d, i = cagra._search_jit(
             data[0], data[0], None, graph[0], qq, valid,
-            jax.random.key(sp.seed), None, itopk,
+            jax.random.key(sp.seed), seed_rows, itopk,
             width, int(max_iter), k, n_seeds, mt.value)
         gi = jnp.where(i >= 0, i + base[0], -1)
         bad = jnp.inf if select_min else -jnp.inf
@@ -261,13 +296,18 @@ def search_cagra(index: ShardedCagra, queries, k: int,
         all_i = comms.allgather(gi)
         return brute_force.knn_merge_parts(all_d, all_i, select_min)
 
+    in_specs = [P(AXIS, None, None), P(AXIS, None, None), P(AXIS), P(AXIS),
+                P()]
+    arrays = [index.data, index.graphs, index.bases, index.counts, q]
+    if has_seeds:
+        in_specs.append(P(AXIS, None))
+        arrays.append(index.seeds)
     shmap = jax.shard_map(
         local, mesh=index.mesh,
-        in_specs=(P(AXIS, None, None), P(AXIS, None, None), P(AXIS), P(AXIS),
-                  P()),
+        in_specs=tuple(in_specs),
         out_specs=(P(), P()),
         check_vma=False)
-    return shmap(index.data, index.graphs, index.bases, index.counts, q)
+    return shmap(*arrays)
 
 
 class ShardedIvfPq:

@@ -130,8 +130,11 @@ def dryrun(n_devices: int) -> None:
             "need %d devices, have %d", n_devices, len(devices))
     mesh = Mesh(np.array(devices), (AXIS,))
     rng = np.random.default_rng(0)
-    data = rng.standard_normal((256 * n_devices - 17, 64)).astype(np.float32)
-    q = rng.standard_normal((16, 64)).astype(np.float32)
+    # >=10k rows per device: big enough that a cross-shard merge bug
+    # (rank mixing, id rebasing, padding leaks) actually surfaces
+    data = rng.standard_normal((10_000 * n_devices - 17, 64)
+                               ).astype(np.float32)
+    q = rng.standard_normal((32, 64)).astype(np.float32)
     index = build(data, mesh)
     # pin both sides to the scan engine: the check below is exact-equality
     # on indices, which different engines may break on fp ties
@@ -143,4 +146,6 @@ def dryrun(n_devices: int) -> None:
     local = brute_force.build(data)
     ref_d, ref_i = brute_force.search(local, q, 5, tile_size=512, algo="scan")
     np.testing.assert_array_equal(np.asarray(idx), np.asarray(ref_i))
-    print(f"dryrun_multichip ok: {n_devices} devices, merged top-5 matches single-chip")
+    print(f"dryrun_multichip ok: sharded brute force over {n_devices} "
+          f"devices x {len(data) // n_devices + 1} rows, merged top-5 "
+          "matches single-chip exactly")
