@@ -177,6 +177,71 @@ def _penalty_row(index: Index, filter, valid_rows):
     return pen
 
 
+def _wide_select_k(s: jax.Array, k: int):
+    """Exact per-row top-k over very wide rows via chunked select_k.
+
+    select_k's KPASS engine caps at 16384 columns (VMEM row block); wider
+    rows select per 8192-chunk first, then select over the surviving
+    nc·k candidates. Exact, including top_k's lowest-index tie-break:
+    per-chunk selection keeps every chunk's own full top-k, and both
+    levels break ties by ascending index."""
+    from ..matrix.select_k import select_k
+
+    m, n = s.shape
+    if n <= 16384:
+        return select_k(s, k, select_min=True)
+    c = 8192
+    n_pad = round_up_to(n, c)
+    nc = n_pad // c
+    sp = jnp.pad(s, ((0, 0), (0, n_pad - n)), constant_values=jnp.inf)
+    cv, ci = select_k(sp.reshape(m * nc, c), k, select_min=True)
+    base = (jnp.arange(nc, dtype=jnp.int32) * c)[None, :, None]
+    cand_v = cv.reshape(m, nc * k)
+    cand_i = (ci.reshape(m, nc, k) + base).reshape(m, nc * k)
+    v, j = select_k(cand_v, k, select_min=True)
+    return v, jnp.take_along_axis(cand_i, j, axis=1)
+
+
+def _blockmin_topk(s: jax.Array, k: int, blk: int = 32):
+    """Exact top-k of a wide distance block via 32-column block minima.
+
+    The binding cost of a naive top_k over (m, n≈500k) is XLA's sort
+    (~9 ms per 8k columns, measured); a k-pass extraction is O(k·m·n)
+    VPU work — both lose at corpus width. This two-level scheme reads
+    the block once for a 32-way min reduce (bandwidth-bound), selects
+    the k best BLOCKS per row (n/32-wide select on the KPASS engine),
+    and re-reads only the k winning blocks' raw columns (m·k·32 values).
+
+    Exactness: every true top-k element lives in one of the k
+    smallest-min blocks — if its block were outside, the k selected
+    blocks each contain an element no larger, displacing it (ties
+    resolve by ascending block index at level 1 and ascending column at
+    level 2, matching top_k's lowest-index-first order).
+    Reference role: select_radix.cuh's candidate-pruning pass."""
+    from ..matrix.select_k import select_k
+
+    m, n = s.shape
+    n_pad = round_up_to(n, blk)
+    if k > n_pad // blk:
+        # more winners than blocks: the pruning level cannot hold them;
+        # plain select (top_k handles any k <= n)
+        return select_k(s, k, select_min=True)
+    sp = (s if n_pad == n else
+          jnp.pad(s, ((0, 0), (0, n_pad - n)), constant_values=jnp.inf))
+    s3 = sp.reshape(m, n_pad // blk, blk)
+    bm = s3.min(axis=2)                              # (m, B)
+    _, bidx = _wide_select_k(bm, k)                  # (m, k) block ids
+    # ascending block order, so level-2's lowest-POSITION tie-break is
+    # the lowest global COLUMN — exactly top_k's order on ties
+    bidx = jnp.sort(bidx, axis=1)
+    cand = jnp.take_along_axis(s3, bidx[:, :, None], axis=1)  # (m, k, blk)
+    cand_cols = (bidx[:, :, None] * blk
+                 + jnp.arange(blk, dtype=jnp.int32)[None, None, :])
+    v, j = _wide_select_k(cand.reshape(m, k * blk), k)
+    idx = jnp.take_along_axis(cand_cols.reshape(m, k * blk), j, axis=1)
+    return v, idx
+
+
 def _search_matmul(index: Index, q, k, filter, valid_rows, precision,
                    workspace_mb: Optional[int] = None):
     """One-shot GEMM + top_k engine, query-chunked to a workspace budget.
@@ -234,6 +299,9 @@ def _search_matmul(index: Index, q, k, filter, valid_rows, precision,
             s = -dot
         if pen is not None:
             s = s + pen[None, :]
+        if n >= 8192:
+            # wide rows: block-min two-level select (see _blockmin_topk)
+            return _blockmin_topk(s, k)
         negv, idx = jax.lax.top_k(-s, k)
         return -negv, idx
 
@@ -286,7 +354,11 @@ def tune_search(index: Index, queries, k: int, reps: int = 5,
             jax.jit(lambda qq, idx: search(idx, qq, k, algo=algo)))
 
     cands = {"matmul": _engine("matmul"), "scan": _engine("scan")}
-    if index.metric in _PALLAS_METRICS and jax.default_backend() == "tpu":
+    if (index.metric in _PALLAS_METRICS and jax.default_backend() == "tpu"
+            and index.size <= (128 << 10)):
+        # above 128k rows the fused kernel's O(k·m·n) per-tile extraction
+        # loses by >20x (r4 measurement) — keep it out of the race rather
+        # than spend a tuning rep compiling a known loser
         cands["pallas"] = _engine("pallas")
     # value_read: engine choice must not be steered by a backend that
     # lies about readiness (observed: block_until_ready returning in
@@ -366,11 +438,15 @@ def search(
         elif not expanded:
             algo = "scan"
         else:
-            # untuned heuristic: matmul only while a >=128-row query chunk
-            # fits the workspace budget (large indexes stream instead)
+            # untuned heuristic: matmul everywhere it can chunk (the
+            # block-min select keeps it competitive at any width); the
+            # fused pallas kernel's per-tile k-extraction is O(k·m·n) VPU
+            # work and measured 28x behind at 500k rows
+            # (scratch/exp_bf_engines.py, r4) — never auto-pick it above
+            # 128k rows
             budget = int(os.environ.get("RAFT_TPU_MATMUL_WORKSPACE_MB",
                                         "1024")) << 20
-            if budget // max(n * 4, 1) >= 128:
+            if n > (128 << 10) or budget // max(n * 4, 1) >= 8:
                 algo = "matmul"
             else:
                 algo = ("pallas" if jax.default_backend() == "tpu"

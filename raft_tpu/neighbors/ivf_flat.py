@@ -324,15 +324,12 @@ def prepare_scan(index: Index) -> None:
 def _search_pallas(index, q, k, n_probes, offsets_j, sizes_j, precision,
                    pen_p=None):
     """Fused query-grouped list scan (the TPU perf path; ops/ivf_scan.py)."""
-    from ..ops import fused_knn
-    from ..ops.ivf_scan import _ivf_flat_scan_jit, pad_for_scan
+    from ..ops.ivf_scan import _ivf_flat_scan_jit, coarse_probe, pad_for_scan
 
     mt = index.metric
-    # coarse stage through the fused kernel too: the select_k fallback is a
-    # full n_lists-wide sort per query, which dominates the whole search
-    _, probed = fused_knn(q, index.centers, n_probes,
+    probed = coarse_probe(q, index.centers, n_probes,
                           metric=_PALLAS_METRICS[mt],
-                          data_norms=index.center_norms,
+                          center_norms=index.center_norms,
                           precision=precision)
     lmax = int(index.list_sizes.max())
     # the aligned-DMA padding copies the dataset: cached once per index,
@@ -452,19 +449,15 @@ def search_arrays(data, data_norms, source_ids, centers, center_norms,
     (bf16/int8 + per-row ``scales``); gathers dequantize on the fly."""
     from .brute_force import dequantize_rows
 
+    from ..ops.ivf_scan import coarse_probe
+
     select_min = is_min_close(mt)
-    # stage 1: coarse probe selection (ivf_flat_search-inl.cuh:38)
-    cross = hdot(qc, centers.T)
-    if mt is DistanceType.InnerProduct:
-        coarse = -cross
-    elif mt is DistanceType.CosineExpanded:
-        qn = jnp.sqrt(jnp.maximum(jnp.sum(qc * qc, axis=1, keepdims=True), 1e-30))
-        cn = jnp.sqrt(jnp.maximum(center_norms, 1e-30))
-        coarse = 1.0 - cross / (qn * cn[None, :])
-    else:
-        q2 = jnp.sum(qc * qc, axis=1, keepdims=True)
-        coarse = jnp.maximum(q2 + center_norms[None, :] - 2.0 * cross, 0.0)
-    _, probed = select_k(coarse, n_probes, select_min=True)
+    # stage 1: coarse probe selection (ivf_flat_search-inl.cuh:38) —
+    # shared with the pallas path so both engines probe identical lists
+    cmetric = ("ip" if mt is DistanceType.InnerProduct
+               else "cos" if mt is DistanceType.CosineExpanded else "l2")
+    probed = coarse_probe(qc, centers, n_probes, metric=cmetric,
+                          center_norms=center_norms)
 
     # stage 2: gather candidates and score (the fused-scan analog)
     rows, valid, _ = _candidate_rows(probed, offsets_j, sizes_j, max_rows)

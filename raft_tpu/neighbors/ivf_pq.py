@@ -513,8 +513,8 @@ def _search_pallas(index: Index, q, k, n_probes, lut_dtype, precision,
                    pen_p=None):
     """Fused query-grouped PQ scan (ops/ivf_pq_scan.py) — the TPU perf
     path (expanded-form LUT + one-hot GEMM scoring)."""
-    from ..ops import fused_knn
     from ..ops.ivf_pq_scan import _ivf_pq_scan_jit
+    from ..ops.ivf_scan import coarse_probe
 
     mt = index.metric
     lmax = int(index.list_sizes.max())
@@ -528,7 +528,7 @@ def _search_pallas(index: Index, q, k, n_probes, lut_dtype, precision,
 
     q_rot = hdot(q, index.rotation.T)
     coarse_metric = "ip" if mt is DistanceType.InnerProduct else "l2"
-    _, probed = fused_knn(q_rot, index.centers_rot, n_probes,
+    probed = coarse_probe(q_rot, index.centers_rot, n_probes,
                           metric=coarse_metric, precision=precision)
     interpret = jax.default_backend() != "tpu"
     vals, rows = _ivf_pq_scan_jit(

@@ -111,6 +111,30 @@ def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, pen_ref, cent_ref,
     g = pl.program_id(0)
     off = offs_ref[g]
     size = sizes_ref[g]
+
+    # dead-group gate: see ivf_scan._kernel — the static group bound
+    # leaves up to n_lists dead groups whose window DMAs are pure waste
+    @pl.when(size <= 0)
+    def _dead():
+        ov_ref[0] = jnp.full((_QG, kp), jnp.inf, jnp.float32)
+        oi_ref[0] = jnp.full((_QG, kp), -1, jnp.int32)
+
+    @pl.when(size > 0)
+    def _alive():
+        _kernel_body(off, size, qb_ref, qn_ref, dn_ref, pen_ref,
+                     cent_ref, cb_ref, scl_ref, codes_ref, ov_ref, oi_ref,
+                     codes_vmem, sem, k=k, kp=kp, lmax=lmax, pq_dim=pq_dim,
+                     book=book, metric=metric, precision=precision,
+                     has_pen=has_pen)
+
+
+def _kernel_body(off, size, qb_ref, qn_ref, dn_ref, pen_ref,
+                 cent_ref, cb_ref, scl_ref, codes_ref, ov_ref, oi_ref,
+                 codes_vmem, sem, *, k: int, kp: int, lmax: int,
+                 pq_dim: int, book: int, metric: str, precision: str,
+                 has_pen: bool):
+    # off/size arrive as values: pl.program_id cannot be called inside a
+    # pl.when branch (the CPU interpreter has no lowering for it there)
     off_al = (off // 8) * 8
     extra = off - off_al
 

@@ -39,30 +39,76 @@ def pack_pairs(probed: jax.Array, n_lists: int):
     → (qtable (G, QG) query ids, glist (G,) list per group, galive (G,),
     flat (mp,) output slot per sorted pair, order (mp,) pair sort, G).
     Shared by the IVF-Flat and IVF-PQ scan kernels.
+
+    SCATTER-FREE (r5): the original formulation built qtable/glist/galive
+    with four ``.at[]`` scatters over the m·p pairs; TPU scatters
+    serialize, and the grouping chain dominated the whole search wall
+    (scratch/exp_grouping_r5.json: 110.9 → 14.8 ms at m=10k, p=20,
+    L=1024). This version keeps ONE argsort and derives everything else
+    from vectorized bisections over the n_lists boundaries, affine index
+    math, and contiguous 128-wide window slices of the sorted pair
+    array. ``glist`` of dead (gated) groups is unspecified.
     """
     m, p = probed.shape
+    mp = m * p
     lids = probed.reshape(-1)                       # (mp,)
-    qids = jnp.repeat(jnp.arange(m, dtype=jnp.int32), p)
     order = jnp.argsort(lids, stable=True)
-    slids, sqids = lids[order], qids[order]
-    counts = jnp.zeros((n_lists,), jnp.int32).at[slids].add(1)
-    starts = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                              jnp.cumsum(counts)[:-1].astype(jnp.int32)])
-    pos = jnp.arange(m * p, dtype=jnp.int32) - starts[slids]
+    slids = lids[order]
+    sqids = (order // p).astype(jnp.int32)          # query of sorted pair
+    lrange = jnp.arange(n_lists, dtype=jnp.int32)
+    starts = jnp.searchsorted(slids, lrange, side="left").astype(jnp.int32)
+    ends = jnp.searchsorted(slids, lrange, side="right").astype(jnp.int32)
+    counts = ends - starts
     gcounts = -(-counts // _QG)                     # cdiv per list
     gbase = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                              jnp.cumsum(gcounts)[:-1].astype(jnp.int32)])
-    gid = gbase[slids] + pos // _QG
-    lane = pos % _QG
-    n_groups = cdiv(m * p, _QG) + n_lists           # static bound
-
-    flat = gid * _QG + lane
-    qtable = jnp.zeros((n_groups * _QG,), jnp.int32).at[flat].set(
-        sqids, mode="drop").reshape(n_groups, _QG)
-    glist = jnp.zeros((n_groups,), jnp.int32).at[gid].set(
-        slids, mode="drop")
-    galive = jnp.zeros((n_groups,), bool).at[gid].max(True, mode="drop")
+    n_groups = cdiv(mp, _QG) + n_lists              # static bound
+    gids = jnp.arange(n_groups, dtype=jnp.int32)
+    glist = jnp.clip(jnp.searchsorted(gbase, gids, side="right") - 1,
+                     0, n_lists - 1).astype(jnp.int32)
+    within = gids - gbase[glist]                    # chunk index in list
+    galive = within < gcounts[glist]
+    row_start = starts[glist] + within * _QG
+    sq_pad = jnp.concatenate(
+        [sqids, jnp.zeros((n_groups * _QG,), jnp.int32)])
+    qtable = jax.vmap(
+        lambda s: jax.lax.dynamic_slice(sq_pad, (s,), (_QG,)))(row_start)
+    lanes = jnp.arange(_QG, dtype=jnp.int32)[None, :]
+    valid = (row_start[:, None] + lanes) < ends[glist][:, None]
+    qtable = jnp.where(valid & galive[:, None], qtable, 0)
+    pos = jnp.arange(mp, dtype=jnp.int32) - starts[slids]
+    flat = (gbase[slids] + pos // _QG) * _QG + pos % _QG
     return qtable, glist, galive, flat, order, n_groups
+
+
+def coarse_probe(q, centers, n_probes: int, metric: str = "l2",
+                 center_norms=None, precision: str = "highest"):
+    """Probe selection (ivf_flat_search-inl.cuh:38 role): one GEMM over
+    the centers plus a rank-k select. Scores are RANKING-ONLY (per-query
+    constants dropped — ||q||² never changes which lists win), and the
+    select rides matrix.select_k's AUTO engine: at (m, n_lists=1024,
+    k=20) the Pallas k-pass engine measured ~6x under lax.top_k
+    (scratch/exp_select_slope_r5.json), which the old fused_knn coarse
+    could not use."""
+    from ..matrix.select_k import select_k
+
+    q = jnp.asarray(q, jnp.float32)
+    cross = jax.lax.dot_general(
+        q, jnp.asarray(centers, jnp.float32),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision(precision))     # (m, L)
+    if center_norms is None:
+        cn = jnp.sum(centers * centers, axis=1)
+    else:
+        cn = jnp.asarray(center_norms, jnp.float32)
+    if metric == "ip":
+        score = -cross
+    elif metric == "cos":
+        score = -cross / jnp.sqrt(jnp.maximum(cn, 1e-30))[None, :]
+    else:                                           # "l2"
+        score = cn[None, :] - 2.0 * cross
+    return select_k(score, n_probes, select_min=True)[1]
 
 
 def merge_pairs(gv, gi, flat, order, m: int, p: int, k: int):
@@ -87,6 +133,31 @@ def _kernel(offs_ref, sizes_ref, qb_ref, qn_ref, dn_ref, pen_ref, scl_ref,
     g = pl.program_id(0)
     off = offs_ref[g]
     size = sizes_ref[g]
+
+    # DEAD-GROUP GATE: the static group bound adds up to n_lists dead
+    # groups (pack_pairs); ungated they still DMA'd the full lmax window
+    # each — measured 8.7 ms of the 15.8 ms kernel wall at 500k/np20
+    # (scratch/exp_scan_decomp_r5.json: v0 15.77 -> gated 7.08)
+    @pl.when(size <= 0)
+    def _dead():
+        ov_ref[0] = jnp.full((_QG, kp), jnp.inf, jnp.float32)
+        oi_ref[0] = jnp.full((_QG, kp), -1, jnp.int32)
+
+    @pl.when(size > 0)
+    def _alive():
+        _kernel_body(off, size, qb_ref, qn_ref, dn_ref, pen_ref,
+                     scl_ref, data_ref, ov_ref, oi_ref, rows_vmem, sem,
+                     k=k, kp=kp, lmax=lmax, metric=metric,
+                     precision=precision, has_pen=has_pen,
+                     has_scales=has_scales)
+
+
+def _kernel_body(off, size, qb_ref, qn_ref, dn_ref, pen_ref,
+                 scl_ref, data_ref, ov_ref, oi_ref, rows_vmem, sem,
+                 *, k: int, kp: int, lmax: int, metric: str,
+                 precision: str, has_pen: bool, has_scales: bool):
+    # off/size arrive as values: pl.program_id cannot be called inside a
+    # pl.when branch (the CPU interpreter has no lowering for it there)
     off_al = (off // 8) * 8
     extra = off - off_al
 

@@ -106,6 +106,28 @@ class TestBruteForce:
         np.testing.assert_allclose(np.asarray(dist), want_dist,
                                    rtol=1e-3, atol=1e-3)
 
+    def test_matmul_engine_blockmin_wide(self, rng):
+        """n >= 8192 rides the block-min two-level select; must stay
+        exact, including on value ties (quantized corpus forces them)."""
+        data, q = _data(rng, n=9000, m=64)
+        data = np.round(data * 4) / 4       # heavy ties
+        index = brute_force.build(data)
+        dist, idx = brute_force.search(index, q, k=10, algo="matmul")
+        want_dist, want_idx = naive_knn(data, q, 10)
+        np.testing.assert_allclose(np.asarray(dist), want_dist,
+                                   rtol=1e-4, atol=1e-4)
+        assert calc_recall(np.asarray(idx), want_idx) > 0.999
+
+    def test_blockmin_topk_matches_topk_exactly(self, rng):
+        from raft_tpu.neighbors.brute_force import _blockmin_topk
+
+        s = rng.standard_normal((256, 8200)).astype(np.float32)
+        s = np.round(s * 8) / 8             # ties
+        v1, i1 = _blockmin_topk(jnp.asarray(s), 10)
+        nv, i2 = jax.lax.top_k(-jnp.asarray(s), 10)
+        np.testing.assert_array_equal(np.asarray(v1), -np.asarray(nv))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
     def test_matmul_engine_chunked(self, rng, monkeypatch):
         # budget forcing multiple query chunks through lax.map
         monkeypatch.setenv("RAFT_TPU_MATMUL_WORKSPACE_MB", "1")

@@ -119,8 +119,9 @@ class TestSelectKAutoDispatch:
         monkeypatch.setattr(autotune, "_MEM_CACHE", {})
         monkeypatch.setattr(autotune, "_DISK_LOADED", False)
         winner, timings = tune_select_k(rows=32, n=4096, k=8, reps=2)
-        assert winner == "topk"      # single engine on TPU (see select_k.py)
-        assert set(timings) == {"topk"}
+        # two engines since r5: lax.top_k and the Pallas k-pass extractor
+        assert set(timings) == {"topk", "kpass"}
+        assert winner in timings
         key = autotune.shape_bucket("select_k", n=4096, k=8)
         assert autotune.lookup(key) == winner
 
@@ -134,3 +135,50 @@ class TestSelectKAutoDispatch:
         np.testing.assert_allclose(np.asarray(v1), np.asarray(v2),
                                    rtol=1e-6, atol=1e-7)
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    @pytest.mark.parametrize("m,n,k", [(130, 1024, 20), (64, 515, 10),
+                                       (700, 2048, 33)])
+    @pytest.mark.parametrize("select_min", [True, False])
+    def test_kpass_matches_topk(self, rng, m, n, k, select_min):
+        """The Pallas k-pass engine is exact and breaks ties like top_k
+        (lowest index first), including on ragged (padded) shapes."""
+        from raft_tpu.matrix.select_k import select_k
+
+        x = rng.standard_normal((m, n)).astype(np.float32)
+        # force value ties so tie-breaking is actually exercised
+        x = np.round(x * 8) / 8
+        xj = jnp.asarray(x)
+        v1, i1 = select_k(xj, k, select_min=select_min, algo="kpass")
+        v2, i2 = select_k(xj, k, select_min=select_min, algo="topk")
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_kpass_inf_rows_distinct_indices(self):
+        """+inf is a legal value (filter penalties, padding): when infs
+        enter the top-k the engine must still return DISTINCT ascending
+        indices, exactly like top_k — not repeat column 0."""
+        from raft_tpu.matrix.select_k import select_k
+
+        x = np.full((520, 1024), np.inf, np.float32)
+        x[:, 0], x[:, 1], x[:, 2] = 1.0, 2.0, 3.0
+        v1, i1 = select_k(jnp.asarray(x), 6, algo="kpass")
+        v2, i2 = select_k(jnp.asarray(x), 6, algo="topk")
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_kpass_preserves_dtype(self, rng):
+        from raft_tpu.matrix.select_k import select_k
+
+        x = jnp.asarray(rng.standard_normal((520, 1024)),
+                        jnp.bfloat16)
+        v, _ = select_k(x, 4, algo="kpass")
+        assert v.dtype == jnp.bfloat16
+
+    def test_kpass_indices_passthrough(self, rng):
+        from raft_tpu.matrix.select_k import select_k
+
+        x = jnp.asarray(rng.standard_normal((130, 640)).astype(np.float32))
+        ids = jnp.asarray(rng.integers(0, 1 << 20, (130, 640)), jnp.int32)
+        v, i = select_k(x, 5, indices=ids, algo="kpass")
+        v2, i2 = select_k(x, 5, indices=ids, algo="topk")
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(i2))
