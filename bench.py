@@ -386,11 +386,15 @@ def main():
     # ground truth: exact search over each part with one shared
     # executable, exact cross-part merge; query chunks give retries a
     # small failure unit
+    # one jit object shared by the main GT stage and the capacity lane:
+    # both search (1000, d) query chunks against 500k-part indexes, so
+    # the capacity lane's ground truth is a cache hit, not a recompile
+    gt_search_jit = jax.jit(lambda q, idx: brute_force.search(
+        idx, q, k, algo="matmul"))
+
     def compute_gt():
         bfs = [brute_force.build(p, metric="sqeuclidean") for p in parts]
-        fn = jax.jit(lambda q, idx: brute_force.search(idx, q, k,
-                                                       algo="matmul"))
-        tp = TwoPart(fn, bfs, offsets, k)
+        tp = TwoPart(gt_search_jit, bfs, offsets, k)
         gchunk = 1000
         gt_deadline = t_start + 0.35 * budget_s
         big = part_n > 100_000
@@ -427,8 +431,9 @@ def main():
 
     entries = []
 
-    def add_entry(algo, name, dt_thr, dt_lat, recall, build_s, extra=None):
-        qps = nq / dt_thr if dt_thr else 0.0
+    def add_entry(algo, name, dt_thr, dt_lat, recall, build_s, extra=None,
+                  batch=None):
+        qps = (batch or nq) / dt_thr if dt_thr else 0.0
         e = {"algo": algo, "name": name, "qps": round(qps, 1),
              "latency_ms": round(dt_lat * 1e3, 1) if dt_lat else -1.0,
              "recall": round(recall, 4), "build_s": round(build_s, 1),
@@ -446,9 +451,9 @@ def main():
     # 1M batch = 640 TFLOP/s after a 2x-peak floor — the lying window
     # scales its answers). Floors are therefore the DATASHEET peaks
     # themselves (v5e: 197 TFLOP/s bf16, 819 GB/s HBM): no real call can
-    # beat them, and real calls run several-fold above (measured roofline
-    # ~86 TFLOP/s / ~72 GB/s), so the floors stay far from honest
-    # timings.
+    # beat them. The r5 slope-fit roofline (raft_tpu/bench/roofline.py)
+    # reads ~657 GB/s stream / ~175 TFLOP/s bf16 — 80-89% of datasheet —
+    # so honest timings sit a modest but real margin above these floors.
     def floor_brute():
         return max(suspect_floor, 2.0 * nq * n * d / 197e12)
 
@@ -463,7 +468,15 @@ def main():
         scanned = groups * window_rows * row_bytes * n_parts
         return max(suspect_floor, scanned / 819e9)
 
-    def measure_wall(tp, *args, floor=0.0, what="", calls: int = 10):
+    def floor_ivf_for(probes, row_bytes, batch_q, parts):
+        """floor_ivf generalized to another corpus shape (the capacity
+        lane): same scan-traffic model, same suspect_floor clamp."""
+        groups = batch_q * probes / 128.0
+        scanned = groups * 1.5 * (part_n / 1024) * row_bytes * parts
+        return max(suspect_floor, scanned / 819e9)
+
+    def measure_wall(tp, *args, floor=0.0, what="", calls: int = 10,
+                     qset=None):
         """THE throughput measurement: pipelined, content-distinct,
         value-read wall.
 
@@ -480,12 +493,14 @@ def main():
         actually ran. The single read's round trip amortizes over
         ``calls``. Results below the lane's physical floor are
         discarded — no honest number exists in that window."""
+        qs = queries if qset is None else qset
         try:
             # calls+1 permutations: the warm-up runs on a THROWAWAY set so
             # no timed call repeats content the backend has already served
-            perms = [jnp.take(queries,
+            perms = [jnp.take(qs,
                               jax.random.permutation(
-                                  jax.random.PRNGKey(100 + i), nq), axis=0)
+                                  jax.random.PRNGKey(100 + i), qs.shape[0]),
+                              axis=0)
                      for i in range(calls + 1)]
             jax.block_until_ready(perms)
             d0 = tp(perms.pop(), *args[1:])[0]      # warm/compile
@@ -508,13 +523,13 @@ def main():
             return None
         return dt
 
-    def measure_tp(tp, *args, reps=5, floor=None, what=""):
+    def measure_tp(tp, *args, reps=5, floor=None, what="", qset=None):
         """(throughput s/call, latency s/call). Throughput is the
         value-read pipelined wall; latency is the per-call-blocked
         median (reported for context, dropped when the window lies)."""
         floor = suspect_floor if floor is None else floor
         lat = median_time(tp, *args, reps=reps, floor=floor)
-        thr = measure_wall(tp, *args, floor=floor, what=what)
+        thr = measure_wall(tp, *args, floor=floor, what=what, qset=qset)
         return thr, lat
 
     # --- brute force (BASELINE config 1): measured-best engine ----------
@@ -591,13 +606,17 @@ def main():
         rec20 = measure_flat(20)
         if not hurry and rec20 is not None:
             if rec20 >= 0.95:
-                for probes in (10, 5):
+                # bisect-capable down-walk: np15 sits in the gap where
+                # the qualifying frontier usually lives (np20 barely
+                # clears, np10 misses — r4: 0.9506 vs 0.8766)
+                for probes in (15, 10, 5):
                     r = measure_flat(probes)
                     if r is None or r < 0.95:
                         break
                     best_probes = probes
             else:
-                for probes in (50, 100):
+                for probes in (25, 30, 40, 50, 100) if rec20 >= 0.93 \
+                        else (50, 100):
                     best_probes = probes
                     r = measure_flat(probes)
                     if r is not None and r >= 0.95:
@@ -695,7 +714,12 @@ def main():
                 quant_limited = (r4 is not None and rec_a is not None
                                  and r4 > rec_a + 0.01)
                 ratio = 4 if quant_limited else 2
-                for probes in (50, 100):
+                # bisect-capable up-walk: a near-miss anchor (r4's
+                # 0.9491 @ np20) explores 25/30/40 so a measured point
+                # actually lands at the gate instead of jumping to
+                # np50's 0.991 with the frontier unmeasured
+                ups = (25, 30, 40, 50) if rec_a >= 0.93 else (50, 100)
+                for probes in ups:
                     r = measure_pq(probes, ratio)
                     if r is not None and r >= 0.95:
                         break
@@ -743,9 +767,11 @@ def main():
         log(f"# cagra built ({cagra_n} rows) in {cagra_build:.0f}s")
         # sweep (itopk, search_width, max_iterations); measured sweep
         # 2026-07-31 (see bench.py history): covering seeds + few hops
+        # (40,4,5) targets the [0.95, 0.965] recall band the r4 sweep
+        # straddled (0.9401 @ itopk40.mi4 vs 0.9688 @ itopk32.mi5)
         sweep = (((32, 4, 5),) if hurry
-                 else ((16, 8, 2), (32, 4, 3), (40, 4, 4), (32, 4, 5),
-                       (64, 4, 8)))
+                 else ((16, 8, 2), (32, 4, 3), (40, 4, 4), (40, 4, 5),
+                       (32, 4, 5), (64, 4, 8)))
         opener = sweep[0]
         for itopk, width, mi in sweep:
             sp = cagra.SearchParams(itopk_size=itopk, search_width=width,
@@ -763,6 +789,83 @@ def main():
                       thr, lat, rec, cagra_build, {"corpus_n": cagra_n})
             if rec >= 0.995 and (itopk, width, mi) != opener:
                 break
+
+    # --- ivf_pq capacity (config 3's structural win: 2M rows) -----------
+    # PQ's reason to exist is corpora where raw f32 pressures memory
+    # (the reference's DEEP-1B positioning): 2M x 128 = 1.02 GB raw vs
+    # ~0.26 GB of pq128x4 codes. A fresh 2M mixture (its own exact
+    # ground truth, 2k-query batches to bound the GT stage) makes this a
+    # recorded, recall-checked, floor-gated bench entry instead of the
+    # r4 one-off artifact.
+    with algo_section('ivf_pq_capacity'):
+        remaining = budget_s - (time.perf_counter() - t_start)
+        from raft_tpu.core.errors import expects as _expects
+        _expects(scale == "full" and not hurry and remaining > 650,
+                 "capacity skip: scale=%s hurry=%s %.0fs left < 650s",
+                 scale, hurry, remaining)
+        cap_nq = 2_000
+        cdata, cq = robust_call(
+            lambda: make_corpus(2_000_000, d, cap_nq, seed=7),
+            "capacity corpus")
+        cparts = [cdata[i * part_n:(i + 1) * part_n]
+                  for i in range(len(cdata) // part_n)]
+        coffs = [i * part_n for i in range(len(cparts))]
+        cbfs = [brute_force.build(p, metric="sqeuclidean") for p in cparts]
+        ctp = TwoPart(gt_search_jit, cbfs, coffs, k)
+        cgt = jnp.concatenate([
+            robust_call(lambda c0=c0: jax.block_until_ready(
+                ctp(cq[c0:c0 + 1000])[1]), f"capacity gt [{c0}]")
+            for c0 in range(0, cap_nq, 1000)])
+        del cbfs, ctp
+        t0 = time.perf_counter()
+        cpis = robust_call(lambda: [
+            ivf_pq.build(p, ivf_pq.IndexParams(
+                n_lists=1024, pq_dim=min(d, 128), pq_bits=4, seed=0))
+            for p in cparts], "capacity pq build")
+        jax.block_until_ready(jax.tree.leaves(cpis))
+        cap_build = time.perf_counter() - t0
+        for pi in cpis:
+            ivf_pq.prepare_scan(pi)
+        cparts_bf16 = [jnp.asarray(p, jnp.bfloat16) for p in cparts]
+        jax.block_until_ready(cparts_bf16)
+        code_gb = sum(int(np.prod(pi.codes.shape))
+                      for pi in cpis) / 1e9
+
+        def measure_capacity(probes):
+            sp = ivf_pq.SearchParams(n_probes=probes, lut_dtype="int8")
+
+            def cap_body(q, idx, dd, s=sp):
+                _, cand = ivf_pq.search(idx, q, 2 * k, s)
+                return refine.refine(dd, q, cand, k)
+
+            tp = TwoPart(jax.jit(cap_body), cpis, coffs, k,
+                         extras=[(pb,) for pb in cparts_bf16])
+            thr, lat = measure_tp(
+                tp, cq,
+                floor=floor_ivf_for(probes, min(d, 128) // 2 + 4,
+                                    cap_nq, len(cparts)),
+                what=f"pq capacity np{probes}", qset=cq)
+            if thr is None:
+                return None
+            rec = robust_call(lambda: device_recall(tp(cq)[1], cgt),
+                              "pq capacity recall")
+            add_entry("raft_ivf_pq",
+                      f"raft_ivf_pq.capacity2M.nlist1024.pq{min(d, 128)}"
+                      f"x4.int8.nprobe{probes}.refine2",
+                      thr, lat, rec, cap_build,
+                      {"corpus_n": len(cdata), "batch_queries": cap_nq,
+                       "code_gb": round(code_gb, 3),
+                       "raw_gb": round(len(cdata) * d * 4 / 1e9, 3)},
+                      batch=cap_nq)
+            return rec
+
+        rec_cap = measure_capacity(20)
+        if rec_cap is not None and rec_cap < 0.95:
+            for probes in (30, 50):
+                r = measure_capacity(probes)
+                if r is not None and r >= 0.95:
+                    break
+        del cdata, cparts, cparts_bf16, cpis
 
     # --- dataset IO: exercise the raft-ann-bench fbin loader ------------
     try:

@@ -20,8 +20,13 @@ TPU design, two engines (mirroring the reference's two families):
 transfer to TPU (histograms lower to serialized scatters or FLOP-heavy
 one-hot contractions; the r3 sweep in bench_select_k_sweep.json showed
 no winnable shape). ``AUTO`` picks KPASS on TPU for f32 rows with
-k ≤ 64 and 512 ≤ n ≤ 16384 (where the measured wins live and the row
-block fits VMEM), TOPK otherwise.
+k ≤ 64 and 512 ≤ n ≤ 4096, TOPK otherwise. The column cap is a VMEM
+bound, not a tuning choice: the kernel keeps ~5 live (128, n) f32/i32
+planes on the scoped-VMEM stack, and measured compile-time OOMs on v5e
+put (128, 15744) at 24.8 MB and even (128, 8192) at 21.3 MB inside a
+larger program against the 16 MB scoped limit — 4096 (~10.5 MB) is the
+rehearsed-safe width. Callers with wider rows chunk first
+(brute_force._wide_select_k).
 """
 from __future__ import annotations
 
@@ -149,13 +154,24 @@ def _kpass_smallest(values: jax.Array, k: int, select_min: bool):
     return vals.astype(values.dtype), idxs
 
 
-def _kpass_eligible(values: jax.Array, k: int) -> bool:
+def _kpass_safe(values: jax.Array, k: int) -> bool:
+    """Shapes the kernel can COMPILE and run sanely: the scoped-VMEM
+    column cap, a supported dtype, and a real TPU backend (interpret
+    mode exists for unit tests only — dispatching it on hot paths is a
+    correctness-of-performance bug)."""
     n = values.shape[-1]
+    return (n <= 4096 and jax.default_backend() == "tpu"
+            and values.dtype in (jnp.float32, jnp.bfloat16, jnp.float16))
+
+
+def _kpass_eligible(values: jax.Array, k: int) -> bool:
+    """Safety bounds plus the measured-win heuristic window (used when
+    no tuning cache entry exists)."""
     rows = 1
     for s in values.shape[:-1]:
         rows *= s
-    return (k <= 64 and 512 <= n <= 16384 and rows >= 512
-            and values.dtype in (jnp.float32, jnp.bfloat16, jnp.float16))
+    return (_kpass_safe(values, k) and k <= 64 and values.shape[-1] >= 512
+            and rows >= 512)
 
 
 def tune_select_k(rows: int, n: int, k: int, select_min: bool = True,
@@ -170,8 +186,12 @@ def tune_select_k(rows: int, n: int, k: int, select_min: bool = True,
     key = autotune.shape_bucket("select_k", n=n, k=k)
     cands = {
         "topk": jax.jit(lambda v: _topk_smallest(v, k, select_min)),
-        "kpass": jax.jit(lambda v: _kpass_smallest(v, k, select_min)),
     }
+    if _kpass_safe(x, k):
+        # shapes past the VMEM column cap must not even be measured
+        # (compile-time OOM), and off-TPU the kernel only exists in
+        # interpret mode — nothing real to measure
+        cands["kpass"] = jax.jit(lambda v: _kpass_smallest(v, k, select_min))
     return autotune.tune_best(key, cands, x, reps=reps, force=True)
 
 
@@ -199,7 +219,10 @@ def select_k(
         from ..ops import autotune
 
         hit = autotune.lookup(autotune.shape_bucket("select_k", n=n, k=k))
-        if hit == "kpass" and _kpass_eligible(values, k):
+        if hit == "kpass" and _kpass_safe(values, k):
+            # a measured win needs only the safety bounds, not the
+            # untuned heuristic window — the tuner's verdict is honored
+            # for every shape it could actually have measured
             algo = SelectAlgo.KPASS
         elif hit == "topk":
             algo = SelectAlgo.TOPK

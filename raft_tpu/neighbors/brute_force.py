@@ -180,17 +180,21 @@ def _penalty_row(index: Index, filter, valid_rows):
 def _wide_select_k(s: jax.Array, k: int):
     """Exact per-row top-k over very wide rows via chunked select_k.
 
-    select_k's KPASS engine caps at 16384 columns (VMEM row block); wider
-    rows select per 8192-chunk first, then select over the surviving
-    nc·k candidates. Exact, including top_k's lowest-index tie-break:
+    select_k's KPASS engine caps at 4096 columns (its scoped-VMEM row
+    block — 8192-wide blocks compile-OOM on v5e inside larger
+    programs); wider rows select per 4096-chunk first, then select
+    over the surviving nc·k candidates. Exact, including top_k's lowest-index tie-break:
     per-chunk selection keeps every chunk's own full top-k, and both
     levels break ties by ascending index."""
     from ..matrix.select_k import select_k
 
     m, n = s.shape
-    if n <= 16384:
+    c = 4096
+    if n <= c or k * 4 > c:
+        # narrow rows need no chunking; huge k makes chunking both
+        # pointless (nc*k ~ n survivors) and ill-formed (the per-chunk
+        # select needs k <= chunk width) — lax.top_k handles any k <= n
         return select_k(s, k, select_min=True)
-    c = 8192
     n_pad = round_up_to(n, c)
     nc = n_pad // c
     sp = jnp.pad(s, ((0, 0), (0, n_pad - n)), constant_values=jnp.inf)

@@ -203,15 +203,21 @@ def build_knn_graph(dataset, k: int, metric=DistanceType.L2Expanded,
     batch = min(batch, n)
 
     if algo == "brute":
-        index = bf_mod.build(dataset, mt)
         # at memory scale, bigger distance-block chunks amortize the
         # per-chunk top_k fixed cost of the n² pass; respect an explicit
         # user workspace choice
         ws = (4096 if n > 400_000
               and "RAFT_TPU_MATMUL_WORKSPACE_MB" not in os.environ
               else None)
-        _brute_graph_loop(bf_mod.search, dataset, index, graph, drop_self,
-                          k, n, batch, ws)
+        part_cap = int(os.environ.get("RAFT_TPU_CAGRA_BRUTE_PART_N",
+                                      "500000"))
+        if n <= part_cap:
+            index = bf_mod.build(dataset, mt)
+            _brute_graph_loop(bf_mod.search, dataset, index, graph,
+                              drop_self, k, n, batch, ws)
+            return graph
+        _parted_brute_graph(bf_mod, dataset, graph, drop_self, k, n, dim,
+                            mt, batch, ws, part_cap)
         return graph
 
     n_lists = max(16, min(1024, int(np.sqrt(n) * 2)))
@@ -239,6 +245,51 @@ def build_knn_graph(dataset, k: int, metric=DistanceType.L2Expanded,
         out = np.asarray(drop_self(ref, jnp.asarray(idx_rows)))
         graph[b0:hi] = out[: hi - b0]
     return graph
+
+
+def _parted_brute_graph(bf_mod, dataset, graph, drop_self, k, n, dim, mt,
+                        batch, workspace_mb, part_cap):
+    """Exact kNN-graph sweep for corpora past the single-program compile
+    cap: 1M-row single-GEMM programs hang the tunneled compiler (bench
+    probe_part_compile, 2026-07-31), so the corpus splits into equal
+    ≤``part_cap`` parts — ONE shared search executable, padding rows
+    masked by ``valid_rows``, per-part top-(k+1) merged exactly
+    (knn_merge_parts) before self-edge removal."""
+    from ..distance.distance_types import is_min_close
+
+    n_parts = -(-n // part_cap)
+    part_n = ((-(-n // n_parts) + 127) // 128) * 128
+
+    def part_slice(i):
+        """Equal-shape part i, zero-padding only the tail slice (a full
+        padded corpus copy would double host memory at the 1M scale
+        this path exists for)."""
+        sl = dataset[i * part_n:(i + 1) * part_n]
+        if len(sl) < part_n:
+            sl = np.concatenate(
+                [sl, np.zeros((part_n - len(sl), dim), np.float32)])
+        return sl
+
+    indexes = [bf_mod.build(part_slice(i), mt) for i in range(n_parts)]
+    valid = [max(0, min(part_n, n - i * part_n)) for i in range(n_parts)]
+    kq = min(n, k + 1)
+    sfn = jax.jit(lambda q, idx, v: bf_mod.search(
+        idx, q, kq, algo="matmul", valid_rows=v,
+        workspace_mb=workspace_mb))
+    select_min = is_min_close(mt)
+    for b0 in range(0, n, batch):
+        hi = min(b0 + batch, n)
+        idx_rows = (np.arange(b0, b0 + batch) % n).astype(np.int32)
+        qb = jnp.asarray(dataset[idx_rows])
+        ds_, is_ = [], []
+        for i, (ix, v) in enumerate(zip(indexes, valid)):
+            dd, ii = sfn(qb, ix, jnp.int32(v))
+            ds_.append(dd)
+            is_.append(jnp.where(ii >= 0, ii + i * part_n, -1))
+        _, merged = bf_mod.knn_merge_parts(jnp.stack(ds_), jnp.stack(is_),
+                                           select_min)
+        out = np.asarray(drop_self(merged, jnp.asarray(idx_rows)))
+        graph[b0:hi] = out[: hi - b0]
 
 
 def _brute_graph_loop(search_fn, dataset, index, graph, drop_self, k, n,

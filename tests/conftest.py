@@ -43,12 +43,14 @@ def res():
     return Resources(seed=0)
 
 
-# The CI box has ONE CPU core (nproc=1), so the <2-minute smoke lane is a
-# measured file subset, not parallelism:
+# The CI box has ONE CPU core (nproc=1), so the smoke lane is a measured
+# file subset, not parallelism:
 #   python -m pytest -q -m "smoke and not slow"
 # covers comms, matrix, distance, sharded brute-force, linalg/sparse,
-# core, brute force and random/stats (~90-110 s serial, per-file timings
-# 2026-07-31). The full not-slow lane stays the depth lane (~13 min).
+# core, brute force and random/stats. Measured ~90-110 s serial on an
+# idle box (per-file timings 2026-07-31) but 133-175 s under contention
+# (judge 2026-08-01, rerun 2026-08-02): treat the promise as ~2-3 min,
+# not <2. The full not-slow lane stays the depth lane (~13 min).
 _SMOKE_FILES = {
     "test_comms.py", "test_matrix.py", "test_distance.py",
     "test_sharded_knn.py", "test_linalg_sparse_ops.py", "test_core.py",
