@@ -15,6 +15,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .comms import AxisComms
+from ..utils import shard_map_compat
 
 __all__ = [
     "test_collective_allreduce", "test_collective_broadcast",
@@ -27,8 +28,8 @@ __all__ = [
 def _run(mesh: Mesh, fn, out_specs=P()):
     axis = mesh.axis_names[0]
     comms = AxisComms(axis, size=mesh.shape[axis])
-    shmap = jax.shard_map(functools.partial(fn, comms), mesh=mesh,
-                          in_specs=(), out_specs=out_specs, check_vma=False)
+    shmap = shard_map_compat(functools.partial(fn, comms), mesh=mesh,
+                          in_specs=(), out_specs=out_specs, check=False)
     return np.asarray(jax.jit(shmap)())
 
 

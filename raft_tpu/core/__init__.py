@@ -6,21 +6,30 @@ shape/dtype/layout, and XLA owns memory placement; the helpers here are what
 remains genuinely runtime-shaped.
 """
 from .bitset import Bitset
-from .errors import RaftError, expects, fail
+from .deadline import Deadline, DeadlineExceeded
+from .errors import (CorruptIndexError, RaftError, ShardsDownError, expects,
+                     fail)
+from .faults import InjectedFault
 from .interruptible import InterruptedException, synchronize
 from .kvp import KeyValuePair
 from .resources import DeviceResources, Resources, device_resources_manager
 from .interop import (as_device_array, auto_convert_output, convert_output,
                       output_as, set_output_as)
-from . import logging, operators, raft_format, serialize, tracing
+from . import faults, logging, operators, raft_format, serialize, tracing
 
 __all__ = [
     "Bitset",
     "RaftError",
+    "CorruptIndexError",
+    "ShardsDownError",
+    "Deadline",
+    "DeadlineExceeded",
+    "InjectedFault",
     "expects",
     "fail",
     "InterruptedException",
     "synchronize",
+    "faults",
     "KeyValuePair",
     "DeviceResources",
     "Resources",

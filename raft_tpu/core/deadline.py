@@ -1,0 +1,109 @@
+"""Deadline propagation for chunked searches.
+
+The reference's cooperative cancellation (raft/core/interruptible.hpp)
+stops work when *someone else* decides to; a serving stack also needs work
+to stop *itself* when its latency budget is spent. A :class:`Deadline`
+rides a :class:`~raft_tpu.core.resources.Resources` (the same injection
+channel as comms) and the chunked search loops (ivf_flat / ivf_pq /
+cagra / brute_force) call :func:`checkpoint` between device dispatches:
+each checkpoint is a full interruptible cancellation point (the existing
+token protocol) plus a deadline probe that raises
+:class:`DeadlineExceeded` with the completed chunks' partial results
+attached — a timed-out query still gets the best answer computed so far.
+
+Device work itself is not preemptible (exactly as a single CUDA kernel is
+not): granularity is the query chunk, sized by the workspace budget or the
+caller's explicit ``query_chunk``.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from .errors import RaftError
+from . import interruptible
+
+__all__ = ["Deadline", "DeadlineExceeded", "carried", "checkpoint",
+           "partial_topk"]
+
+
+class DeadlineExceeded(RaftError):
+    """Raised at a checkpoint once the deadline has passed.
+
+    ``partial`` holds the completed chunks' results — for top-k searches a
+    ``(distances, indices)`` pair covering the queries whose chunks
+    finished dispatching, ``None`` when nothing completed.
+    """
+
+    def __init__(self, msg: str, partial=None):
+        self.partial = partial
+        super().__init__(msg)
+
+
+class Deadline:
+    """Wall-clock budget carried by Resources (``res.set_deadline``).
+
+    ``clock`` is injectable for deterministic tests; it defaults to
+    ``time.monotonic``. The budget starts counting at construction.
+    """
+
+    def __init__(self, seconds: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.seconds = float(seconds)
+        self._clock = clock
+        self._t0 = clock()
+
+    @classmethod
+    def after(cls, seconds: float, **kw) -> "Deadline":
+        return cls(seconds, **kw)
+
+    def elapsed(self) -> float:
+        return self._clock() - self._t0
+
+    def remaining(self) -> float:
+        return self.seconds - self.elapsed()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+
+def carried(res) -> Optional["Deadline"]:
+    """The Deadline carried by ``res`` — ``res`` may be a Resources, a
+    bare Deadline, or None. The one resolution rule shared by checkpoint
+    and the search entry points' auto-chunk gates (so a bare Deadline is
+    never a silent no-op)."""
+    if res is None:
+        return None
+    return res if isinstance(res, Deadline) else getattr(res, "deadline",
+                                                         None)
+
+
+def checkpoint(res=None, partial=None) -> None:
+    """Cancellation + deadline point between chunk dispatches.
+
+    ``res``: a Resources carrying a deadline (or a bare :class:`Deadline`;
+    None → cancellation check only). ``partial``: the partial results to
+    attach on expiry — a value or a zero-arg callable (evaluated only when
+    the deadline actually fires).
+    """
+    interruptible.check()
+    dl = carried(res)
+    if dl is None or not dl.expired():
+        return
+    p = partial() if callable(partial) else partial
+    raise DeadlineExceeded(
+        f"raft_tpu: deadline of {dl.seconds:.4g}s exceeded "
+        f"({dl.elapsed():.4g}s elapsed); partial results "
+        f"{'attached' if p is not None else 'empty'}", partial=p)
+
+
+def partial_topk(outs_d: list, outs_i: list):
+    """Completed top-k chunks → one (distances, indices) pair (None when
+    no chunk finished). The standard ``partial`` thunk for search loops."""
+    if not outs_d:
+        return None
+    import jax.numpy as jnp
+
+    if len(outs_d) == 1:
+        return outs_d[0], outs_i[0]
+    return jnp.concatenate(outs_d), jnp.concatenate(outs_i)

@@ -6,11 +6,44 @@ exceptions directly — so only the contract-checking surface is kept.
 """
 from __future__ import annotations
 
-__all__ = ["RaftError", "expects", "fail"]
+__all__ = ["RaftError", "CorruptIndexError", "ShardsDownError", "expects",
+           "fail"]
 
 
 class RaftError(RuntimeError):
     """Base exception for raft_tpu (analog of ``raft::exception``)."""
+
+
+class CorruptIndexError(RaftError, ValueError):
+    """A serialized index failed an integrity check (CRC mismatch,
+    truncation, unparseable section). ``section`` names the file section
+    that failed: ``"header"`` or an array name. Also a ValueError so
+    pre-checksum callers catching ValueError on malformed files keep
+    working."""
+
+    def __init__(self, section: str, detail: str = ""):
+        self.section = section
+        msg = f"corrupt index file: section {section!r}"
+        super().__init__(f"{msg} ({detail})" if detail else msg)
+
+
+class ShardsDownError(RaftError):
+    """A sharded search found dead shards and the caller did not opt into
+    a degraded answer (``allow_partial=True``). ``shards_ok`` is the
+    per-shard validity mask observed at search time."""
+
+    def __init__(self, shards_ok):
+        self.shards_ok = list(bool(x) for x in shards_ok)
+        down = [i for i, ok in enumerate(self.shards_ok) if not ok]
+        if not any(self.shards_ok):
+            # total failure: no degraded answer exists, so don't steer
+            # the operator toward a flag that cannot help
+            msg = (f"sharded search: all {len(self.shards_ok)} shards "
+                   f"unavailable — no surviving shard to degrade onto")
+        else:
+            msg = (f"sharded search: shard(s) {down} unavailable; pass "
+                   f"allow_partial=True to accept a degraded merged result")
+        super().__init__(msg)
 
 
 def expects(cond: bool, msg: str, *args) -> None:

@@ -1,0 +1,201 @@
+"""Deterministic fault injection at named sites.
+
+The reference hardens its layers with contract macros and status checks
+(raft/core/error.hpp, NCCL status checking in the comms layer) but has no
+way to *exercise* the failure paths on demand; raft_tpu's resilience layer
+(guarded kernel fallback, deadline propagation, degraded sharded search,
+durable index I/O) is only trustworthy if every failure path is
+deterministically testable. This module provides that: probes at named
+sites that can be armed from the environment or from a context manager to
+force kernel compile failure, shard death, byte corruption, I/O errors,
+and slow dispatch.
+
+Spec grammar (``RAFT_TPU_FAULTS``, comma-separated)::
+
+    kind@pattern[:count][=value]
+
+* ``kind`` — fault kind a probe asks about: ``kernel_compile``,
+  ``shard_dead``, ``shard_timeout``, ``corrupt_bytes``, ``io_error``,
+  ``slow_dispatch`` (kinds are open strings; probes define meaning).
+* ``pattern`` — fnmatch pattern over the site name (default ``*``).
+* ``count`` — fire at most this many times (default unlimited).
+* ``value`` — kind-specific argument (sleep seconds for
+  ``slow_dispatch``, byte offset for ``corrupt_bytes``).
+
+Examples::
+
+    RAFT_TPU_FAULTS='kernel_compile@*'            # every gated kernel fails
+    RAFT_TPU_FAULTS='shard_dead@*.shard1'         # shard 1 reported dead
+    RAFT_TPU_FAULTS='io_error@core.serialize.*:1' # first save attempt dies
+    RAFT_TPU_FAULTS='slow_dispatch@ivf_flat.*=0.05'
+
+In-process, prefer the :func:`inject` context manager — it is scoped,
+composable and needs no env round trip. Probes are cheap when nothing is
+armed (one lock-free list check), so library sites stay probed in
+production builds.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import fnmatch
+import os
+import threading
+import time
+from typing import List, Optional
+
+from .errors import RaftError
+
+__all__ = ["InjectedFault", "Fault", "inject", "fired", "check", "sleep_if",
+           "corrupt", "active", "seen_sites", "reload_env", "reset_stats"]
+
+
+class InjectedFault(RaftError):
+    """Raised by a fault probe when an armed fault fires at its site."""
+
+    def __init__(self, kind: str, site: str):
+        self.kind = kind
+        self.site = site
+        super().__init__(f"injected fault {kind!r} at site {site!r}")
+
+
+@dataclasses.dataclass
+class Fault:
+    """One armed fault: kind + site pattern + optional budget/argument."""
+
+    kind: str
+    pattern: str = "*"
+    count: Optional[int] = None     # None → unlimited firings
+    value: Optional[str] = None     # kind-specific argument
+    fires: int = 0                  # times this fault has fired
+
+    def matches(self, kind: str, site: str) -> bool:
+        return (self.kind == kind
+                and (self.count is None or self.fires < self.count)
+                and fnmatch.fnmatch(site, self.pattern))
+
+
+_lock = threading.Lock()
+_injected: List[Fault] = []         # context-manager-armed
+_env_faults: List[Fault] = []       # RAFT_TPU_FAULTS-armed
+_env_loaded = False
+_seen_sites: set = set()            # every site that ever probed
+
+
+def _parse_spec(spec: str) -> Fault:
+    kind, _, rest = spec.strip().partition("@")
+    if not kind:
+        raise ValueError(f"bad fault spec {spec!r}: empty kind")
+    pattern = rest or "*"
+    value = None
+    if "=" in pattern:
+        pattern, _, value = pattern.partition("=")
+    count = None
+    if ":" in pattern:
+        pattern, _, c = pattern.partition(":")
+        count = int(c)
+    return Fault(kind, pattern or "*", count, value)
+
+
+def _load_env() -> None:
+    global _env_loaded
+    if _env_loaded:
+        return
+    with _lock:
+        if _env_loaded:
+            return
+        spec = os.environ.get("RAFT_TPU_FAULTS", "")
+        _env_faults.clear()
+        for part in spec.split(","):
+            if part.strip():
+                _env_faults.append(_parse_spec(part))
+        _env_loaded = True
+
+
+def reload_env() -> None:
+    """Re-read ``RAFT_TPU_FAULTS`` (tests that monkeypatch the env)."""
+    global _env_loaded
+    with _lock:
+        _env_loaded = False
+    _load_env()
+
+
+@contextlib.contextmanager
+def inject(kind: str, pattern: str = "*", count: Optional[int] = None,
+           value=None):
+    """Arm a fault for the dynamic extent of the block (thread-shared:
+    probes on any thread see it, like an env-armed fault)."""
+    f = Fault(kind, pattern, count, None if value is None else str(value))
+    with _lock:
+        _injected.append(f)
+    try:
+        yield f
+    finally:
+        with _lock:
+            _injected.remove(f)
+
+
+def fired(kind: str, site: str) -> Optional[Fault]:
+    """Probe: does an armed fault of ``kind`` fire at ``site``? Consumes
+    one firing from the first matching fault's budget. Lock-free when
+    nothing is armed (the hot-path case: probes sit on per-chunk search
+    dispatch); the race with a concurrently-arming context manager is
+    benign — its window simply starts at the next probe."""
+    _load_env()
+    if not _injected and not _env_faults:
+        return None
+    with _lock:
+        _seen_sites.add(site)
+        for f in _injected + _env_faults:
+            if f.matches(kind, site):
+                f.fires += 1
+                return f
+    return None
+
+
+def check(kind: str, site: str) -> None:
+    """Raise :class:`InjectedFault` when an armed fault fires here."""
+    if fired(kind, site) is not None:
+        raise InjectedFault(kind, site)
+
+
+def sleep_if(site: str, default_s: float = 0.01) -> None:
+    """``slow_dispatch`` probe: sleep the armed duration at this site."""
+    f = fired("slow_dispatch", site)
+    if f is not None:
+        time.sleep(float(f.value) if f.value else default_s)
+
+
+def corrupt(site: str, data):
+    """``corrupt_bytes`` probe: flip one bit of ``data`` (any bytes-like;
+    returned unchanged — not copied — when unarmed) at the armed byte
+    offset, else the middle byte. No-op on empty data."""
+    f = fired("corrupt_bytes", site)
+    if f is None or not data:
+        return data
+    off = int(f.value) if f.value else len(data) // 2
+    off = max(0, min(off, len(data) - 1))
+    out = bytearray(data)
+    out[off] ^= 0x01
+    return bytes(out)
+
+
+def active() -> List[Fault]:
+    """Currently armed faults (context + env), for diagnostics."""
+    _load_env()
+    with _lock:
+        return list(_injected + _env_faults)
+
+
+def seen_sites() -> set:
+    """Site names that probed while any fault was armed (the unarmed
+    fast path skips the bookkeeping — see ``fired``)."""
+    with _lock:
+        return set(_seen_sites)
+
+
+def reset_stats() -> None:
+    with _lock:
+        _seen_sites.clear()
+        for f in _injected + _env_faults:
+            f.fires = 0

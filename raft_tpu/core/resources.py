@@ -30,9 +30,11 @@ def workspace_chunk_bytes(res) -> int:
     when *explicitly configured* (clamped to a sane range), else 256 MB.
     A default-constructed Resources (workspace untouched) keeps the tuned
     default — passing a vanilla Resources for comms/device injection must
-    not silently inflate memory use."""
-    if res is not None and res.workspace_bytes != DEFAULT_WORKSPACE_BYTES:
-        return max(16 << 20, min(res.workspace_bytes, 4 << 30))
+    not silently inflate memory use. ``res`` may be any deadline/comms
+    carrier (e.g. a bare Deadline): no workspace attribute → default."""
+    ws = getattr(res, "workspace_bytes", None) if res is not None else None
+    if ws is not None and ws != DEFAULT_WORKSPACE_BYTES:
+        return max(16 << 20, min(ws, 4 << 30))
     return 256 << 20
 
 # Default workspace budget used to size tiles in streaming algorithms (the
@@ -55,6 +57,7 @@ class Resources:
         mesh: Optional[jax.sharding.Mesh] = None,
         seed: int = 0,
         workspace_bytes: int = DEFAULT_WORKSPACE_BYTES,
+        deadline=None,
     ):
         self._factories: Dict[str, Callable[[], Any]] = {}
         self._store: Dict[str, Any] = {}
@@ -63,6 +66,8 @@ class Resources:
         if mesh is not None:
             self._store["mesh"] = mesh
         self._store["workspace_bytes"] = workspace_bytes
+        if deadline is not None:
+            self._store["deadline"] = deadline
         # generic registry access resolves the device the same lazy way the
         # .device property does, so both paths agree
         self._factories.setdefault("device", lambda: jax.devices()[0])
@@ -107,6 +112,20 @@ class Resources:
         with self._key_lock:
             self._key, sub = jax.random.split(self._key)
         return sub
+
+    # -- deadline (injected like comms; see core/deadline.py) -------------
+    @property
+    def deadline(self):
+        """The carried :class:`~raft_tpu.core.deadline.Deadline`, or None.
+        Chunked searches probe it between dispatches (deadline.checkpoint)."""
+        return self._store.get("deadline")
+
+    def set_deadline(self, deadline) -> None:
+        """Attach (or clear with None) a Deadline for subsequent searches."""
+        if deadline is None:
+            self._store.pop("deadline", None)
+        else:
+            self._store["deadline"] = deadline
 
     # -- comms (injected like the reference's COMMUNICATOR resource) ------
     @property

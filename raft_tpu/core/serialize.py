@@ -7,16 +7,34 @@ same wire idea: a stream of scalars (struct-packed) and arrays (``.npy``
 frames), plus a small versioned header per index type. Index save/load for
 each ANN type builds on these primitives (the analog of
 neighbors/*_serialize.cuh).
+
+Durability (the resilience layer):
+
+* **Per-section CRC32.** New files carry a checksum after the header
+  section and after every array section (array frames are additionally
+  length-prefixed, so truncation is detected before a frame is parsed).
+  A mismatch raises :class:`~raft_tpu.core.errors.CorruptIndexError`
+  naming the failing section. Pre-checksum files (no ``__crc__`` header
+  flag) still load through the legacy path.
+* **Atomic writes.** Path saves write to a same-directory temp file and
+  ``os.replace`` into place, so an interrupted save never leaves a
+  partial file at the target path (and never clobbers a previous good
+  file).
 """
 from __future__ import annotations
 
 import io
 import os
 import struct
+import uuid
+import zlib
 from typing import Any, BinaryIO, Dict, List, Tuple
 
 import jax
 import numpy as np
+
+from .errors import CorruptIndexError
+from . import faults
 
 __all__ = [
     "serialize_scalar",
@@ -29,7 +47,158 @@ __all__ = [
     "load_arrays",
 ]
 
-_MAGIC = b"RAFT_TPU"
+_MAGIC = b"RAFT_TPU"      # legacy (pre-checksum) layout
+# the checksummed layout gets its OWN magic: the layout discriminator
+# must not be a flippable flag byte inside the file — a corrupted
+# discriminator must fail loudly (bad magic), never silently route a
+# checksummed file through the unverified legacy parse
+_MAGIC_CRC = b"RAFTTPU2"
+
+
+class _CrcWriter:
+    """Pass-through writer accumulating a CRC32 of the current section."""
+
+    def __init__(self, f: BinaryIO):
+        self._f = f
+        self.crc = 0
+
+    def write(self, b: bytes) -> None:
+        self.crc = zlib.crc32(b, self.crc)
+        self._f.write(b)
+
+    def take(self) -> int:
+        """Finish the current section: return its CRC and reset."""
+        c = self.crc
+        self.crc = 0
+        return c
+
+
+class _TeeCrc:
+    """Streams a frame to ``f``: CRCs the TRUE bytes, writes the
+    (possibly fault-corrupted) bytes, and counts the frame length — so
+    large arrays serialize without a full in-memory copy."""
+
+    def __init__(self, f: BinaryIO, site: str, crc0: int):
+        self._f = f
+        self._site = site
+        self.crc = crc0
+        self.n = 0
+
+    def write(self, b) -> None:
+        self.crc = zlib.crc32(b, self.crc)
+        self.n += len(b)
+        self._f.write(faults.corrupt(self._site, b))
+
+
+def _write_array_section(f: BinaryIO, name: str, arr) -> None:
+    """One checksummed array section: name frame, length-prefixed npy
+    frame, CRC32 trailer. The CRC covers name + payload with the length
+    folded in LAST (it is only known after the frame streams — seekable
+    targets, which include the atomic-save temp file and BytesIO, get a
+    placeholder patched in place; the reader mirrors the fold order)."""
+    nb = name.encode()
+    name_frame = struct.pack("<H", len(nb)) + nb
+    f.write(name_frame)
+    crc = zlib.crc32(name_frame)
+    site = f"core.serialize.array.{name}"
+    if hasattr(f, "seekable") and f.seekable():
+        len_pos = f.tell()
+        f.write(struct.pack("<Q", 0))              # patched below
+        tee = _TeeCrc(f, site, crc)
+        serialize_array(tee, arr)
+        plen, crc = tee.n, tee.crc
+        end = f.tell()
+        f.seek(len_pos)
+        f.write(struct.pack("<Q", plen))
+        f.seek(end)
+    else:
+        # non-seekable sink: buffer the frame to learn its length
+        buf = io.BytesIO()
+        serialize_array(buf, arr)
+        payload = buf.getbuffer()
+        plen = len(payload)
+        f.write(struct.pack("<Q", plen))
+        crc = zlib.crc32(payload, crc)
+        f.write(faults.corrupt(site, payload))
+    crc = zlib.crc32(struct.pack("<Q", plen), crc)
+    f.write(struct.pack("<I", crc))
+
+
+class _CrcReader:
+    """Pass-through reader accumulating a CRC32 of the current section."""
+
+    def __init__(self, f: BinaryIO):
+        self._f = f
+        self.crc = 0
+
+    def read(self, n: int = -1) -> bytes:
+        b = self._f.read(n)
+        self.crc = zlib.crc32(b, self.crc)
+        return b
+
+    def take(self) -> int:
+        c = self.crc
+        self.crc = 0
+        return c
+
+
+def _read_exact(f, n: int, section: str) -> bytes:
+    """Read exactly ``n`` bytes or raise CorruptIndexError (truncation).
+
+    Reads in bounded chunks: ``n`` can come from a corrupt length prefix
+    (a flipped high bit turns it into exabytes), and a single ``read(n)``
+    could attempt that allocation before EOF reveals the truncation —
+    chunking keeps memory bounded by the actual data."""
+    if n < 0:
+        raise CorruptIndexError(section, f"negative length {n}")
+    chunks = []
+    remaining = n
+    while remaining > 0:
+        b = f.read(min(remaining, 64 << 20))
+        if not b:
+            got = n - remaining
+            raise CorruptIndexError(
+                section, f"truncated: wanted {n} bytes, got {got}")
+        chunks.append(b)
+        remaining -= len(b)
+    if len(chunks) == 1:
+        return chunks[0]
+    return b"".join(chunks)
+
+
+def _read_payload(f, n: int, section: str):
+    """Exact-length array-payload read.
+
+    Seekable sources (path loads, BytesIO) validate the untrusted length
+    prefix against the remaining file size FIRST — a flipped high bit
+    must raise CorruptIndexError, not attempt an exabyte allocation —
+    then fill one preallocated buffer (no chunk-list + join doubling).
+    Non-seekable sources fall back to the chunked bounded read."""
+    if n < 0:
+        raise CorruptIndexError(section, f"negative length {n}")
+    if not (hasattr(f, "seekable") and f.seekable()):
+        return _read_exact(f, n, section)
+    pos = f.tell()
+    end = f.seek(0, 2)
+    f.seek(pos)
+    if n > end - pos:
+        raise CorruptIndexError(
+            section, f"length {n} exceeds the {end - pos} bytes remaining")
+    buf = bytearray(n)
+    mv = memoryview(buf)
+    got = 0
+    while got < n:
+        if hasattr(f, "readinto"):
+            r = f.readinto(mv[got:])
+        else:
+            b = f.read(n - got)
+            r = len(b)
+            mv[got : got + r] = b
+        if not r:
+            raise CorruptIndexError(
+                section, f"truncated: wanted {n} bytes, got {got}")
+        got += r
+    return buf
 
 
 def serialize_scalar(f: BinaryIO, value, fmt: str) -> None:
@@ -56,8 +225,14 @@ def deserialize_array(f: BinaryIO) -> np.ndarray:
 def serialize_header(f: BinaryIO, kind: str, version: int, meta: Dict[str, Any]) -> None:
     """Versioned header: magic, index kind, serialization version and a
     metadata dict of plain ints/floats/strings/bools (analog of the version
-    constants in detail/ivf_pq_serialize.cuh)."""
+    constants in detail/ivf_pq_serialize.cuh). Writes the LEGACY magic;
+    :func:`save_arrays` writes the checksummed layout's own magic."""
     f.write(_MAGIC)
+    _serialize_header_body(f, kind, version, meta)
+
+
+def _serialize_header_body(f: BinaryIO, kind: str, version: int,
+                           meta: Dict[str, Any]) -> None:
     kind_b = kind.encode()
     f.write(struct.pack("<HI", len(kind_b), version))
     f.write(kind_b)
@@ -80,65 +255,165 @@ def serialize_header(f: BinaryIO, kind: str, version: int, meta: Dict[str, Any])
 
 
 def deserialize_header(f: BinaryIO, expect_kind: str | None = None):
-    magic = f.read(len(_MAGIC))
+    magic = _read_exact(f, len(_MAGIC), "header")
     if magic != _MAGIC:
-        raise ValueError("not a raft_tpu serialized file (bad magic)")
-    kind_len, version = struct.unpack("<HI", f.read(6))
-    kind = f.read(kind_len).decode()
+        raise CorruptIndexError(
+            "header", "not a raft_tpu serialized file (bad magic)")
+    kind, version, meta = _deserialize_header_body(f)
     if expect_kind is not None and kind != expect_kind:
         raise ValueError(f"expected index kind {expect_kind!r}, found {kind!r}")
-    (n_items,) = struct.unpack("<I", f.read(4))
+    return kind, version, meta
+
+
+def _deserialize_header_body(f: BinaryIO):
+    kind_len, version = struct.unpack("<HI", _read_exact(f, 6, "header"))
+    kind = _read_exact(f, kind_len, "header").decode()
+    (n_items,) = struct.unpack("<I", _read_exact(f, 4, "header"))
     meta: Dict[str, Any] = {}
     for _ in range(n_items):
-        (klen,) = struct.unpack("<H", f.read(2))
-        k = f.read(klen).decode()
-        tag = f.read(1)
+        (klen,) = struct.unpack("<H", _read_exact(f, 2, "header"))
+        k = _read_exact(f, klen, "header").decode()
+        tag = _read_exact(f, 1, "header")
         if tag == b"b":
-            (v,) = struct.unpack("<?", f.read(1))
+            (v,) = struct.unpack("<?", _read_exact(f, 1, "header"))
         elif tag == b"i":
-            (v,) = struct.unpack("<q", f.read(8))
+            (v,) = struct.unpack("<q", _read_exact(f, 8, "header"))
         elif tag == b"f":
-            (v,) = struct.unpack("<d", f.read(8))
+            (v,) = struct.unpack("<d", _read_exact(f, 8, "header"))
         elif tag == b"s":
-            (slen,) = struct.unpack("<I", f.read(4))
-            v = f.read(slen).decode()
+            (slen,) = struct.unpack("<I", _read_exact(f, 4, "header"))
+            v = _read_exact(f, slen, "header").decode()
         else:
-            raise ValueError(f"bad meta tag {tag!r}")
+            raise CorruptIndexError("header", f"bad meta tag {tag!r}")
         meta[k] = v
     return kind, version, meta
 
 
 def save_arrays(path_or_file, kind: str, version: int, meta: Dict[str, Any],
                 arrays: Dict[str, Any]) -> None:
-    """Save a header plus named arrays (sorted order, name-prefixed frames)."""
+    """Save a header plus named arrays (sorted order, name-prefixed frames).
+
+    Writes the per-section-CRC layout (see module docstring). Path saves
+    are atomic: a temp file in the target directory is ``os.replace``-d
+    into place only after a complete, flushed write.
+    """
 
     def _write(f: BinaryIO):
-        serialize_header(f, kind, version, meta)
+        w = _CrcWriter(f)
+        w.write(_MAGIC_CRC)
+        _serialize_header_body(w, kind, version, meta)
         items = sorted(arrays.items())
-        f.write(struct.pack("<I", len(items)))
+        w.write(struct.pack("<I", len(items)))
+        f.write(struct.pack("<I", w.take()))            # header section CRC
+        faults.check("io_error", "core.serialize.save_arrays")
         for name, arr in items:
-            nb = name.encode()
-            f.write(struct.pack("<H", len(nb)) + nb)
-            serialize_array(f, arr)
+            # per-section CRC covers the TRUE bytes; an armed
+            # corrupt_bytes fault mutates what lands on disk after
+            # checksumming, like real storage corruption — so the
+            # reader's CRC check catches it
+            _write_array_section(f, name, arr)
 
     if isinstance(path_or_file, (str, bytes, os.PathLike)):
-        with open(path_or_file, "wb") as f:
-            _write(f)
+        path = os.fspath(path_or_file)
+        # pid alone collides when two threads save the same path; the
+        # uuid component makes every save's temp file its own
+        suffix = f".tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        tmp = path + (suffix.encode() if isinstance(path, bytes) else suffix)
+        try:
+            with open(tmp, "wb") as f:
+                _write(f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
     else:
         _write(path_or_file)
 
 
 def load_arrays(path_or_file, expect_kind: str | None = None):
-    """Inverse of :func:`save_arrays` → (kind, version, meta, {name: ndarray})."""
+    """Inverse of :func:`save_arrays` → (kind, version, meta, {name: ndarray}).
+
+    Verifies per-section CRCs on checksummed files, raising
+    :class:`CorruptIndexError` naming the failing section; files written
+    before the checksum layout load through the legacy path unchanged.
+    """
 
     def _read(f: BinaryIO):
-        kind, version, meta = deserialize_header(f, expect_kind)
-        (n,) = struct.unpack("<I", f.read(4))
+        r = _CrcReader(f)
+        # the MAGIC discriminates the layout — never a flag byte inside
+        # the file (a flipped flag would silently skip verification)
+        magic = _read_exact(r, len(_MAGIC), "header")
+        has_crc = magic == _MAGIC_CRC
+        if not has_crc and magic != _MAGIC:
+            raise CorruptIndexError(
+                "header", "not a raft_tpu serialized file (bad magic)")
+        try:
+            # kind check deferred: a corrupt header must report corruption,
+            # not a spurious kind mismatch from flipped kind bytes
+            kind, version, meta = _deserialize_header_body(r)
+        except (struct.error, UnicodeDecodeError, OverflowError,
+                MemoryError) as e:
+            raise CorruptIndexError("header", f"unparseable: {e}") from e
         arrays: Dict[str, np.ndarray] = {}
-        for _ in range(n):
-            (nlen,) = struct.unpack("<H", f.read(2))
-            name = f.read(nlen).decode()
-            arrays[name] = deserialize_array(f)
+        if has_crc:
+            (n,) = struct.unpack("<I", _read_exact(r, 4, "header"))
+            got = r.take()
+            (want,) = struct.unpack("<I", _read_exact(f, 4, "header"))
+            if got != want:
+                raise CorruptIndexError(
+                    "header", f"CRC mismatch ({got:#010x} != {want:#010x})")
+            if expect_kind is not None and kind != expect_kind:
+                raise ValueError(
+                    f"expected index kind {expect_kind!r}, found {kind!r}")
+            for _ in range(n):
+                (nlen,) = struct.unpack(
+                    "<H", _read_exact(r, 2, "array table"))
+                try:
+                    name = _read_exact(r, nlen, "array table").decode()
+                except UnicodeDecodeError as e:
+                    # a flipped bit in the name bytes is corruption, not
+                    # a crash — the contract is CorruptIndexError always
+                    raise CorruptIndexError(
+                        "array table", f"undecodable name: {e}") from e
+                # the length folds into the CRC last, mirroring the
+                # writer (which learns it only after streaming the frame)
+                plen_b = _read_exact(f, 8, name)
+                (plen,) = struct.unpack("<Q", plen_b)
+                payload = _read_payload(f, plen, name)
+                r.crc = zlib.crc32(payload, r.crc)
+                r.crc = zlib.crc32(plen_b, r.crc)
+                got = r.take()
+                (want,) = struct.unpack("<I", _read_exact(f, 4, name))
+                if got != want:
+                    raise CorruptIndexError(
+                        name, f"CRC mismatch ({got:#010x} != {want:#010x})")
+                bio = io.BytesIO(payload)
+                del payload   # BytesIO holds its own copy; free ours
+                try:
+                    arrays[name] = np.load(bio, allow_pickle=False)
+                except ValueError as e:
+                    raise CorruptIndexError(name, f"bad npy frame: {e}") \
+                        from e
+        else:
+            # legacy (pre-checksum) layout: count + raw npy frames
+            if expect_kind is not None and kind != expect_kind:
+                raise ValueError(
+                    f"expected index kind {expect_kind!r}, found {kind!r}")
+            (n,) = struct.unpack("<I", _read_exact(f, 4, "array table"))
+            for _ in range(n):
+                (nlen,) = struct.unpack(
+                    "<H", _read_exact(f, 2, "array table"))
+                try:
+                    name = _read_exact(f, nlen, "array table").decode()
+                except UnicodeDecodeError as e:
+                    raise CorruptIndexError(
+                        "array table", f"undecodable name: {e}") from e
+                arrays[name] = deserialize_array(f)
         return kind, version, meta, arrays
 
     if isinstance(path_or_file, (str, bytes, os.PathLike)):

@@ -230,7 +230,15 @@ def select_k(
             algo = (SelectAlgo.KPASS if _kpass_eligible(values, k)
                     else SelectAlgo.TOPK)
     if algo is SelectAlgo.KPASS:
-        vals, idxs = _kpass_smallest(values, k, select_min)
+        # guarded: a KPASS compile/execution failure (unrehearsed shape,
+        # new chip generation) demotes to the exact TOPK engine instead
+        # of failing the call (ops/guarded.py)
+        from ..ops.guarded import guarded_call
+
+        vals, idxs = guarded_call(
+            "select_k.kpass",
+            lambda: _kpass_smallest(values, k, select_min),
+            lambda: _topk_smallest(values, k, select_min))
     else:
         vals, idxs = _topk_smallest(values, k, select_min)
     if indices is not None:

@@ -21,14 +21,15 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..core import interop, tracing
+from ..core import deadline, interop, tracing
 from ..core.bitset import Bitset
 from ..core.errors import expects
 from ..core.serialize import load_arrays, save_arrays
+from ..ops.guarded import guarded_call
 from ..distance.distance_types import DistanceType, canonical_metric, is_min_close
 from ..distance.pairwise import _ELEMENTWISE, _elementwise_tile, _haversine
 from ..matrix.select_k import select_k
-from ..utils import hdot, in_jax_trace, round_up_to
+from ..utils import hdot, in_jax_trace, round_up_to, run_query_chunks
 
 __all__ = ["Index", "build", "search", "knn", "knn_merge_parts", "save",
            "load", "tune_search"]
@@ -401,6 +402,8 @@ def search(
     algo: str = "auto",
     precision: str = "highest",
     workspace_mb: Optional[int] = None,
+    res=None,
+    query_chunk: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """k nearest neighbors of each query → (distances (m, k), indices (m, k)).
 
@@ -419,12 +422,28 @@ def search(
     ``precision``: MXU precision for the distance GEMM ("highest"/"default").
     ``workspace_mb``: matmul-engine distance-block budget override (else
     RAFT_TPU_MATMUL_WORKSPACE_MB, default 1024).
+    ``res``/``query_chunk``: when a Resources carries a Deadline (or an
+    explicit ``query_chunk`` is given), queries run in host-level chunks
+    with a cancellation/deadline checkpoint between dispatches —
+    ``DeadlineExceeded`` carries the completed chunks' partial results.
     """
     q = jnp.asarray(queries, jnp.float32)
     expects(q.ndim == 2 and q.shape[1] == index.dim,
             "queries must be (m, %d), got %s", index.dim, q.shape)
     n = index.size
     expects(0 < k <= n, "k=%d out of range for index of size %d", k, n)
+    if query_chunk <= 0 and deadline.carried(res) is not None:
+        query_chunk = max(1, min(q.shape[0], 4096))
+    # a carried deadline always takes the chunked path: even a single
+    # chunk needs its pre-dispatch checkpoint (an already-expired budget
+    # must raise, not dispatch)
+    if query_chunk > 0 and (query_chunk < q.shape[0]
+                            or deadline.carried(res) is not None):
+        return run_query_chunks(
+            lambda qc, _s0: search(index, qc, k, tile_size, filter,
+                                   valid_rows, algo, precision,
+                                   workspace_mb),
+            q, query_chunk, res)
     mt = index.metric
     select_min = is_min_close(mt)
     expanded = mt in _PALLAS_METRICS
@@ -460,7 +479,14 @@ def search(
     if algo == "pallas":
         expects(mt in _PALLAS_METRICS,
                 "algo='pallas' supports L2/cosine/IP, got %s", mt.name)
-        return _search_pallas(index, q, k, filter, valid_rows, precision)
+        # guarded: a fused-kernel failure demotes this site to the exact
+        # GEMM engine (ops/guarded.py)
+        return guarded_call(
+            "brute_force.fused",
+            lambda: _search_pallas(index, q, k, filter, valid_rows,
+                                   precision),
+            lambda: _search_matmul(index, q, k, filter, valid_rows,
+                                   precision, workspace_mb))
     if algo == "matmul":
         expects(expanded,
                 "algo='matmul' supports L2/cosine/IP, got %s", mt.name)

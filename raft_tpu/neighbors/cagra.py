@@ -42,12 +42,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import interop, tracing
+from ..core import deadline, interop, tracing
 from ..core.bitset import Bitset
 from ..core.errors import expects
 from ..core.serialize import load_arrays, save_arrays
 from ..distance.distance_types import DistanceType, canonical_metric
 from ..matrix.select_k import select_k
+from ..utils import run_query_chunks
 from . import ivf_pq as ivf_pq_mod
 from . import refine as refine_mod
 
@@ -257,7 +258,13 @@ def _parted_brute_graph(bf_mod, dataset, graph, drop_self, k, n, dim, mt,
     (knn_merge_parts) before self-edge removal."""
     from ..distance.distance_types import is_min_close
 
-    n_parts = -(-n // part_cap)
+    # split against the 128-aligned cap, so the later round-up to the
+    # 128-row tile can never push a part past part_cap (the compile-cap
+    # this path exists to respect): n_parts = ceil(n / cap_al) guarantees
+    # ceil(n / n_parts) <= cap_al, and rounding a value <= cap_al up to
+    # 128 stays <= cap_al
+    cap_al = max(128, (part_cap // 128) * 128)
+    n_parts = -(-n // cap_al)
     part_n = ((-(-n // n_parts) + 127) // 128) * 128
 
     def part_slice(i):
@@ -756,8 +763,16 @@ def search(
     k: int,
     params: SearchParams | None = None,
     filter: Optional[Bitset] = None,  # noqa: A002
+    res=None,
+    query_chunk: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Batched-frontier graph traversal (search_single_cta analog)."""
+    """Batched-frontier graph traversal (search_single_cta analog).
+
+    ``res``/``query_chunk``: when a Resources carries a Deadline (or an
+    explicit ``query_chunk`` is given), queries traverse in host-level
+    chunks with a cancellation/deadline checkpoint between dispatches —
+    ``DeadlineExceeded`` carries the completed chunks' partial results.
+    """
     p = params or SearchParams()
     q = jnp.asarray(queries, jnp.float32)
     expects(q.ndim == 2 and q.shape[1] == index.dim, "bad query shape %s",
@@ -810,10 +825,30 @@ def search(
         score = index.dataset
     expects(p.algo in ("auto", "single_cta", "multi_cta", "multi_kernel"),
             "unknown cagra search algo %r", p.algo)
-    return _search_jit(index.dataset, score, scales, index.graph, q,
-                       mask_bits, key, index.seed_nodes, itopk, width,
-                       int(max_iter), k, n_seeds, index.metric.value,
-                       int(p.min_iterations))
+
+    def run(qc, key=key):
+        return _search_jit(index.dataset, score, scales, index.graph, qc,
+                           mask_bits, key, index.seed_nodes, itopk, width,
+                           int(max_iter), k, n_seeds, index.metric.value,
+                           int(p.min_iterations))
+
+    if query_chunk <= 0 and deadline.carried(res) is not None:
+        query_chunk = max(1, min(q.shape[0], 1024))
+    # a carried deadline always takes the chunked path: even a single
+    # chunk needs its pre-dispatch checkpoint (an already-expired budget
+    # must raise, not dispatch)
+    if query_chunk > 0 and (query_chunk < q.shape[0]
+                            or deadline.carried(res) is not None):
+        # distinct key per chunk: reusing one key would hand every chunk
+        # the same random seed rows (correlated sampling). Chunked runs
+        # therefore draw different random seeds than the unchunked call
+        # — neighbor quality is seed-robust (covering seed set + exact
+        # f32 re-rank), but byte-level parity across chunk sizes is not
+        # promised.
+        return run_query_chunks(
+            lambda qc, s0: run(qc, key=jax.random.fold_in(key, s0)),
+            q, query_chunk, res)
+    return run(q)
 
 
 def save(index: Index, path) -> None:

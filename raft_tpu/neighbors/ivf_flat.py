@@ -30,10 +30,11 @@ from ..core.bitset import Bitset
 from ..core.errors import expects
 from ..core.resources import workspace_chunk_bytes
 from ..core.serialize import load_arrays, save_arrays
+from ..ops.guarded import guarded_call
 from ..cluster import kmeans_balanced
 from ..distance.distance_types import DistanceType, canonical_metric, is_min_close
 from ..matrix.select_k import select_k
-from ..utils import cdiv, hdot, in_jax_trace
+from ..utils import cdiv, hdot, in_jax_trace, run_query_chunks
 
 __all__ = ["IndexParams", "SearchParams", "Index", "build",
            "build_from_batches", "extend", "search", "prepare_scan",
@@ -389,6 +390,7 @@ def search(
     offsets_j = jnp.asarray(index.list_offsets[:-1], jnp.int32)
     sizes_np = index.list_sizes
     sizes_j = jnp.asarray(sizes_np, jnp.int32)
+    mask_bits = filter.to_mask() if filter is not None else None
 
     # every storage dtype rides the pallas scan: f32/bf16 natively,
     # int8 via per-row scales applied to the dot in-kernel, uint8 exact
@@ -400,25 +402,41 @@ def search(
     if use_pallas:
         expects(mt in _PALLAS_METRICS, "metric %s unsupported by pallas",
                 mt.name)
-        pen_p = _scan_penalty(
-            index, filter.to_mask() if filter is not None else None,
-            int(index.list_sizes.max()))
+        pen_p = _scan_penalty(index, mask_bits,
+                              int(index.list_sizes.max()))
         dim_pad = -(-index.dim // 128) * 128
         if query_chunk <= 0:
             # bound the (pairs × dim) query blocks to ~256 MB
             per_q = n_probes * dim_pad * 4
             query_chunk = max(1, min(q.shape[0],
                                      workspace_chunk_bytes(res) // max(per_q, 1)))
-        outs_d, outs_i = [], []
-        for c0 in range(0, q.shape[0], query_chunk):
-            d_c, i_c = _search_pallas(index, q[c0 : c0 + query_chunk], k,
-                                      n_probes, offsets_j, sizes_j,
-                                      precision, pen_p)
-            outs_d.append(d_c)
-            outs_i.append(i_c)
-        if len(outs_d) == 1:
-            return outs_d[0], outs_i[0]
-        return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+        fb_state: dict = {}   # built lazily: the fallback almost never runs
+
+        def _xla_fallback(qc):
+            # the gather path's per-query footprint (max_rows * dim * 4)
+            # is orders of magnitude above the kernel's — re-chunk to ITS
+            # workspace budget or the containment path itself OOMs
+            if not fb_state:
+                fb_state["max_rows"] = _probe_budget(sizes_np, n_probes)
+                per_q = fb_state["max_rows"] * index.dim * 4
+                fb_state["chunk"] = max(
+                    1, workspace_chunk_bytes(res) // max(per_q, 1))
+            return run_query_chunks(
+                lambda qs, _s0: _search_chunk(index, qs, k, n_probes,
+                                              fb_state["max_rows"],
+                                              offsets_j, sizes_j, mask_bits,
+                                              mt),
+                qc, fb_state["chunk"])
+
+        # guarded: a scan-kernel failure demotes this site to the exact
+        # XLA gather path (ops/guarded.py)
+        return run_query_chunks(
+            lambda qc, _s0: guarded_call(
+                "ivf_flat.scan",
+                lambda: _search_pallas(index, qc, k, n_probes, offsets_j,
+                                       sizes_j, precision, pen_p),
+                lambda: _xla_fallback(qc)),
+            q, query_chunk, res)
 
     max_rows = _probe_budget(sizes_np, n_probes)
     if query_chunk <= 0:
@@ -426,18 +444,10 @@ def search(
         per_q = max_rows * index.dim * 4
         query_chunk = max(1, min(q.shape[0], workspace_chunk_bytes(res) // max(per_q, 1)))
 
-    mask_bits = filter.to_mask() if filter is not None else None
-
-    outs_d, outs_i = [], []
-    for c0 in range(0, q.shape[0], query_chunk):
-        qc = q[c0 : c0 + query_chunk]
-        d_c, i_c = _search_chunk(index, qc, k, n_probes, max_rows, offsets_j,
-                                 sizes_j, mask_bits, mt)
-        outs_d.append(d_c)
-        outs_i.append(i_c)
-    if len(outs_d) == 1:
-        return outs_d[0], outs_i[0]
-    return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+    return run_query_chunks(
+        lambda qc, _s0: _search_chunk(index, qc, k, n_probes, max_rows,
+                                      offsets_j, sizes_j, mask_bits, mt),
+        q, query_chunk, res)
 
 
 def search_arrays(data, data_norms, source_ids, centers, center_norms,

@@ -45,10 +45,11 @@ from ..core.bitset import Bitset
 from ..core.errors import expects
 from ..core.resources import workspace_chunk_bytes
 from ..core.serialize import load_arrays, save_arrays
+from ..ops.guarded import guarded_call
 from ..cluster import kmeans_balanced
 from ..distance.distance_types import DistanceType, canonical_metric
 from ..matrix.select_k import select_k
-from ..utils import cdiv, hdot, in_jax_trace
+from ..utils import cdiv, hdot, in_jax_trace, run_query_chunks
 from .ivf_flat import _candidate_rows, _probe_budget
 
 __all__ = ["CodebookGen", "IndexParams", "SearchParams", "Index", "build",
@@ -600,29 +601,52 @@ def search(
 
             logger.info("ivf_pq auto: XLA gather path (%s); the pallas "
                         "scan kernel does not cover this config", why)
+    mask_bits = filter.to_mask() if filter is not None else None
     if use_pallas:
         expects(index.codebook_kind is CodebookGen.PER_SUBSPACE,
                 "algo='pallas' needs PER_SUBSPACE codebooks")
         expects(not wide_needs_bf16,
                 "algo='pallas' with pq_dim*2^pq_bits >= 8192 requires the "
                 "bf16 LUT mode (SearchParams.lut_dtype=jnp.bfloat16)")
-        pen_p = _scan_penalty(
-            index, filter.to_mask() if filter is not None else None,
-            int(index.list_sizes.max()))
+        pen_p = _scan_penalty(index, mask_bits,
+                              int(index.list_sizes.max()))
         if query_chunk <= 0:
             per_q = n_probes * index.rot_dim * 4 * 2
             query_chunk = max(1, min(q.shape[0],
                                      workspace_chunk_bytes(res) // max(per_q, 1)))
-        outs_d, outs_i = [], []
-        for c0 in range(0, q.shape[0], query_chunk):
-            d_c, i_c = _search_pallas(index, q[c0 : c0 + query_chunk], k,
-                                      n_probes, p.lut_dtype, precision,
-                                      pen_p)
-            outs_d.append(d_c)
-            outs_i.append(i_c)
-        if len(outs_d) == 1:
-            return outs_d[0], outs_i[0]
-        return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+        fb_state: dict = {}   # built lazily: the fallback almost never runs
+
+        def _xla_fallback(qc):
+            # the gather/LUT path's per-query footprint dwarfs the
+            # kernel's — re-chunk to ITS workspace budget or the
+            # containment path itself OOMs
+            if not fb_state:
+                sizes_np = index.list_sizes
+                fb_state["max_rows"] = _probe_budget(sizes_np, n_probes)
+                fb_state["offsets_j"] = jnp.asarray(
+                    index.list_offsets[:-1], jnp.int32)
+                fb_state["sizes_j"] = jnp.asarray(sizes_np, jnp.int32)
+                per_q = fb_state["max_rows"] * index.pq_dim * 8 + \
+                    n_probes * index.pq_dim * index.pq_book_size * 4
+                fb_state["chunk"] = max(
+                    1, workspace_chunk_bytes(res) // max(per_q, 1))
+            return run_query_chunks(
+                lambda qs, _s0: _search_chunk(index, qs, k, n_probes,
+                                              fb_state["max_rows"],
+                                              fb_state["offsets_j"],
+                                              fb_state["sizes_j"],
+                                              mask_bits, p.lut_dtype),
+                qc, fb_state["chunk"])
+
+        # guarded: a PQ-scan kernel failure demotes this site to the
+        # exact XLA gather/LUT path (ops/guarded.py)
+        return run_query_chunks(
+            lambda qc, _s0: guarded_call(
+                "ivf_pq.scan",
+                lambda: _search_pallas(index, qc, k, n_probes, p.lut_dtype,
+                                       precision, pen_p),
+                lambda: _xla_fallback(qc)),
+            q, query_chunk, res)
 
     sizes_np = index.list_sizes
     max_rows = _probe_budget(sizes_np, n_probes)
@@ -634,18 +658,12 @@ def search(
 
     offsets_j = jnp.asarray(index.list_offsets[:-1], jnp.int32)
     sizes_j = jnp.asarray(sizes_np, jnp.int32)
-    mask_bits = filter.to_mask() if filter is not None else None
 
-    outs_d, outs_i = [], []
-    for c0 in range(0, q.shape[0], query_chunk):
-        qc = q[c0 : c0 + query_chunk]
-        d_c, i_c = _search_chunk(index, qc, k, n_probes, max_rows, offsets_j,
-                                 sizes_j, mask_bits, p.lut_dtype)
-        outs_d.append(d_c)
-        outs_i.append(i_c)
-    if len(outs_d) == 1:
-        return outs_d[0], outs_i[0]
-    return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+    return run_query_chunks(
+        lambda qc, _s0: _search_chunk(index, qc, k, n_probes, max_rows,
+                                      offsets_j, sizes_j, mask_bits,
+                                      p.lut_dtype),
+        q, query_chunk, res)
 
 
 def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,

@@ -30,7 +30,7 @@ import jax
 
 from ..core import logging as rlog
 
-__all__ = ["shape_bucket", "lookup", "record", "measure",
+__all__ = ["shape_bucket", "lookup", "record", "forget", "measure",
            "measure_throughput", "measure_value_read_wall", "tune_best",
            "cache_path", "load_cache", "save_cache",
            "TimingUnreliableError"]
@@ -43,6 +43,9 @@ class TimingUnreliableError(RuntimeError):
     than record an impossible one."""
 
 _MEM_CACHE: Dict[str, str] = {}
+# keys recorded with persist=False (guard demotions): NEVER written to
+# disk, even when a later ordinary record() triggers save_cache()
+_EPHEMERAL: set = set()
 _DISK_LOADED = False
 
 # count of plausibility-floor trips (see measure); benches report it so
@@ -86,8 +89,10 @@ def save_cache() -> None:
     try:
         os.makedirs(os.path.dirname(p), exist_ok=True)
         tmp = p + f".tmp{os.getpid()}"
+        durable = {k: v for k, v in _MEM_CACHE.items()
+                   if k not in _EPHEMERAL}
         with open(tmp, "w") as f:
-            json.dump(_MEM_CACHE, f, indent=1, sort_keys=True)
+            json.dump(durable, f, indent=1, sort_keys=True)
         os.replace(tmp, p)
     except OSError as e:
         rlog.log_warn("autotune cache %s unwritable: %s", p, e)
@@ -112,10 +117,30 @@ def lookup(key: str) -> Optional[str]:
     return _MEM_CACHE.get(key)
 
 
-def record(key: str, choice: str) -> None:
+def record(key: str, choice: str, persist: bool = True) -> None:
+    """Record a winner. ``persist=False`` keeps the entry in-process only
+    (used for guard demotions from transient failures that must not
+    poison later processes through the disk cache) — such keys are also
+    excluded from every later ``save_cache`` dump."""
     load_cache()
     _MEM_CACHE[key] = choice
-    save_cache()
+    if persist:
+        _EPHEMERAL.discard(key)
+        save_cache()
+    else:
+        _EPHEMERAL.add(key)
+
+
+def forget(key: str) -> None:
+    """Drop an entry (guard reset / test isolation). A durable (persisted)
+    entry also rewrites the disk cache — an operator re-arming a demoted
+    site must not have the stale demotion resurrected by the next
+    process's load_cache."""
+    was_durable = key in _MEM_CACHE and key not in _EPHEMERAL
+    _MEM_CACHE.pop(key, None)
+    _EPHEMERAL.discard(key)
+    if was_durable:
+        save_cache()
 
 
 def _value_read(out) -> None:

@@ -36,6 +36,22 @@ __all__ = ["fused_knn"]
 _INT_BIG = 2**30  # sentinel column id, larger than any real lane index
 
 
+def _compiler_params(dimension_semantics):
+    """Version-compat TPU compiler params (resilience: API skew must
+    degrade to the equivalent spelling, not crash the kernel path).
+    Newer jax spells it ``pltpu.CompilerParams`` with a
+    ``GridDimensionSemantics`` enum; 0.4.x uses ``TPUCompilerParams``
+    with plain 'parallel'/'arbitrary' strings."""
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is not None:
+        sem = getattr(pltpu, "GridDimensionSemantics", None)
+        dims = (tuple(getattr(sem, s.upper()) for s in dimension_semantics)
+                if sem is not None and hasattr(sem, "PARALLEL") else None)
+        return cls(dimension_semantics=dims)
+    return pltpu.TPUCompilerParams(
+        dimension_semantics=tuple(dimension_semantics))
+
+
 def _pick_tiles(dim_p: int, k: int) -> Tuple[int, int]:
     """(query-tile, dataset-tile) sizes under a ~12 MB VMEM working set.
 
@@ -206,11 +222,7 @@ def _fused_knn_padded(q, d, dn, pen, k: int, metric: str, interpret: bool,
             pltpu.VMEM((tm, kp), jnp.float32),
             pltpu.VMEM((tm, kp), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=(pltpu.GridDimensionSemantics.PARALLEL,
-                                 pltpu.GridDimensionSemantics.ARBITRARY)
-            if hasattr(pltpu.GridDimensionSemantics, "PARALLEL") else None,
-        ),
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=flops,
             bytes_accessed=int(q.size + d.size + dn.size) * 4,

@@ -1,0 +1,106 @@
+"""Guarded kernel dispatch: Pallas engines fall back to their XLA-path
+equivalents on compile/execution failure.
+
+Every custom-kernel engine in this library has an exact composed-XLA
+equivalent (that is what the parity tests assert), so a Pallas failure —
+a Mosaic lowering bug on a new chip generation, a scoped-VMEM
+compile-OOM on an unrehearsed shape, a driver hiccup — should cost one
+log line and a slower call, never the request or the process. The
+reference hard-fails on kernel errors (RAFT_CUDA_TRY); a serving stack
+cannot.
+
+``guarded_call(site, primary, fallback)`` is the single chokepoint:
+
+* a **demoted** site (prior failure this process, or a ``guard:…`` entry
+  in the autotune cache) skips the kernel path entirely;
+* fault-injection probes (:mod:`raft_tpu.core.faults`) fire first, so
+  every fallback path is deterministically testable
+  (``RAFT_TPU_FAULTS='kernel_compile@*'``);
+* a real failure logs ONCE per site, records the demotion in the
+  autotune cache (in-process always; persisted to the cross-process
+  cache only when ``RAFT_TPU_GUARD_PERSIST=1``, so a transient failure
+  cannot poison future processes by default), and serves the fallback;
+* injected faults never demote — they simulate per-call failure, and a
+  simulation must not change later dispatch decisions.
+
+Trace caveat: when the guarded call happens inside an outer ``jit``
+trace, the kernel's own compilation may be deferred to the outer
+executable's compile, outside this try block — the guard then catches
+trace-time failures and armed faults, not late compile errors. Eager
+dispatch (the serving pattern) is fully covered.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict
+
+import jax
+
+from ..core import faults, logging as rlog
+from ..core.deadline import DeadlineExceeded
+from ..core.interruptible import InterruptedException
+
+__all__ = ["guarded_call", "demoted_sites", "reset"]
+
+# site -> reason string; demoted sites dispatch straight to the fallback
+_DEMOTED: Dict[str, str] = {}
+
+
+def _guard_key(site: str) -> str:
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", dev.platform).replace(" ", "_")
+    return f"{dev.platform}:{kind}:guard:{site}"
+
+
+def _demote(site: str, err: Exception, persist: bool) -> None:
+    from . import autotune
+
+    first = site not in _DEMOTED
+    _DEMOTED[site] = f"{type(err).__name__}: {err}"
+    if first:
+        rlog.log_warn(
+            "guarded %s: kernel path failed (%s: %s); demoted to the XLA "
+            "fallback for the rest of this process", site,
+            type(err).__name__, err)
+    autotune.record(
+        _guard_key(site), "fallback",
+        persist=persist and os.environ.get("RAFT_TPU_GUARD_PERSIST") == "1")
+
+
+def guarded_call(site: str, primary: Callable[[], object],
+                 fallback: Callable[[], object]):
+    """Run ``primary`` (the kernel engine) with ``fallback`` (its exact
+    XLA equivalent) as the containment path. See module docstring for the
+    demotion/injection contract. Cancellation and deadline exceptions
+    pass through — they are control flow, not engine failures."""
+    from . import autotune
+
+    if site in _DEMOTED or autotune.lookup(_guard_key(site)) == "fallback":
+        return fallback()
+    try:
+        faults.check("kernel_compile", site)
+        faults.sleep_if(site)
+        return primary()
+    except faults.InjectedFault:
+        # simulated failure: serve the fallback for THIS call only
+        return fallback()
+    except (KeyboardInterrupt, SystemExit, InterruptedException,
+            DeadlineExceeded):
+        raise
+    except Exception as e:  # noqa: BLE001 - any engine failure = contain
+        _demote(site, e, persist=True)
+        return fallback()
+
+
+def demoted_sites() -> Dict[str, str]:
+    """Sites demoted this process and why (diagnostics)."""
+    return dict(_DEMOTED)
+
+
+def reset() -> None:
+    """Clear in-process demotions (tests / operator re-arm after a fix)."""
+    from . import autotune
+
+    for site in list(_DEMOTED):
+        autotune.forget(_guard_key(site))
+    _DEMOTED.clear()

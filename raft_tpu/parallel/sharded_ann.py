@@ -18,24 +18,54 @@ is trivial.
 """
 from __future__ import annotations
 
-from typing import Tuple
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..comms import AxisComms
-from ..core.errors import expects
+from ..core import faults
+from ..core.errors import ShardsDownError, expects
 from ..distance.distance_types import DistanceType, canonical_metric, is_min_close
 from ..neighbors import brute_force, cagra, ivf_flat, ivf_pq
-from ..utils import cdiv
+from ..utils import cdiv, shard_map_compat
 
 __all__ = ["ShardedIvfFlat", "build_ivf_flat", "search_ivf_flat",
            "ShardedCagra", "build_cagra", "search_cagra",
            "ShardedIvfPq", "build_ivf_pq", "search_ivf_pq"]
 
 AXIS = "shard"
+
+
+def _shard_health(index, family: str) -> np.ndarray:
+    """Effective per-shard validity for one search call: the index's
+    sticky ``shards_ok`` flags (set by ``mark_shard_failed`` — e.g. after
+    a failed build, corrupt shard load, or repeated timeouts) AND'd with
+    any armed ``shard_dead``/``shard_timeout`` fault probes, so every
+    degraded-merge path is deterministically testable."""
+    ok = np.asarray(index.shards_ok, bool).copy()
+    for i in range(ok.size):
+        site = f"sharded_ann.{family}.shard{i}"
+        if ok[i] and (faults.fired("shard_dead", site) is not None
+                      or faults.fired("shard_timeout", site) is not None):
+            ok[i] = False
+    return ok
+
+
+def _health_gate(ok: np.ndarray, allow_partial: bool) -> None:
+    """Dead shards without ``allow_partial=True`` are an error, not a
+    silently-degraded answer — and ZERO surviving shards is total
+    failure, not a degraded answer: an all-(+inf, -1) result piped
+    downstream would silently wrap-index with -1."""
+    if not ok.all() and (not allow_partial or not ok.any()):
+        raise ShardsDownError(ok)
+
+
+def _shard_mask(mesh, ok: np.ndarray) -> jax.Array:
+    """(p, 1) bool validity mask sharded over the mesh axis (rides into
+    shard_map so each shard masks its own contribution pre-merge)."""
+    return jax.device_put(jnp.asarray(ok.reshape(-1, 1)),
+                          NamedSharding(mesh, P(AXIS, None)))
 
 
 def _comms_of(mesh, res=None) -> AxisComms:
@@ -85,6 +115,13 @@ class ShardedIvfFlat:
         self.metric = metric
         self._max_rows_tbl = max_rows_tbl   # host: n_probes → max_rows bound
         self.scales = scales                # (p, R) f32, int8 mode only
+        # sticky per-shard health flags (see mark_shard_failed)
+        self.shards_ok = np.ones(mesh.shape[AXIS], bool)
+
+    def mark_shard_failed(self, i: int, ok: bool = False) -> None:
+        """Flag shard ``i`` unhealthy: its results are masked out of every
+        merge until re-marked ok (search then needs allow_partial=True)."""
+        self.shards_ok[i] = ok
 
     @property
     def n_shards(self) -> int:
@@ -146,8 +183,15 @@ def build_ivf_flat(dataset, mesh: Mesh,
 
 def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
                     params: ivf_flat.SearchParams | None = None,
-                    res=None) -> Tuple[jax.Array, jax.Array]:
-    """Replicated queries → per-shard local search → allgather + merge."""
+                    res=None, allow_partial: bool = False):
+    """Replicated queries → per-shard local search → allgather + merge.
+
+    ``allow_partial=True`` accepts dead shards (``index.shards_ok`` or an
+    armed ``shard_dead``/``shard_timeout`` fault): their contributions
+    are masked out of the merge and the return becomes
+    ``(distances, indices, shards_ok)`` reporting the loss. Default
+    (False) raises :class:`ShardsDownError` when any shard is dead.
+    """
     sp = params or ivf_flat.SearchParams()
     q = jnp.asarray(queries, jnp.float32)
     n_probes = min(sp.n_probes, index.centers.shape[1])
@@ -155,10 +199,12 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
     mt = index.metric
     select_min = is_min_close(mt)
     comms = _comms_of(index.mesh, res)
+    ok = _shard_health(index, "ivf_flat")
+    _health_gate(ok, allow_partial)
 
     has_scales = index.scales is not None
 
-    def local(data, norms, gids, centers, cnorms, offsets, sizes, qq,
+    def local(data, norms, gids, centers, cnorms, offsets, sizes, okf, qq,
               *rest):
         args = [a[0] for a in (data, norms, gids, centers, cnorms, offsets,
                                sizes)]
@@ -166,25 +212,31 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
         d, i = ivf_flat.search_arrays(
             args[0], args[1], args[2], args[3], args[4], args[5], args[6],
             qq, k, n_probes, max_rows, mt, scales=sc)
+        # dead-shard containment: an invalid shard's list is all
+        # (+inf, -1), so the merge is over survivors only
+        bad = jnp.inf if select_min else -jnp.inf
+        d = jnp.where(okf[0, 0], d, bad)
+        i = jnp.where(okf[0, 0], i, -1)
         all_d = comms.allgather(d)              # (p, m, k)
         all_i = comms.allgather(i)
         return brute_force.knn_merge_parts(all_d, all_i, select_min)
 
     in_specs = [P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
                 P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
-                P(AXIS, None), P()]
+                P(AXIS, None), P(AXIS, None), P()]
     arrays = [index.data, index.data_norms, index.source_ids,
               index.centers, index.center_norms, index.offsets,
-              index.sizes, q]
+              index.sizes, _shard_mask(index.mesh, ok), q]
     if has_scales:
         in_specs.append(P(AXIS, None))
         arrays.append(index.scales)
-    shmap = jax.shard_map(
+    shmap = shard_map_compat(
         local, mesh=index.mesh,
         in_specs=tuple(in_specs),
         out_specs=(P(), P()),
-        check_vma=False)
-    return shmap(*arrays)
+        check=False)
+    d, i = shmap(*arrays)
+    return (d, i, ok) if allow_partial else (d, i)
 
 
 class ShardedCagra:
@@ -201,6 +253,11 @@ class ShardedCagra:
         self.metric = metric
         self.seeds = seeds      # (p, s) per-shard covering seed rows
                                 # (sorted unique; invalid-id padded)
+        self.shards_ok = np.ones(mesh.shape[AXIS], bool)
+
+    def mark_shard_failed(self, i: int, ok: bool = False) -> None:
+        """Flag shard ``i`` unhealthy (see ShardedIvfFlat.mark_shard_failed)."""
+        self.shards_ok[i] = ok
 
     @property
     def n_shards(self) -> int:
@@ -264,8 +321,11 @@ def build_cagra(dataset, mesh: Mesh,
 
 def search_cagra(index: ShardedCagra, queries, k: int,
                  params: cagra.SearchParams | None = None,
-                 res=None) -> Tuple[jax.Array, jax.Array]:
-    """Replicated queries → per-shard graph traversal → allgather + merge."""
+                 res=None, allow_partial: bool = False):
+    """Replicated queries → per-shard graph traversal → allgather + merge.
+
+    ``allow_partial``: degraded-merge contract of :func:`search_ivf_flat`.
+    """
     sp = params or cagra.SearchParams()
     q = jnp.asarray(queries, jnp.float32)
     itopk = max(sp.itopk_size, k)
@@ -277,10 +337,12 @@ def search_cagra(index: ShardedCagra, queries, k: int,
     mt = index.metric
     select_min = mt is not DistanceType.InnerProduct
     comms = _comms_of(index.mesh, res)
+    ok = _shard_health(index, "cagra")
+    _health_gate(ok, allow_partial)
 
     has_seeds = index.seeds is not None
 
-    def local(data, graph, base, count, qq, *rest):
+    def local(data, graph, base, count, okf, qq, *rest):
         # padding rows (beyond this shard's real count) are masked out so
         # neither random nor covering seeding can surface them
         valid = jnp.arange(data.shape[1], dtype=jnp.int32) < count[0]
@@ -290,6 +352,7 @@ def search_cagra(index: ShardedCagra, queries, k: int,
             jax.random.key(sp.seed), seed_rows, itopk,
             width, int(max_iter), k, n_seeds, mt.value)
         gi = jnp.where(i >= 0, i + base[0], -1)
+        gi = jnp.where(okf[0, 0], gi, -1)       # dead-shard containment
         bad = jnp.inf if select_min else -jnp.inf
         d = jnp.where(gi >= 0, d, bad)
         all_d = comms.allgather(d)
@@ -297,17 +360,19 @@ def search_cagra(index: ShardedCagra, queries, k: int,
         return brute_force.knn_merge_parts(all_d, all_i, select_min)
 
     in_specs = [P(AXIS, None, None), P(AXIS, None, None), P(AXIS), P(AXIS),
-                P()]
-    arrays = [index.data, index.graphs, index.bases, index.counts, q]
+                P(AXIS, None), P()]
+    arrays = [index.data, index.graphs, index.bases, index.counts,
+              _shard_mask(index.mesh, ok), q]
     if has_seeds:
         in_specs.append(P(AXIS, None))
         arrays.append(index.seeds)
-    shmap = jax.shard_map(
+    shmap = shard_map_compat(
         local, mesh=index.mesh,
         in_specs=tuple(in_specs),
         out_specs=(P(), P()),
-        check_vma=False)
-    return shmap(*arrays)
+        check=False)
+    d, i = shmap(*arrays)
+    return (d, i, ok) if allow_partial else (d, i)
 
 
 class ShardedIvfPq:
@@ -333,6 +398,11 @@ class ShardedIvfPq:
         self.pq_bits = pq_bits
         self.codebook_kind = codebook_kind
         self._sizes_host = sizes_host   # list of per-shard np size arrays
+        self.shards_ok = np.ones(mesh.shape[AXIS], bool)
+
+    def mark_shard_failed(self, i: int, ok: bool = False) -> None:
+        """Flag shard ``i`` unhealthy (see ShardedIvfFlat.mark_shard_failed)."""
+        self.shards_ok[i] = ok
 
     @property
     def n_shards(self) -> int:
@@ -383,9 +453,12 @@ def build_ivf_pq(dataset, mesh: Mesh,
 
 def search_ivf_pq(index: ShardedIvfPq, queries, k: int,
                   params: ivf_pq.SearchParams | None = None,
-                  res=None) -> Tuple[jax.Array, jax.Array]:
+                  res=None, allow_partial: bool = False):
     """Replicated queries → per-shard LUT search → allgather + merge
-    (knn_merge_parts.cuh:172 pattern over the comms allgather)."""
+    (knn_merge_parts.cuh:172 pattern over the comms allgather).
+
+    ``allow_partial``: degraded-merge contract of :func:`search_ivf_flat`.
+    """
     sp = params or ivf_pq.SearchParams()
     q = jnp.asarray(queries, jnp.float32)
     n_probes = min(sp.n_probes, index.centers_rot.shape[1])
@@ -393,29 +466,34 @@ def search_ivf_pq(index: ShardedIvfPq, queries, k: int,
     mt = index.metric
     select_min = is_min_close(mt)
     comms = _comms_of(index.mesh, res)
+    ok = _shard_health(index, "ivf_pq")
+    _health_gate(ok, allow_partial)
     # dummy host offsets: _search_chunk reads offsets/sizes from the traced
     # args, never from the Index (search() does, but we bypass it)
     dummy_off = np.zeros(index.centers_rot.shape[1] + 1, np.int64)
 
-    def local(codes, gids, centers, books, rots, offsets, sizes, qq):
+    def local(codes, gids, centers, books, rots, offsets, sizes, okf, qq):
         shard = ivf_pq.Index(
             codes[0], gids[0], centers[0], books[0], rots[0], dummy_off,
             mt, index.pq_bits, index.codebook_kind)
         d, i = ivf_pq._search_chunk(shard, qq, k, n_probes, max_rows,
                                     offsets[0], sizes[0], None, sp.lut_dtype)
+        i = jnp.where(okf[0, 0], i, -1)     # dead-shard containment
         bad = jnp.inf if select_min else -jnp.inf
         d = jnp.where(i >= 0, d, bad)       # padded rows carry id -1
         all_d = comms.allgather(d)
         all_i = comms.allgather(i)
         return brute_force.knn_merge_parts(all_d, all_i, select_min)
 
-    shmap = jax.shard_map(
+    shmap = shard_map_compat(
         local, mesh=index.mesh,
         in_specs=(P(AXIS, None, None), P(AXIS, None), P(AXIS, None, None),
                   P(AXIS, *([None] * (index.codebooks.ndim - 1))),
-                  P(AXIS, None, None), P(AXIS, None), P(AXIS, None), P()),
+                  P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
+                  P(AXIS, None), P()),
         out_specs=(P(), P()),
-        check_vma=False)
-    return shmap(index.codes, index.source_ids, index.centers_rot,
+        check=False)
+    d, i = shmap(index.codes, index.source_ids, index.centers_rot,
                  index.codebooks, index.rotations, index.offsets,
-                 index.sizes, q)
+                 index.sizes, _shard_mask(index.mesh, ok), q)
+    return (d, i, ok) if allow_partial else (d, i)

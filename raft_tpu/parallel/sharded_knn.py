@@ -24,7 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.errors import expects
 from ..distance.distance_types import is_min_close
 from ..neighbors import brute_force
-from ..utils import cdiv
+from ..utils import cdiv, shard_map_compat
 
 __all__ = ["ShardedIndex", "build", "search", "dryrun"]
 
@@ -105,12 +105,12 @@ def search(index: ShardedIndex, queries, k: int, tile_size: int = 8192,
         all_idx = jax.lax.all_gather(gidx, AXIS)
         return brute_force.knn_merge_parts(all_dist, all_idx, select_min)
 
-    shmap = jax.shard_map(
+    shmap = shard_map_compat(
         local_search,
         mesh=index.mesh,
         in_specs=(P(AXIS, None), P()),
         out_specs=(P(), P()),
-        check_vma=False,
+        check=False,
     )
     q = jnp.asarray(queries, jnp.float32)
     return shmap(index.dataset, q)

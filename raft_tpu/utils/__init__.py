@@ -16,6 +16,8 @@ __all__ = [
     "next_pow2",
     "is_pow2",
     "pad_to",
+    "run_query_chunks",
+    "shard_map_compat",
     "LANES",
     "SUBLANES_F32",
     "SUBLANES_BF16",
@@ -72,6 +74,51 @@ def hdot(x, y):
     import jax.numpy as jnp
 
     return jnp.matmul(x, y, precision="highest")
+
+
+def run_query_chunks(fn, q, chunk: int, res=None):
+    """THE chunked-search loop: apply ``fn((m_c, d) chunk, start_row)``
+    over row-chunks of ``q`` and concatenate the (vals, ids) pairs.
+
+    ``res`` (a Resources or bare Deadline, optional) adds a
+    cancellation + deadline checkpoint between chunk dispatches;
+    ``DeadlineExceeded`` carries the completed chunks' partial results.
+    Every chunked search entry point and guarded XLA fallback routes
+    through this one audited implementation."""
+    from ..core import deadline
+
+    outs_d, outs_i = [], []
+    for s0 in range(0, q.shape[0], chunk):
+        deadline.checkpoint(
+            res, partial=lambda: deadline.partial_topk(outs_d, outs_i))
+        d_c, i_c = fn(q[s0 : s0 + chunk], s0)
+        outs_d.append(d_c)
+        outs_i.append(i_c)
+    if len(outs_d) == 1:
+        return outs_d[0], outs_i[0]
+    import jax.numpy as jnp
+
+    return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs, check=False):
+    """``jax.shard_map`` across jax versions (resilience: a version skew
+    must degrade to the equivalent API, not crash the sharded path).
+    Newer jax exposes ``jax.shard_map(..., check_vma=)``; the promotion
+    window spelled the kwarg ``check_rep``; older releases only have
+    ``jax.experimental.shard_map.shard_map(..., check_rep=)``. The kwarg
+    is feature-tested, not version-guessed."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check)
+    except TypeError:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check)
 
 
 def in_jax_trace() -> bool:

@@ -7,6 +7,7 @@ import pytest
 
 from raft_tpu.comms import AxisComms, comms_test, init_comms, local_mesh
 from raft_tpu.core.resources import Resources
+from raft_tpu.utils import shard_map_compat
 
 
 @pytest.fixture(scope="module")
@@ -53,8 +54,8 @@ def test_allgatherv_and_gatherv(mesh):
             ok = ok * jnp.all(jnp.where(valid, g[r] == r, True))
         return comms.allreduce(ok)
 
-    shmap = jax.shard_map(body, mesh=mesh, in_specs=(), out_specs=P(),
-                          check_vma=False)
+    shmap = shard_map_compat(body, mesh=mesh, in_specs=(), out_specs=P(),
+                          check=False)
     assert float(np.asarray(jax.jit(shmap)())) == 8.0
 
 
@@ -82,8 +83,8 @@ def test_allgatherv_counts_masked_reduction(mesh):
         mask = jnp.arange(3)[None, :] < c[:, None]
         return jnp.sum(jnp.where(mask, g, 0.0))
 
-    shmap = jax.shard_map(body, mesh=mesh, in_specs=(), out_specs=P(),
-                          check_vma=False)
+    shmap = shard_map_compat(body, mesh=mesh, in_specs=(), out_specs=P(),
+                          check=False)
     got = float(np.asarray(jax.jit(shmap)()))
     assert got == float(want), (got, want)
 
@@ -103,6 +104,6 @@ def test_multicast_sendrecv(mesh):
         ok = (got[0] == want1) & (got[1] == want2)
         return comms.allreduce(ok.astype(jnp.float32))
 
-    shmap = jax.shard_map(body, mesh=mesh, in_specs=(), out_specs=P(),
-                          check_vma=False)
+    shmap = shard_map_compat(body, mesh=mesh, in_specs=(), out_specs=P(),
+                          check=False)
     assert float(np.asarray(jax.jit(shmap)())) == 8.0

@@ -1,0 +1,610 @@
+"""Resilient-execution-layer tests: fault injection, guarded kernel
+fallback, deadlines, degraded sharded search, durable index I/O.
+
+Everything here is deterministic and CPU-safe (the ``faults`` marker).
+The acceptance bar: with injection forcing kernel failure at every gated
+site, search results are BIT-IDENTICAL to the fallback engine run
+directly; a dead shard yields a degraded merged answer with the loss
+reported; corrupt/truncated index files raise a typed error naming the
+bad section; interrupted saves never leave a partial file.
+
+Index builds dominate this file's runtime on the 1-core CI box, so every
+index is a module-scoped fixture shared across test classes.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from raft_tpu.core import faults
+from raft_tpu.core.deadline import Deadline, DeadlineExceeded
+from raft_tpu.core.errors import CorruptIndexError, ShardsDownError
+from raft_tpu.core.resources import Resources
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _no_disk_autotune(monkeypatch):
+    # guard demotions ride the autotune cache; tests must not touch the
+    # user-level JSON
+    monkeypatch.setenv("RAFT_TPU_AUTOTUNE_CACHE", "")
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(42)
+    data = rng.standard_normal((800, 16)).astype(np.float32)
+    q = rng.standard_normal((24, 16)).astype(np.float32)
+    return data, q
+
+
+@pytest.fixture(scope="module")
+def flat_index(corpus):
+    from raft_tpu.neighbors import ivf_flat
+
+    return ivf_flat.build(corpus[0], ivf_flat.IndexParams(n_lists=8, seed=0))
+
+
+@pytest.fixture(scope="module")
+def pq_index(corpus):
+    from raft_tpu.neighbors import ivf_pq
+
+    return ivf_pq.build(corpus[0], ivf_pq.IndexParams(
+        n_lists=8, pq_dim=4, pq_bits=4, seed=0))
+
+
+@pytest.fixture(scope="module")
+def bf_index(corpus):
+    from raft_tpu.neighbors import brute_force
+
+    return brute_force.build(corpus[0])
+
+
+@pytest.fixture(scope="module")
+def cagra_index(corpus):
+    from raft_tpu.neighbors import cagra
+
+    return cagra.build(corpus[0], cagra.IndexParams(
+        graph_degree=8, intermediate_graph_degree=12, seed=0))
+
+
+def _ticking(ticks):
+    it = iter(ticks)
+    return lambda: next(it)
+
+
+class TestFaultFramework:
+    def test_spec_parse(self):
+        f = faults._parse_spec("kernel_compile@ivf_flat.*:3=0.5")
+        assert f.kind == "kernel_compile" and f.pattern == "ivf_flat.*"
+        assert f.count == 3 and f.value == "0.5"
+        f = faults._parse_spec("shard_dead")
+        assert f.pattern == "*" and f.count is None and f.value is None
+
+    def test_inject_scoped_and_counted(self):
+        # a private kind: this test must hold even under the faults lane
+        # (RAFT_TPU_FAULTS='kernel_compile@*' arming everything ambient)
+        assert faults.fired("unit_kind", "x.y") is None
+        with faults.inject("unit_kind", "x.*", count=2):
+            assert faults.fired("unit_kind", "x.y") is not None
+            assert faults.fired("unit_kind", "nomatch") is None
+            assert faults.fired("unit_kind", "x.z") is not None
+            assert faults.fired("unit_kind", "x.y") is None   # spent
+        assert faults.fired("unit_kind", "x.y") is None       # scoped
+
+    def test_check_raises(self):
+        with faults.inject("io_error", "site.a"):
+            with pytest.raises(faults.InjectedFault, match="site.a"):
+                faults.check("io_error", "site.a")
+        faults.check("io_error", "site.a")  # disarmed: no raise
+
+    def test_env_spec(self):
+        os.environ["RAFT_TPU_FAULTS"] = "slow_dispatch@env.site:1=0"
+        try:
+            faults.reload_env()
+            assert faults.fired("slow_dispatch", "env.site") is not None
+            assert faults.fired("slow_dispatch", "env.site") is None
+        finally:
+            os.environ.pop("RAFT_TPU_FAULTS", None)
+            faults.reload_env()
+
+    def test_corrupt_flips_one_bit(self):
+        data = bytes(range(64))
+        with faults.inject("corrupt_bytes", "c.site", value=10):
+            out = faults.corrupt("c.site", data)
+        assert out != data and len(out) == len(data)
+        assert out[10] == data[10] ^ 1
+        assert faults.corrupt("c.site", data) == data  # disarmed
+
+
+class TestGuardedFallback:
+    """Acceptance: with kernel_compile forced at every gated site, the
+    searches return bit-identical results to the fallback engine run
+    directly (the fallbacks are exact)."""
+
+    def test_select_k_kpass_falls_back_exact(self):
+        from raft_tpu.matrix.select_k import select_k
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((130, 1024)), jnp.float32)
+        with faults.inject("kernel_compile"):
+            v1, i1 = select_k(x, 5, algo="kpass")
+        v2, i2 = select_k(x, 5, algo="topk")
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_ivf_flat_scan_falls_back_exact(self, corpus, flat_index):
+        from raft_tpu.neighbors import ivf_flat
+
+        _, q = corpus
+        sp = ivf_flat.SearchParams(n_probes=8)
+        with faults.inject("kernel_compile"):
+            dp, ip = ivf_flat.search(flat_index, q, 8, sp, algo="pallas")
+        dx, ix = ivf_flat.search(flat_index, q, 8, sp, algo="xla")
+        np.testing.assert_array_equal(np.asarray(ip), np.asarray(ix))
+        np.testing.assert_array_equal(np.asarray(dp), np.asarray(dx))
+
+    def test_ivf_pq_scan_falls_back_exact(self, corpus, pq_index):
+        from raft_tpu.neighbors import ivf_pq
+
+        _, q = corpus
+        sp = ivf_pq.SearchParams(n_probes=8)
+        with faults.inject("kernel_compile"):
+            dp, ip = ivf_pq.search(pq_index, q, 8, sp, algo="pallas")
+        dx, ix = ivf_pq.search(pq_index, q, 8, sp, algo="xla")
+        np.testing.assert_array_equal(np.asarray(ip), np.asarray(ix))
+        np.testing.assert_array_equal(np.asarray(dp), np.asarray(dx))
+
+    def test_brute_force_fused_falls_back_exact(self, corpus, bf_index):
+        from raft_tpu.neighbors import brute_force
+
+        _, q = corpus
+        with faults.inject("kernel_compile"):
+            dp, ip = brute_force.search(bf_index, q, 10, algo="pallas")
+        dm, im = brute_force.search(bf_index, q, 10, algo="matmul")
+        np.testing.assert_array_equal(np.asarray(ip), np.asarray(im))
+        np.testing.assert_array_equal(np.asarray(dp), np.asarray(dm))
+
+    def test_cagra_unaffected_by_kernel_faults(self, corpus, cagra_index):
+        # cagra's only kernel dependency is select_k's (guarded) KPASS
+        # engine; forcing kernel failure everywhere must not change its
+        # results
+        from raft_tpu.neighbors import cagra
+
+        _, q = corpus
+        d0, i0 = cagra.search(cagra_index, q, 5)
+        with faults.inject("kernel_compile"):
+            d1, i1 = cagra.search(cagra_index, q, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    def test_real_failure_demotes_and_logs_once(self):
+        from raft_tpu.ops import autotune, guarded
+
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise RuntimeError("mosaic lowering died")
+
+        try:
+            assert guarded.guarded_call("t.site", boom, lambda: "fb") == "fb"
+            # demoted: the second call must not touch the kernel path
+            assert guarded.guarded_call("t.site", boom, lambda: "fb") == "fb"
+            assert len(calls) == 1
+            assert "t.site" in guarded.demoted_sites()
+            # the demotion is recorded in the autotune cache
+            assert autotune.lookup(guarded._guard_key("t.site")) == "fallback"
+        finally:
+            guarded.reset()
+        assert "t.site" not in guarded.demoted_sites()
+        assert autotune.lookup(guarded._guard_key("t.site")) is None
+
+    def test_ephemeral_demotion_never_hits_disk(self, tmp_path, monkeypatch):
+        """A persist=False guard demotion must not leak into the disk
+        cache when a later ordinary record() dumps it."""
+        import json
+
+        from raft_tpu.ops import autotune
+
+        cache = tmp_path / "autotune.json"
+        monkeypatch.setenv("RAFT_TPU_AUTOTUNE_CACHE", str(cache))
+        try:
+            autotune.record("guard:test:x", "fallback", persist=False)
+            autotune.record("select_k_test_key", "topk")   # triggers save
+            disk = json.loads(cache.read_text())
+            assert "select_k_test_key" in disk
+            assert "guard:test:x" not in disk
+            # still honored in-process
+            assert autotune.lookup("guard:test:x") == "fallback"
+        finally:
+            autotune.forget("guard:test:x")
+            autotune.forget("select_k_test_key")
+
+    def test_injected_faults_do_not_demote(self):
+        from raft_tpu.ops import guarded
+
+        ran = []
+        with faults.inject("kernel_compile", "i.site", count=1):
+            assert guarded.guarded_call(
+                "i.site", lambda: "kern", lambda: "fb") == "fb"
+        # injection spent: the kernel path runs again (no sticky demotion)
+        assert guarded.guarded_call(
+            "i.site", lambda: ran.append(1) or "kern", lambda: "fb") == "kern"
+        assert ran and "i.site" not in guarded.demoted_sites()
+
+    def test_cancellation_passes_through(self):
+        from raft_tpu.core.interruptible import InterruptedException
+        from raft_tpu.ops import guarded
+
+        def cancelled():
+            raise InterruptedException("stop")
+
+        with pytest.raises(InterruptedException):
+            guarded.guarded_call("c.site", cancelled, lambda: "fb")
+        assert "c.site" not in guarded.demoted_sites()
+
+
+class TestDeadline:
+    def test_deadline_clock(self):
+        dl = Deadline(1.0, clock=_ticking([0.0, 0.5, 1.5]))
+        assert not dl.expired()
+        assert dl.expired()
+
+    def test_checkpoint_attaches_partial(self):
+        res = Resources(deadline=Deadline(
+            1.0, clock=_ticking([0.0, 2.0, 2.0])))
+        from raft_tpu.core import deadline as dl_mod
+
+        with pytest.raises(DeadlineExceeded) as ei:
+            dl_mod.checkpoint(res, partial=lambda: "the-partial")
+        assert ei.value.partial == "the-partial"
+
+    def test_ivf_flat_partial_results(self, corpus, flat_index):
+        """A deadline shorter than the chunked search raises BETWEEN
+        chunks with the completed chunks' results attached."""
+        from raft_tpu.neighbors import ivf_flat
+
+        _, q = corpus
+        sp = ivf_flat.SearchParams(n_probes=8)
+        dx, ix = ivf_flat.search(flat_index, q, 8, sp, algo="xla")
+        # ticks: Deadline init, ck@chunk0 (ok), ck@chunk1 (expired) + the
+        # elapsed() read in the error message
+        res = Resources(deadline=Deadline(
+            1.0, clock=_ticking([0.0, 0.5, 2.0, 2.0])))
+        with pytest.raises(DeadlineExceeded) as ei:
+            ivf_flat.search(flat_index, q, 8, sp, algo="xla", query_chunk=8,
+                            res=res)
+        pd, pi = ei.value.partial
+        assert pd.shape == (8, 8)
+        np.testing.assert_array_equal(np.asarray(pi), np.asarray(ix[:8]))
+        np.testing.assert_array_equal(np.asarray(pd), np.asarray(dx[:8]))
+
+    def test_ivf_pq_partial_results(self, corpus, pq_index):
+        from raft_tpu.neighbors import ivf_pq
+
+        _, q = corpus
+        sp = ivf_pq.SearchParams(n_probes=8)
+        _, ix = ivf_pq.search(pq_index, q, 8, sp, algo="xla")
+        res = Resources(deadline=Deadline(
+            1.0, clock=_ticking([0.0, 0.5, 2.0, 2.0])))
+        with pytest.raises(DeadlineExceeded) as ei:
+            ivf_pq.search(pq_index, q, 8, sp, algo="xla", query_chunk=8,
+                          res=res)
+        pd, pi = ei.value.partial
+        assert pd.shape == (8, 8)
+        np.testing.assert_array_equal(np.asarray(pi), np.asarray(ix[:8]))
+
+    def test_brute_force_partial_results(self, corpus, bf_index):
+        from raft_tpu.neighbors import brute_force
+
+        _, q = corpus
+        _, ix = brute_force.search(bf_index, q, 5)
+        res = Resources(deadline=Deadline(
+            1.0, clock=_ticking([0.0, 0.5, 2.0, 2.0])))
+        with pytest.raises(DeadlineExceeded) as ei:
+            brute_force.search(bf_index, q, 5, res=res, query_chunk=8)
+        pd, pi = ei.value.partial
+        assert pd.shape == (8, 5)
+        np.testing.assert_array_equal(np.asarray(pi), np.asarray(ix[:8]))
+
+    def test_cagra_deadline_between_chunks(self, corpus, cagra_index):
+        from raft_tpu.neighbors import cagra
+
+        _, q = corpus
+        _, ix = cagra.search(cagra_index, q, 5)
+        res = Resources(deadline=Deadline(
+            1.0, clock=_ticking([0.0, 0.5, 2.0, 2.0])))
+        with pytest.raises(DeadlineExceeded) as ei:
+            cagra.search(cagra_index, q, 5, res=res, query_chunk=8)
+        pd, pi = ei.value.partial
+        assert pd.shape == (8, 5)
+        np.testing.assert_array_equal(np.asarray(pi), np.asarray(ix[:8]))
+
+    def test_bare_deadline_as_res(self, corpus, bf_index):
+        """A bare Deadline passed as res is honored, not a silent no-op
+        — even when the whole batch fits one chunk (pre-dispatch check)."""
+        from raft_tpu.neighbors import brute_force
+
+        _, q = corpus
+        with pytest.raises(DeadlineExceeded):
+            brute_force.search(bf_index, q, 5,
+                               res=Deadline(1.0,
+                                            clock=_ticking([0.0, 5.0, 5.0])))
+
+    def test_expired_before_first_chunk_has_empty_partial(self, corpus,
+                                                          flat_index):
+        from raft_tpu.neighbors import ivf_flat
+
+        _, q = corpus
+        res = Resources(deadline=Deadline(
+            1.0, clock=_ticking([0.0, 5.0, 5.0])))
+        with pytest.raises(DeadlineExceeded) as ei:
+            ivf_flat.search(flat_index, q, 8, ivf_flat.SearchParams(n_probes=8),
+                            algo="xla", query_chunk=8, res=res)
+        assert ei.value.partial is None
+
+    def test_interruptible_token_protocol(self, corpus, flat_index):
+        """checkpoint is a full cancellation point: a cancelled token
+        aborts the chunked search through the same probe."""
+        from raft_tpu.core import interruptible
+        from raft_tpu.neighbors import ivf_flat
+
+        _, q = corpus
+        sp = ivf_flat.SearchParams(n_probes=8)
+        interruptible.cancel()
+        with pytest.raises(interruptible.InterruptedException):
+            ivf_flat.search(flat_index, q, 8, sp, algo="xla", query_chunk=8)
+        # token resets after raising (interruptible contract)
+        ivf_flat.search(flat_index, q, 8, sp, algo="xla", query_chunk=8)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.array(jax.devices()[:4]), ("shard",))
+
+
+@pytest.fixture(scope="module")
+def sharded_data():
+    rng = np.random.default_rng(17)
+    data = rng.standard_normal((1200, 16)).astype(np.float32)
+    q = rng.standard_normal((20, 16)).astype(np.float32)
+    return data, q
+
+
+@pytest.fixture(scope="module")
+def sharded_flat(mesh, sharded_data):
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.parallel import sharded_ann
+
+    return sharded_ann.build_ivf_flat(
+        sharded_data[0], mesh, ivf_flat.IndexParams(n_lists=8, seed=0))
+
+
+class TestDegradedSharded:
+    """Acceptance: a forced single-shard failure with allow_partial=True
+    returns merged results from the surviving shards, with shards_ok
+    reporting the loss; without allow_partial it raises.
+
+    Shard i of the 4-shard mesh owns global rows [i*300, (i+1)*300)."""
+
+    def test_ivf_flat_degraded(self, sharded_flat, sharded_data):
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.parallel import sharded_ann
+
+        data, q = sharded_data
+        sp = ivf_flat.SearchParams(n_probes=8)
+        with faults.inject("shard_dead", "sharded_ann.ivf_flat.shard1"):
+            with pytest.raises(ShardsDownError, match=r"\[1\]"):
+                sharded_ann.search_ivf_flat(sharded_flat, q, 5, sp)
+        with faults.inject("shard_dead", "sharded_ann.ivf_flat.shard1"):
+            d, i, ok = sharded_ann.search_ivf_flat(
+                sharded_flat, q, 5, sp, allow_partial=True)
+        assert list(ok) == [True, False, True, True]
+        got = np.asarray(i)
+        # shard 1 owns global rows [300, 600): none may appear
+        assert not (((got >= 300) & (got < 600)).any())
+        # survivors still produce a full merged answer
+        assert (got >= 0).all() and np.isfinite(np.asarray(d)).all()
+        # degraded result == exact search over the surviving rows
+        from ann_utils import calc_recall, naive_knn
+
+        keep = np.concatenate([np.arange(0, 300), np.arange(600, 1200)])
+        _, want = naive_knn(data[keep], q, 5)
+        assert calc_recall(got, keep[want]) == 1.0
+
+    def test_sticky_flag_and_healthy_api(self, sharded_flat, sharded_data):
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.parallel import sharded_ann
+
+        _, q = sharded_data
+        sp = ivf_flat.SearchParams(n_probes=8)
+        sharded_flat.mark_shard_failed(2)
+        try:
+            _, i, ok = sharded_ann.search_ivf_flat(sharded_flat, q, 5, sp,
+                                                   allow_partial=True)
+            assert list(ok) == [True, True, False, True]
+            got = np.asarray(i)
+            assert not (((got >= 600) & (got < 900)).any())
+        finally:
+            sharded_flat.mark_shard_failed(2, ok=True)   # re-arm
+        # healthy index: legacy 2-tuple API, allow_partial reports all-ok
+        out = sharded_ann.search_ivf_flat(sharded_flat, q, 5, sp)
+        assert len(out) == 2
+        d, i, ok = sharded_ann.search_ivf_flat(sharded_flat, q, 5, sp,
+                                               allow_partial=True)
+        assert ok.all()
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(out[1]))
+
+    def test_ivf_pq_degraded(self, mesh, sharded_data):
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.parallel import sharded_ann
+
+        data, q = sharded_data
+        # pq_bits=4: a 300-row shard has too few training residuals for
+        # the default 256-entry codebooks
+        index = sharded_ann.build_ivf_pq(
+            data, mesh, ivf_pq.IndexParams(n_lists=8, pq_dim=8, pq_bits=4,
+                                           seed=0))
+        sp = ivf_pq.SearchParams(n_probes=8)
+        with faults.inject("shard_timeout", "sharded_ann.ivf_pq.shard3"):
+            d, i, ok = sharded_ann.search_ivf_pq(
+                index, q, 5, sp, allow_partial=True)
+        assert list(ok) == [True, True, True, False]
+        got = np.asarray(i)
+        assert not (got >= 900).any()   # shard 3 owns [900, 1200)
+        assert (got >= 0).all()
+
+    def test_cagra_degraded(self, mesh, sharded_data):
+        from raft_tpu.neighbors import cagra
+        from raft_tpu.parallel import sharded_ann
+
+        data, q = sharded_data
+        index = sharded_ann.build_cagra(
+            data, mesh, cagra.IndexParams(
+                intermediate_graph_degree=16, graph_degree=8, seed=0))
+        sp = cagra.SearchParams(itopk_size=32)
+        with faults.inject("shard_dead", "sharded_ann.cagra.shard0"):
+            d, i, ok = sharded_ann.search_cagra(
+                index, q, 5, sp, allow_partial=True)
+        assert list(ok) == [False, True, True, True]
+        got = np.asarray(i)
+        assert not ((got >= 0) & (got < 300)).any()
+        assert (got >= 0).all()
+
+
+class TestDurableIO:
+    """Acceptance: truncated or bit-flipped files raise CorruptIndexError
+    naming the bad section; interrupted saves never leave a partial file
+    at the target path."""
+
+    def test_corrupt_named_section(self, tmp_path, rng):
+        from raft_tpu.core import serialize
+
+        path = str(tmp_path / "x.raft")
+        serialize.save_arrays(path, "t", 1, {"n": 4}, {
+            "aa": rng.standard_normal((8, 4)).astype(np.float32),
+            "zz": np.arange(8, dtype=np.int64)})
+        raw = bytearray(open(path, "rb").read())
+        raw[-3] ^= 0x10                 # inside the LAST array section
+        open(path, "wb").write(bytes(raw))
+        with pytest.raises(CorruptIndexError) as ei:
+            serialize.load_arrays(path)
+        assert ei.value.section == "zz"
+
+    def test_truncated_named_section(self, tmp_path, rng):
+        from raft_tpu.core import serialize
+
+        path = str(tmp_path / "x.raft")
+        serialize.save_arrays(path, "t", 1, {}, {
+            "data": rng.standard_normal((64, 8)).astype(np.float32)})
+        raw = open(path, "rb").read()
+        open(path, "wb").write(raw[: len(raw) - 40])
+        with pytest.raises(CorruptIndexError) as ei:
+            serialize.load_arrays(path)
+        assert ei.value.section == "data"
+
+    def test_corrupt_length_prefix_is_contained(self, tmp_path, rng):
+        """A flipped high bit in a length prefix must report corruption,
+        not attempt an exabyte allocation."""
+        from raft_tpu.core import serialize
+
+        path = str(tmp_path / "x.raft")
+        serialize.save_arrays(path, "t", 1, {}, {
+            "data": rng.standard_normal((16, 4)).astype(np.float32)})
+        raw = bytearray(open(path, "rb").read())
+        at = raw.find(b"\x04\x00data") + 6
+        raw[at + 7] ^= 0x40             # high byte of the little-endian <Q
+        open(path, "wb").write(bytes(raw))
+        with pytest.raises(CorruptIndexError) as ei:
+            serialize.load_arrays(path)
+        assert ei.value.section == "data"
+
+    def test_legacy_files_still_load(self, rng):
+        # a file in the pre-checksum layout (header + count + raw frames)
+        import io
+        import struct
+
+        from raft_tpu.core import serialize
+
+        arrays = {"a": rng.standard_normal((5, 3)).astype(np.float32)}
+        meta = {"metric": "l2", "n": 5}
+        buf = io.BytesIO()
+        serialize.serialize_header(buf, "legacy", 2, meta)
+        buf.write(struct.pack("<I", 1))
+        buf.write(struct.pack("<H", 1) + b"a")
+        serialize.serialize_array(buf, arrays["a"])
+        buf.seek(0)
+        kind, version, meta2, arrays2 = serialize.load_arrays(buf, "legacy")
+        assert (kind, version, meta2) == ("legacy", 2, meta)
+        np.testing.assert_array_equal(arrays2["a"], arrays["a"])
+
+    def test_interrupted_save_is_atomic(self, tmp_path, rng):
+        from raft_tpu.core import serialize
+
+        path = str(tmp_path / "idx.raft")
+        arrays = {"d": rng.standard_normal((16, 4)).astype(np.float32)}
+        serialize.save_arrays(path, "t", 1, {}, arrays)
+        with faults.inject("io_error", "core.serialize.save_arrays"):
+            with pytest.raises(faults.InjectedFault):
+                serialize.save_arrays(path, "t", 9, {"new": True}, arrays)
+        # the previous good file is intact and no temp litter remains
+        _, version, meta, _ = serialize.load_arrays(path)
+        assert version == 1 and "new" not in meta
+        assert os.listdir(tmp_path) == ["idx.raft"]
+
+    def test_interrupted_first_save_leaves_nothing(self, tmp_path, rng):
+        from raft_tpu.core import serialize
+
+        path = str(tmp_path / "fresh.raft")
+        with faults.inject("io_error", "core.serialize.save_arrays"):
+            with pytest.raises(faults.InjectedFault):
+                serialize.save_arrays(path, "t", 1, {}, {
+                    "d": rng.standard_normal((4, 4)).astype(np.float32)})
+        assert os.listdir(tmp_path) == []
+
+    def test_ivf_flat_corrupt_index(self, tmp_path, flat_index):
+        from raft_tpu.neighbors import ivf_flat
+
+        path = tmp_path / "ivf.raft"
+        ivf_flat.save(flat_index, path)
+        loaded = ivf_flat.load(path)     # clean file round-trips
+        assert loaded.size == flat_index.size
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) // 2] ^= 0x40
+        open(path, "wb").write(bytes(raw))
+        with pytest.raises(CorruptIndexError) as ei:
+            ivf_flat.load(path)
+        assert ei.value.section
+
+    def test_ivf_pq_corrupt_index(self, tmp_path, pq_index):
+        from raft_tpu.neighbors import ivf_pq
+
+        path = tmp_path / "pq.raft"
+        ivf_pq.save(pq_index, path)
+        assert ivf_pq.load(path).size == pq_index.size
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) // 2] ^= 0x40
+        open(path, "wb").write(bytes(raw))
+        with pytest.raises(CorruptIndexError):
+            ivf_pq.load(path)
+
+    def test_cagra_corrupt_and_write_fault(self, tmp_path, cagra_index):
+        from raft_tpu.neighbors import cagra
+
+        path = tmp_path / "cagra.raft"
+        # corruption injected at WRITE time (after checksumming) is
+        # caught by the reader's CRC — the storage-rot model
+        with faults.inject("corrupt_bytes", "core.serialize.array.graph"):
+            cagra.save(cagra_index, path)
+        with pytest.raises(CorruptIndexError) as ei:
+            cagra.load(path)
+        assert ei.value.section == "graph"
+        cagra.save(cagra_index, path)
+        loaded = cagra.load(path)
+        np.testing.assert_array_equal(np.asarray(loaded.graph),
+                                      np.asarray(cagra_index.graph))

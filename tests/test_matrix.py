@@ -180,14 +180,17 @@ class TestSelectKAutoDispatch:
         assert v.dtype == jnp.bfloat16
 
     def test_kpass_vmem_column_cap(self, rng):
-        """Rows wider than 8192 must never dispatch to KPASS: the kernel
-        keeps ~3 (128, n) f32 planes on the scoped-VMEM stack and a
-        15744-wide block compile-OOMs on v5e (measured r5). AUTO falls
-        back to TOPK; the chunked wide path stays exact."""
+        """Rows wider than 4096 must never dispatch to KPASS: the kernel
+        keeps ~5 live (128, n) f32/i32 planes on the scoped-VMEM stack,
+        and measured compile-OOMs on v5e put (128, 15744) at 24.8 MB and
+        even (128, 8192) at 21.3 MB against the 16 MB scoped limit —
+        4096 (~10.5 MB) is the rehearsed-safe width. AUTO falls back to
+        TOPK; the chunked wide path stays exact. 4224 sits just past the
+        cap, exercising the excluded-range boundary."""
         from raft_tpu.matrix.select_k import _kpass_eligible, _kpass_safe
         from raft_tpu.neighbors.brute_force import _wide_select_k
 
-        for n in (8192, 15744):
+        for n in (4224, 8192, 15744):
             x = jnp.zeros((520, n), jnp.float32)
             assert not _kpass_safe(x, 10) and not _kpass_eligible(x, 10)
 
