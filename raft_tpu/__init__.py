@@ -24,10 +24,12 @@ Subpackages mirror the reference's domain split (SURVEY.md §1 layer map):
 - ``comms``     distributed communicator over jax collectives
 - ``parallel``  multi-chip (MNMG-analog) sharded algorithms
 - ``ops``       Pallas TPU kernels backing the hot paths
+- ``serve``     query-serving runtime: micro-batching, admission
+                control, warmup, metrics (docs/serving.md)
 """
 
 __version__ = "0.1.0"
 
-from . import core  # noqa: F401
+from . import core, serve  # noqa: F401
 
-__all__ = ["core", "__version__"]
+__all__ = ["core", "serve", "__version__"]

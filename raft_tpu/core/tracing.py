@@ -4,19 +4,39 @@ Reference: raft/core/nvtx.hpp:84 (RAII ``nvtx::range`` pushed at every public
 entry point, compiled out unless RAFT_NVTX). Here ranges map onto
 ``jax.profiler.TraceAnnotation`` so they show up in TPU profiler/Perfetto
 traces; a module-level switch keeps them zero-cost when disabled.
+
+A span *timer* can additionally be installed with :func:`set_timer`
+(``raft_tpu.serve.metrics.enable_span_metrics`` does): every range and
+annotated call then reports its wall duration under its span name,
+giving the serving metrics per-stage latency histograms for free. The
+timer is independent of the profiler switch — metrics collection must
+not require Perfetto tracing to be on — and both default off, keeping
+the probes one ``is None`` check on the hot path.
 """
 from __future__ import annotations
 
 import contextlib
 import functools
 import os
-from typing import Iterator
+import time
+from typing import Callable, Iterator, Optional
 
 import jax
 
-__all__ = ["enabled", "enable", "disable", "range", "annotate"]
+__all__ = ["enabled", "enable", "disable", "range", "annotate", "set_timer"]
 
 _enabled = os.environ.get("RAFT_TPU_TRACE", "0") not in ("0", "", "false")
+
+# (span_name, seconds) observer; None = timing off (the default)
+_timer: Optional[Callable[[str, float], None]] = None
+
+
+def set_timer(fn: Optional[Callable[[str, float], None]]) -> None:
+    """Install (or clear with None) the span-duration observer. Spans
+    report host wall time between entry and exit — for searches that is
+    dispatch-to-value time, the serving-relevant quantity."""
+    global _timer
+    _timer = fn
 
 
 def enabled() -> bool:
@@ -36,11 +56,20 @@ def disable() -> None:
 @contextlib.contextmanager
 def range(name: str) -> Iterator[None]:  # noqa: A001 - mirrors nvtx::range
     """Context-managed trace range (analog of ``raft::common::nvtx::range``)."""
-    if _enabled:
-        with jax.profiler.TraceAnnotation(name):
-            yield
-    else:
+    timer = _timer
+    if timer is None and not _enabled:
         yield
+        return
+    t0 = time.perf_counter()
+    try:
+        if _enabled:
+            with jax.profiler.TraceAnnotation(name):
+                yield
+        else:
+            yield
+    finally:
+        if timer is not None:
+            timer(name, time.perf_counter() - t0)
 
 
 def annotate(name: str | None = None):
@@ -51,10 +80,18 @@ def annotate(name: str | None = None):
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            if not _enabled:
+            timer = _timer
+            if timer is None and not _enabled:
                 return fn(*args, **kwargs)
-            with jax.profiler.TraceAnnotation(label):
+            t0 = time.perf_counter()
+            try:
+                if _enabled:
+                    with jax.profiler.TraceAnnotation(label):
+                        return fn(*args, **kwargs)
                 return fn(*args, **kwargs)
+            finally:
+                if timer is not None:
+                    timer(label, time.perf_counter() - t0)
 
         return wrapper
 

@@ -32,7 +32,7 @@ from ..matrix.select_k import select_k
 from ..utils import hdot, in_jax_trace, round_up_to, run_query_chunks
 
 __all__ = ["Index", "build", "search", "knn", "knn_merge_parts", "save",
-           "load", "tune_search"]
+           "load", "tune_search", "make_searcher"]
 
 # v2: store_dtype meta + uint16-framed bf16 datasets + int8 scales; v1
 # files (plain f32) remain readable
@@ -614,3 +614,21 @@ def load(path) -> Index:
         meta["metric_arg"],
         jnp.asarray(arrays["scales"]) if "scales" in arrays else None,
     )
+
+
+def make_searcher(index: Index, params=None, **opts):
+    """Stable batchable signature for the serving runtime
+    (:mod:`raft_tpu.serve`): returns ``fn(queries, k, res=None) ->
+    (distances, indices)`` with every engine choice frozen at closure
+    build time, so repeated bucketed-shape calls hit the same cached
+    executables. ``params`` exists for signature parity across the index
+    families (brute force has no SearchParams and rejects one); ``opts``
+    forwards to :func:`search` (``algo``, ``precision``, ``filter``,
+    ``query_chunk``, ...)."""
+    expects(params is None, "brute_force has no SearchParams; pass engine "
+            "options as keywords")
+
+    def _fn(queries, k, res=None):
+        return search(index, queries, k, res=res, **opts)
+
+    return _fn

@@ -877,3 +877,17 @@ def load(path) -> Index:
         seeds = jnp.asarray(np.unique(np.asarray(seeds)), jnp.int32)
     return Index(jnp.asarray(arrs["dataset"]), jnp.asarray(arrs["graph"]),
                  DistanceType(meta["metric"]), seeds)
+
+
+def make_searcher(index: Index, params: SearchParams | None = None, **opts):
+    """Stable batchable signature for the serving runtime
+    (:mod:`raft_tpu.serve`): returns ``fn(queries, k, res=None) ->
+    (distances, indices)`` with the traversal policy frozen at closure
+    build time, so repeated bucketed-shape calls hit the same cached
+    executables. ``opts`` forwards to :func:`search` (``filter``,
+    ``query_chunk``, ...)."""
+
+    def _fn(queries, k, res=None):
+        return search(index, queries, k, params, res=res, **opts)
+
+    return _fn

@@ -38,7 +38,7 @@ from ..utils import cdiv, hdot, in_jax_trace, run_query_chunks
 
 __all__ = ["IndexParams", "SearchParams", "Index", "build",
            "build_from_batches", "extend", "search", "prepare_scan",
-           "reconstruct", "save", "load"]
+           "reconstruct", "save", "load", "make_searcher"]
 
 # v2: store_dtype meta + uint16-framed bf16 rows + int8 scales; v1 files
 # (dense f32) remain readable
@@ -593,3 +593,17 @@ def load(path) -> Index:
         centers, jnp.sum(centers * centers, axis=1), offsets,
         DistanceType(meta["metric"]),
         list_sizes_arr=np.diff(offsets), scales=scales)
+
+
+def make_searcher(index: Index, params: SearchParams | None = None, **opts):
+    """Stable batchable signature for the serving runtime
+    (:mod:`raft_tpu.serve`): returns ``fn(queries, k, res=None) ->
+    (distances, indices)`` with the probe policy and engine choice frozen
+    at closure build time, so repeated bucketed-shape calls hit the same
+    cached executables. ``opts`` forwards to :func:`search` (``algo``,
+    ``filter``, ``precision``, ``query_chunk``, ...)."""
+
+    def _fn(queries, k, res=None):
+        return search(index, queries, k, params, res=res, **opts)
+
+    return _fn

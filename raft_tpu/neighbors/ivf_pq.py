@@ -827,3 +827,17 @@ def load(path) -> Index:
         DistanceType(meta["metric"]), meta["pq_bits"],
         CodebookGen(meta["codebook_kind"]),
         list_sizes_arr=np.diff(offsets))
+
+
+def make_searcher(index: Index, params: SearchParams | None = None, **opts):
+    """Stable batchable signature for the serving runtime
+    (:mod:`raft_tpu.serve`): returns ``fn(queries, k, res=None) ->
+    (distances, indices)`` with the probe/LUT policy frozen at closure
+    build time, so repeated bucketed-shape calls hit the same cached
+    executables. ``opts`` forwards to :func:`search` (``algo``,
+    ``filter``, ``precision``, ``query_chunk``, ...)."""
+
+    def _fn(queries, k, res=None):
+        return search(index, queries, k, params, res=res, **opts)
+
+    return _fn

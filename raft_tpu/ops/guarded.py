@@ -62,6 +62,14 @@ def _demote(site: str, err: Exception, persist: bool) -> None:
             "guarded %s: kernel path failed (%s: %s); demoted to the XLA "
             "fallback for the rest of this process", site,
             type(err).__name__, err)
+        try:
+            # serving telemetry: demotions are operational events the
+            # metrics snapshot must surface (docs/serving.md)
+            from ..serve import metrics as serve_metrics
+
+            serve_metrics.counter("guarded.demotions").inc()
+        except Exception:  # noqa: BLE001 - telemetry must not break containment
+            pass
     autotune.record(
         _guard_key(site), "fallback",
         persist=persist and os.environ.get("RAFT_TPU_GUARD_PERSIST") == "1")

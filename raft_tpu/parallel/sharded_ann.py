@@ -32,7 +32,8 @@ from ..utils import cdiv, shard_map_compat
 
 __all__ = ["ShardedIvfFlat", "build_ivf_flat", "search_ivf_flat",
            "ShardedCagra", "build_cagra", "search_cagra",
-           "ShardedIvfPq", "build_ivf_pq", "search_ivf_pq"]
+           "ShardedIvfPq", "build_ivf_pq", "search_ivf_pq",
+           "make_searcher"]
 
 AXIS = "shard"
 
@@ -497,3 +498,25 @@ def search_ivf_pq(index: ShardedIvfPq, queries, k: int,
                  index.codebooks, index.rotations, index.offsets,
                  index.sizes, _shard_mask(index.mesh, ok), q)
     return (d, i, ok) if allow_partial else (d, i)
+
+
+def make_searcher(index, params=None, *, allow_partial: bool = False,
+                  **opts):
+    """Stable batchable signature for the serving runtime
+    (:mod:`raft_tpu.serve`), dispatching on the sharded index type:
+    returns ``fn(queries, k, res=None) -> (distances, indices)`` — or,
+    with ``allow_partial=True``, ``(distances, indices, shards_ok)`` so
+    the batcher can serve degraded answers through dead shards and
+    surface the loss in its metrics and per-request responses."""
+    fns = {ShardedIvfFlat: search_ivf_flat,
+           ShardedCagra: search_cagra,
+           ShardedIvfPq: search_ivf_pq}
+    fn = fns.get(type(index))
+    expects(fn is not None, "unsupported sharded index type %s",
+            type(index).__name__)
+
+    def _fn(queries, k, res=None):
+        return fn(index, queries, k, params, res=res,
+                  allow_partial=allow_partial, **opts)
+
+    return _fn

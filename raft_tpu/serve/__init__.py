@@ -1,0 +1,56 @@
+"""Query-serving runtime: shape-bucketed micro-batching, admission
+control, warmup, and a metrics registry (docs/serving.md).
+
+RAFT is consumed through a handle/stream-pool runtime that multiplexes
+concurrent callers onto the device (SURVEY §1 layer 1); the
+TPU-idiomatic equivalent is a micro-batching scheduler: requests
+coalesce under a max-wait/max-batch policy, pad to a fixed ladder of
+pre-compiled shape buckets (steady-state traffic never triggers an XLA
+recompile), dispatch through the existing ``search()`` paths, and
+demultiplex back to callers — with bounded-queue backpressure, deadline
+shedding/partial results, degraded sharded serving, and process-local
+operational metrics.
+
+- ``metrics``   counters/gauges/histograms, snapshot + text export,
+                tracing-span timing (dependency-free)
+- ``admission`` bounded request queue, backpressure, deadline shedding
+- ``batcher``   BucketLadder + MicroBatcher (coalesce/pad/dispatch/demux)
+- ``warmup``    ladder pre-compile + XLA compilation-count instrumentation
+
+Submodules import lazily, so telemetry-only consumers (ops/guarded
+demotion events, core/tracing span timing) pull in none of the
+scheduler's jax-facing dependencies.
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+_SUBMODULES = ("admission", "batcher", "metrics", "warmup")
+_EXPORTS = {
+    "MicroBatcher": "batcher",
+    "BucketLadder": "batcher",
+    "AdmissionQueue": "admission",
+    "Request": "admission",
+    "SearchResult": "admission",
+    "QueueFullError": "admission",
+    "count_compilations": "warmup",
+}
+
+__all__ = list(_SUBMODULES) + list(_EXPORTS)
+
+
+def __getattr__(name: str) -> Any:
+    if name in _SUBMODULES:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name in _EXPORTS:
+        val = getattr(__getattr__(_EXPORTS[name]), name)
+        globals()[name] = val
+        return val
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

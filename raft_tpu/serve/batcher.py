@@ -1,0 +1,333 @@
+"""Shape-bucketed micro-batching scheduler: the query-serving runtime's
+core loop.
+
+Every search entry point in this library is a bare function call — one
+caller, one pre-shaped query batch. A serving stack has neither: many
+concurrent callers, each with 1..few-hundred queries and its own k and
+latency budget. The standard inference-server answer, TPU-idiomatic
+form:
+
+* **Coalesce**: requests drain from an :class:`~.admission.AdmissionQueue`
+  under a max-wait / max-batch policy and are concatenated row-wise.
+* **Bucket, don't recompile**: the concatenated block is padded up to a
+  fixed :class:`BucketLadder` of (query-rows × k) shapes. XLA
+  executables are cached by input shape, so after
+  :meth:`MicroBatcher.warmup` has dispatched every ladder shape once,
+  steady-state traffic of ANY mix of request sizes hits only cached
+  executables — zero recompiles (asserted by the load test with
+  :func:`~.warmup.count_compilations`). Padding rows are zeros and k is
+  rounded up a bucket; both are sliced away at demux (top-k lists are
+  sorted, so the first k of a k-bucket answer IS the exact k answer, and
+  per-row results are independent of other rows in the batch).
+* **Dispatch through the existing paths**: the batcher is generic over a
+  ``search_fn(queries, k, res=None)`` closure — build one with the
+  ``make_searcher`` helpers on brute_force / ivf_flat / ivf_pq / cagra
+  or :func:`raft_tpu.parallel.sharded_ann.make_searcher` (whose
+  ``allow_partial=True`` degraded merges surface ``shards_ok`` per
+  response and in the metrics).
+* **Deadlines end-to-end**: a request's
+  :class:`~raft_tpu.core.deadline.Deadline` is enforced at admission pop
+  and again pre-dispatch (shed, ``<name>.shed``); the tightest live
+  deadline rides into the search as ``res``, so a mid-batch expiry
+  raises between chunk dispatches and completed rows are still
+  delivered — fully-covered requests succeed, the rest fail with their
+  own partial slice attached (``<name>.deadline_exceeded``).
+
+The worker is one daemon thread: TPU dispatch is asynchronous, so a
+single submitting thread keeps the device pipelined while callers block
+on per-request futures.
+
+A popped batch splits per k bucket before dispatch (one k per
+executable), so heavily mixed-k traffic trades fill ratio for
+k-padding — watch ``<name>.batch_fill`` and give hot k values their own
+bucket rather than widening an existing one.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import faults, logging as rlog
+from ..core.deadline import Deadline, DeadlineExceeded
+from ..core.errors import expects
+from .admission import AdmissionQueue, Request, SearchResult
+
+__all__ = ["BucketLadder", "MicroBatcher"]
+
+
+class BucketLadder:
+    """The fixed set of dispatch shapes: ascending query-row buckets ×
+    ascending k buckets. ``bucket_queries``/``bucket_k`` round a request
+    up to the smallest covering bucket; anything beyond the largest
+    bucket is a submit-time error (split such callers upstream)."""
+
+    def __init__(self,
+                 query_buckets: Sequence[int] = (8, 32, 128, 512),
+                 k_buckets: Sequence[int] = (16, 64, 128)):
+        self.query_buckets = tuple(int(b) for b in query_buckets)
+        self.k_buckets = tuple(int(b) for b in k_buckets)
+        for name, bs in (("query_buckets", self.query_buckets),
+                         ("k_buckets", self.k_buckets)):
+            expects(len(bs) > 0, "%s must be non-empty", name)
+            expects(all(b > 0 for b in bs), "%s must be positive", name)
+            expects(tuple(sorted(set(bs))) == bs,
+                    "%s must be ascending and unique, got %s", name, bs)
+
+    @property
+    def max_queries(self) -> int:
+        return self.query_buckets[-1]
+
+    @property
+    def max_k(self) -> int:
+        return self.k_buckets[-1]
+
+    def bucket_queries(self, m: int) -> int:
+        expects(1 <= m <= self.max_queries,
+                "request of %d query rows outside ladder (max bucket %d)",
+                m, self.max_queries)
+        return next(b for b in self.query_buckets if b >= m)
+
+    def bucket_k(self, k: int) -> int:
+        expects(1 <= k <= self.max_k,
+                "k=%d outside ladder (max k bucket %d)", k, self.max_k)
+        return next(b for b in self.k_buckets if b >= k)
+
+    def shapes(self) -> List[Tuple[int, int]]:
+        """Every (query_bucket, k_bucket) pair — the warmup set."""
+        return [(mb, kb) for mb in self.query_buckets
+                for kb in self.k_buckets]
+
+
+class MicroBatcher:
+    """Micro-batching front end over one built index's search closure.
+
+    ``search_fn(queries, k, res=None) -> (distances, indices)`` (or a
+    3-tuple ending in ``shards_ok`` for degraded sharded searchers) must
+    accept any ladder shape; ``dim`` is the query width used for padding
+    and warmup. ``autostart=False`` lets tests enqueue a deterministic
+    backlog before the worker drains it.
+    """
+
+    def __init__(self, search_fn: Callable, dim: int, *,
+                 ladder: Optional[BucketLadder] = None,
+                 max_wait_s: float = 0.002,
+                 max_batch_requests: int = 64,
+                 queue_depth: int = 256,
+                 registry=None,
+                 name: str = "serve",
+                 autostart: bool = True,
+                 clock: Callable[[], float] = time.monotonic):
+        from . import metrics as _metrics
+
+        self._search = search_fn
+        self._dim = int(dim)
+        self.ladder = ladder or BucketLadder()
+        self._max_wait_s = float(max_wait_s)
+        self._max_batch = int(max_batch_requests)
+        self._name = name
+        self._clock = clock
+        self._reg = registry or _metrics.default_registry
+        self.queue = AdmissionQueue(queue_depth, registry=self._reg,
+                                    prefix=name, clock=clock)
+        r = self._reg
+        self._requests = r.counter(f"{name}.requests")
+        self._served = r.counter(f"{name}.served")
+        self._batches = r.counter(f"{name}.batches")
+        self._errors = r.counter(f"{name}.errors")
+        self._dlx = r.counter(f"{name}.deadline_exceeded")
+        self._degraded = r.counter(f"{name}.degraded_batches")
+        self._healthy = r.gauge(f"{name}.healthy_shards")
+        self._latency = r.histogram(f"{name}.latency_s")
+        self._batch_latency = r.histogram(f"{name}.batch_latency_s")
+        self._fill = r.histogram(f"{name}.batch_fill",
+                                 _metrics.RATIO_BUCKETS)
+        self._padding = r.histogram(f"{name}.padding_waste",
+                                    _metrics.RATIO_BUCKETS)
+        self._thread: Optional[threading.Thread] = None
+        if autostart:
+            self.start()
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name=f"{self._name}-batcher", daemon=True)
+        self._thread.start()
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop admitting, drain what is queued, stop the worker."""
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def __enter__(self) -> "MicroBatcher":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- client API -------------------------------------------------------
+    def submit(self, queries, k: int,
+               deadline: Optional[Deadline] = None) -> Request:
+        """Enqueue a request; returns its future. Raises
+        :class:`~.admission.QueueFullError` under backpressure and
+        ValueError-family errors for off-ladder shapes."""
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        expects(q.ndim == 2 and q.shape[1] == self._dim,
+                "queries must be (m, %d), got %s", self._dim, q.shape)
+        self.ladder.bucket_queries(q.shape[0])   # validate against ladder
+        self.ladder.bucket_k(k)
+        req = Request(q, k, deadline, enqueued_at=self._clock())
+        self.queue.submit(req)
+        self._requests.inc()
+        return req
+
+    def search(self, queries, k: int, deadline: Optional[Deadline] = None,
+               timeout: Optional[float] = None) -> SearchResult:
+        """Synchronous convenience: submit + block for the result."""
+        return self.submit(queries, k, deadline).result(timeout)
+
+    def warmup(self) -> int:
+        """Pre-compile every ladder shape through the live search path;
+        returns the number of XLA compilations that took (0 on a warm
+        process). See :func:`raft_tpu.serve.warmup.warmup`."""
+        from . import warmup as _warmup
+
+        return _warmup.warmup(self._search, self.ladder, self._dim,
+                              registry=self._reg, name=self._name)
+
+    # -- worker -----------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            batch = self.queue.pop_batch(self._max_batch, self._max_wait_s,
+                                         max_rows=self.ladder.max_queries)
+            if not batch:
+                if self.queue.closed:
+                    return
+                continue
+            # operator knob: simulate a stalled worker/device
+            # (RAFT_TPU_FAULTS='slow_dispatch@<name>.batch=0.1')
+            faults.sleep_if(f"{self._name}.batch")
+            groups: dict = {}
+            for r in batch:
+                groups.setdefault(self.ladder.bucket_k(r.k), []).append(r)
+            for kb in sorted(groups):
+                reqs = groups[kb]
+                try:
+                    self._dispatch_group(kb, reqs)
+                except Exception as e:  # noqa: BLE001 - worker must survive
+                    self._errors.inc()
+                    rlog.log_warn(
+                        "serve %s: batch dispatch failed (%s: %s)",
+                        self._name, type(e).__name__, e)
+                    for r in reqs:
+                        if not r.done():
+                            r.set_exception(e)
+
+    def _tightest_deadline(self, reqs: List[Request]) -> Optional[Deadline]:
+        carried = [r.deadline for r in reqs if r.deadline is not None]
+        if not carried:
+            return None
+        return min(carried, key=lambda d: d.remaining())
+
+    def _dispatch_group(self, kb: int, reqs: List[Request]) -> None:
+        # late shed: a deadline can expire between admission pop and here
+        # (e.g. an earlier group's dispatch, or an armed slow worker)
+        live = []
+        for r in reqs:
+            if r.deadline is not None and r.deadline.expired():
+                self.queue.shed(r)
+            else:
+                live.append(r)
+        if not live:
+            return
+        rows = sum(r.rows for r in live)
+        mb = self.ladder.bucket_queries(rows)
+        block = np.zeros((mb, self._dim), np.float32)
+        offs: List[int] = []
+        off = 0
+        for r in live:
+            block[off:off + r.rows] = r.queries
+            offs.append(off)
+            off += r.rows
+        t0 = self._clock()
+        try:
+            out = self._search(block, kb,
+                               res=self._tightest_deadline(live))
+        except DeadlineExceeded as e:
+            self._deliver_partial(kb, live, offs, e)
+            return
+        dt = self._clock() - t0
+        shards_ok = None
+        if isinstance(out, tuple) and len(out) == 3:
+            d, i, shards_ok = out
+        else:
+            d, i = out
+        d = np.asarray(d)
+        i = np.asarray(i)
+        if shards_ok is not None:
+            ok = np.asarray(shards_ok, bool)
+            self._healthy.set(int(ok.sum()))
+            if not ok.all():
+                self._degraded.inc()
+        now = self._clock()
+        for r, o in zip(live, offs):
+            r.set_result(SearchResult(d[o:o + r.rows, :r.k],
+                                      i[o:o + r.rows, :r.k], shards_ok))
+            self._latency.observe(now - r.enqueued_at)
+        self._served.inc(len(live))
+        self._batches.inc()
+        self._reg.counter(f"{self._name}.dispatch.{mb}x{kb}").inc()
+        self._batch_latency.observe(dt)
+        self._fill.observe(rows / mb)
+        self._padding.observe((mb - rows) / mb)
+
+    def _deliver_partial(self, kb: int, live: List[Request],
+                         offs: List[int], e: DeadlineExceeded) -> None:
+        """Mid-batch deadline expiry: the search delivered rows
+        [0, done). Requests fully inside succeed; requests whose OWN
+        deadline is spent fail with their slice of the partial attached
+        (may be None); the rest were collateral of a co-batched tighter
+        deadline and are re-dispatched — a request without a budget must
+        never fail on someone else's. Terminates: every recursion drops
+        the expired-deadline owners, so the retried group carries a
+        strictly looser tightest deadline."""
+        if e.partial is not None:
+            pd, pi = np.asarray(e.partial[0]), np.asarray(e.partial[1])
+            done = pd.shape[0]
+        else:
+            pd = pi = None
+            done = 0
+        now = self._clock()
+        retry: List[Request] = []
+        for r, o in zip(live, offs):
+            if o + r.rows <= done:
+                r.set_result(SearchResult(pd[o:o + r.rows, :r.k],
+                                          pi[o:o + r.rows, :r.k], None))
+                self._latency.observe(now - r.enqueued_at)
+                self._served.inc()
+                continue
+            if r.deadline is None or not r.deadline.expired():
+                retry.append(r)
+                continue
+            own = None
+            if done > o:
+                own = (pd[o:done, :r.k], pi[o:done, :r.k])
+            covered = max(0, done - o)
+            self._dlx.inc()
+            r.set_exception(DeadlineExceeded(
+                f"raft_tpu serve: deadline exceeded mid-batch; "
+                f"{covered} of {r.rows} query rows completed "
+                f"({'attached' if own is not None else 'empty'})",
+                partial=own))
+        if retry:
+            self._reg.counter(f"{self._name}.redispatched").inc(len(retry))
+            self._dispatch_group(kb, retry)

@@ -1,0 +1,85 @@
+"""Startup warmup for the bucket ladder, plus compilation-count
+instrumentation.
+
+The recompile-avoidance guarantee of :mod:`raft_tpu.serve.batcher` is
+only worth anything if every ladder shape is compiled BEFORE traffic
+arrives — an un-warmed bucket turns the first unlucky request into a
+multi-second XLA compile stall. :func:`warmup` dispatches a dummy batch
+through the live search closure at every (query-bucket × k-bucket)
+shape and blocks on the results, so steady-state serving hits only
+cached executables.
+
+:func:`count_compilations` is the matching measurement: it wraps
+``jax._src.compiler.backend_compile`` — the single funnel both the jit
+cache-miss path and ``compile_or_get_cached`` route through on jax
+0.4.x — and counts invocations, letting the load test assert the
+headline property literally: after warmup, a stream of mixed-size
+requests causes **zero** new XLA compilations.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import numpy as np
+
+__all__ = ["CompileCounter", "count_compilations", "warmup"]
+
+
+class CompileCounter:
+    """Mutable count of XLA backend compiles inside a
+    :func:`count_compilations` block."""
+
+    def __init__(self):
+        self.count = 0
+
+
+@contextlib.contextmanager
+def count_compilations():
+    """Count XLA compilations during the block (yields a
+    :class:`CompileCounter`). Raises if this jax version moved the
+    compile funnel — a vacuous zero would silently gut the load test's
+    recompile assertion."""
+    from jax._src import compiler as _compiler  # versioned private API
+
+    orig = getattr(_compiler, "backend_compile", None)
+    if orig is None:
+        raise RuntimeError(
+            "jax._src.compiler.backend_compile not found on jax "
+            f"{jax.__version__}; update count_compilations() to this "
+            "version's compile funnel")
+    counter = CompileCounter()
+
+    def _spy(*args, **kwargs):
+        counter.count += 1
+        return orig(*args, **kwargs)
+
+    _compiler.backend_compile = _spy
+    try:
+        yield counter
+    finally:
+        _compiler.backend_compile = orig
+
+
+def warmup(search_fn, ladder, dim: int, dtype=np.float32, registry=None,
+           name: str = "serve") -> int:
+    """Dispatch a dummy batch through ``search_fn`` at every ladder shape
+    and block on each result. Returns the number of XLA compilations the
+    sweep triggered (0 when the process is already warm). Records
+    ``<name>.warmup.shapes`` (gauge) and ``<name>.warmup.compiles``
+    (counter)."""
+    from . import metrics as _metrics
+
+    reg = registry or _metrics.default_registry
+    shapes = 0
+    with count_compilations() as cc:
+        for mb in ladder.query_buckets:
+            q = np.zeros((mb, int(dim)), dtype)
+            for kb in ladder.k_buckets:
+                out = search_fn(q, kb)
+                # block: compiles are lazy until the dispatch executes
+                jax.block_until_ready((out[0], out[1]))
+                shapes += 1
+    reg.gauge(f"{name}.warmup.shapes").set(shapes)
+    reg.counter(f"{name}.warmup.compiles").inc(cc.count)
+    return cc.count

@@ -28,10 +28,26 @@ def queries():
     return rng.standard_normal((50, 32)).astype(np.float32)
 
 
+# builds dominate this module's wall on the 1-core CI box (the 870s
+# tier-1 timeout is tight): tests that search the same configuration
+# share one module-scoped build — searches never mutate the index
+@pytest.fixture(scope="module")
+def flat_index16(mesh, dataset):
+    return sharded_ann.build_ivf_flat(
+        dataset, mesh, ivf_flat.IndexParams(n_lists=16, seed=0))
+
+
+@pytest.fixture(scope="module")
+def pq_index16(mesh, dataset):
+    from raft_tpu.neighbors import ivf_pq
+
+    return sharded_ann.build_ivf_pq(
+        dataset, mesh, ivf_pq.IndexParams(n_lists=16, pq_dim=8, seed=0))
+
+
 class TestShardedIvfFlat:
-    def test_recall_and_merge(self, mesh, dataset, queries):
-        index = sharded_ann.build_ivf_flat(
-            dataset, mesh, ivf_flat.IndexParams(n_lists=16, seed=0))
+    def test_recall_and_merge(self, mesh, dataset, queries, flat_index16):
+        index = flat_index16
         assert index.n_shards == 4
         # full probes per shard → exact: merged result must match global knn
         d, i = sharded_ann.search_ivf_flat(
@@ -57,9 +73,8 @@ class TestShardedIvfFlat:
         floor = {"bfloat16": 0.95, "int8": 0.9, "uint8": 0.9999}[dtype]
         assert r > floor, r
 
-    def test_partial_probes(self, mesh, dataset, queries):
-        index = sharded_ann.build_ivf_flat(
-            dataset, mesh, ivf_flat.IndexParams(n_lists=16, seed=0))
+    def test_partial_probes(self, mesh, dataset, queries, flat_index16):
+        index = flat_index16
         _, i = sharded_ann.search_ivf_flat(
             index, queries, k=10, params=ivf_flat.SearchParams(n_probes=8))
         _, want_i = naive_knn(dataset, queries, 10)
@@ -105,11 +120,11 @@ class TestShardedCagra:
 
 
 class TestShardedIvfPq:
-    def test_recall_vs_single_shard(self, mesh, dataset, queries):
+    def test_recall_vs_single_shard(self, mesh, dataset, queries,
+                                    pq_index16):
         from raft_tpu.neighbors import ivf_pq
 
-        index = sharded_ann.build_ivf_pq(
-            dataset, mesh, ivf_pq.IndexParams(n_lists=16, pq_dim=8, seed=0))
+        index = pq_index16
         assert index.n_shards == 4
         d, i = sharded_ann.search_ivf_pq(
             index, queries, k=10, params=ivf_pq.SearchParams(n_probes=16))
@@ -135,7 +150,7 @@ class TestShardedIvfPq:
         assert got.max() < len(data)
         assert (got >= 0).all()
 
-    def test_comms_injection(self, mesh, dataset, queries):
+    def test_comms_injection(self, mesh, dataset, queries, pq_index16):
         """search via a Resources-injected communicator (comms_t pattern)."""
         from raft_tpu.comms import AxisComms
         from raft_tpu.core.resources import Resources
@@ -143,8 +158,7 @@ class TestShardedIvfPq:
 
         res = Resources(mesh=mesh)
         res.set_comms(AxisComms("shard", size=4))
-        index = sharded_ann.build_ivf_pq(
-            dataset, mesh, ivf_pq.IndexParams(n_lists=16, pq_dim=8, seed=0))
+        index = pq_index16
         d1, i1 = sharded_ann.search_ivf_pq(
             index, queries, k=5, params=ivf_pq.SearchParams(n_probes=16),
             res=res)
