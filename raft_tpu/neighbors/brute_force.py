@@ -32,7 +32,7 @@ from ..matrix.select_k import select_k
 from ..utils import hdot, in_jax_trace, round_up_to, run_query_chunks
 
 __all__ = ["Index", "build", "search", "knn", "knn_merge_parts", "save",
-           "load", "tune_search", "make_searcher"]
+           "load", "tune_search", "make_searcher", "prepare_fused"]
 
 # v2: store_dtype meta + uint16-framed bf16 datasets + int8 scales; v1
 # files (plain f32) remain readable
@@ -71,12 +71,23 @@ class Index:
         return self.dataset.dtype
 
     def tree_flatten(self):
-        return ((self.dataset, self.norms, self.scales),
-                (self.metric, self.metric_arg))
+        # the fused engine's tile-aligned corpus cache (prepare_fused)
+        # travels WITH the index so jitted engines can take the index as
+        # an ARGUMENT and still skip the per-call pad copy (closure-baking
+        # the dataset exceeds remote-compile request limits at memory
+        # scale; cagra's _score_* caches set the precedent)
+        fp = getattr(self, "_fused_pad", None)
+        pad_leaves = tuple(fp[1:]) if fp is not None else (None,) * 4
+        return ((self.dataset, self.norms, self.scales) + pad_leaves,
+                (self.metric, self.metric_arg,
+                 fp[0] if fp is not None else None))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], aux[0], aux[1], children[2])
+        out = cls(children[0], children[1], aux[0], aux[1], children[2])
+        if len(aux) > 2 and aux[2] is not None:
+            out._fused_pad = (aux[2],) + tuple(children[3:])
+        return out
 
 
 def quantize_rows(dataset: jax.Array, dtype) -> Tuple[jax.Array, Optional[jax.Array]]:
@@ -247,6 +258,24 @@ def _blockmin_topk(s: jax.Array, k: int, blk: int = 32):
     return v, idx
 
 
+def _chunked_queries(one, q, chunk: int, k: int):
+    """Run the per-chunk engine ``one`` over fixed-size query chunks via
+    ``lax.map`` (a single chunk dispatches directly, no map wrapper),
+    padding the tail chunk and slicing the pad rows back off. Shared by
+    the matmul and fused engines so their chunking semantics cannot
+    drift."""
+    m = q.shape[0]
+    m_pad = round_up_to(m, chunk)
+    qp = jnp.pad(q, ((0, m_pad - m), (0, 0)))
+    if m_pad == chunk:
+        vals, idxs = one(qp)
+    else:
+        vals, idxs = jax.lax.map(one, qp.reshape(m_pad // chunk, chunk, -1))
+        vals = vals.reshape(m_pad, k)
+        idxs = idxs.reshape(m_pad, k)
+    return vals[:m], idxs[:m]
+
+
 def _search_matmul(index: Index, q, k, filter, valid_rows, precision,
                    workspace_mb: Optional[int] = None):
     """One-shot GEMM + top_k engine, query-chunked to a workspace budget.
@@ -269,8 +298,6 @@ def _search_matmul(index: Index, q, k, filter, valid_rows, precision,
     budget = (workspace_mb if workspace_mb is not None else int(
         os.environ.get("RAFT_TPU_MATMUL_WORKSPACE_MB", "1024"))) << 20
     chunk = int(max(8, min(m, budget // max(n * 4, 1))))
-    m_pad = round_up_to(m, chunk)
-    qp = jnp.pad(q, ((0, m_pad - m), (0, 0)))
     dn = index.norms
     dns = None if dn is None else (
         jnp.sqrt(jnp.maximum(dn, 1e-30)) if mt is DistanceType.CosineExpanded
@@ -310,19 +337,79 @@ def _search_matmul(index: Index, q, k, filter, valid_rows, precision,
         negv, idx = jax.lax.top_k(-s, k)
         return -negv, idx
 
-    if m_pad == chunk:
-        vals, idxs = one(qp)
-    else:
-        vals, idxs = jax.lax.map(one, qp.reshape(m_pad // chunk, chunk, -1))
-        vals = vals.reshape(m_pad, k)
-        idxs = idxs.reshape(m_pad, k)
-    vals, idxs = vals[:m], idxs[:m]
+    vals, idxs = _chunked_queries(one, q, chunk, k)
     idxs = jnp.where(jnp.isfinite(vals), idxs, -1)
     if mt is DistanceType.L2SqrtExpanded:
         vals = jnp.sqrt(jnp.maximum(vals, 0.0))
     elif mt is DistanceType.InnerProduct:
         vals = jnp.where(jnp.isfinite(vals), -vals, -jnp.inf)
     return vals, idxs
+
+
+def _tune_key(index: Index, m: int, k: int) -> str:
+    """Autotune bucket for the engine race. The store dtype is part of
+    the key: the crossovers move with HBM traffic (a bf16 corpus streams
+    at half the bytes, int8 at a quarter), so a winner measured for one
+    storage mode must not steer another's dispatch."""
+    from ..ops import autotune
+
+    return autotune.shape_bucket("bf_search", n=index.size, m=m,
+                                 d=index.dim, k=k,
+                                 store=str(index.store_dtype))
+
+
+def _fused_align_key(index: Index):
+    """(tn, dim_p) the fused engine derives for this index — the ONE
+    place the alignment contract between ``prepare_fused`` and
+    ``fused_knn``'s internal padding is computed, so the two sites
+    cannot silently desynchronize (tn depends only on dim/itemsize, not
+    k: ``_pick_tiles`` varies tm with k, never tn)."""
+    from ..ops.fused_knn import _pick_tiles
+
+    dtype = index.store_dtype
+    itemsize = (jnp.dtype(dtype).itemsize
+                if dtype in (jnp.bfloat16, jnp.int8, jnp.uint8) else 4)
+    dim_p = round_up_to(index.dim, 128)
+    return _pick_tiles(dim_p, 1, itemsize)[1], dim_p
+
+
+def prepare_fused(index: Index) -> None:
+    """Eagerly build the fused engine's tile-aligned corpus copy and
+    attach it to the index (rows padded to the dataset-tile multiple,
+    dim to the 128 lane width, plus a base +inf penalty on pad rows).
+    The fused kernel then reads the corpus RESIDENT in HBM across calls
+    instead of re-padding (a full corpus copy) per dispatch. No-op when
+    the cache already matches the current tile geometry; realigns after
+    a ``RAFT_TPU_FUSED_TILES`` change. Called automatically on eager
+    fused dispatch and by ``tune_search``; jit users should call it once
+    before tracing — caches are never written under a trace (storing
+    tracers corrupts them), so an unprepared index pays the pad inside
+    every jitted call."""
+    if in_jax_trace():
+        # enforce, not just document: a tracer stored in the cache would
+        # poison every later eager dispatch (UnexpectedTracerError →
+        # guard demotion) and the key-match early return would keep it
+        return
+    d = index.dataset
+    if d.dtype not in (jnp.bfloat16, jnp.int8, jnp.uint8):
+        d = d.astype(jnp.float32)
+    n, dim = d.shape
+    key = _fused_align_key(index)
+    tn, dim_p = key
+    n_pad = round_up_to(n, min(tn, round_up_to(n, 128)))
+    cache = getattr(index, "_fused_pad", None)
+    if cache is not None and cache[0] == key:
+        return
+    d_pad = jnp.pad(d, ((0, n_pad - n), (0, dim_p - dim)))
+    base_pen = jnp.pad(jnp.zeros((n,), jnp.float32), (0, n_pad - n),
+                       constant_values=jnp.inf)
+    norms_pad = (None if index.norms is None
+                 else jnp.pad(jnp.asarray(index.norms, jnp.float32),
+                              (0, n_pad - n)))
+    scales_pad = (None if index.scales is None
+                  else jnp.pad(jnp.asarray(index.scales, jnp.float32),
+                               (0, n_pad - n)))
+    index._fused_pad = (key, d_pad, norms_pad, base_pen, scales_pad)
 
 
 def tune_search(index: Index, queries, k: int, reps: int = 5,
@@ -336,8 +423,7 @@ def tune_search(index: Index, queries, k: int, reps: int = 5,
     from ..ops import autotune
 
     q = jnp.asarray(queries, jnp.float32)
-    key = autotune.shape_bucket("bf_search", n=index.size, m=q.shape[0],
-                                d=index.dim, k=k)
+    key = _tune_key(index, q.shape[0], k)
     # the index rides as a jit ARGUMENT: closure-baking it would trace
     # the dataset into the HLO as a constant, which exceeds the tunnel's
     # remote-compile request limit at memory scale (observed HTTP 413 at
@@ -359,29 +445,72 @@ def tune_search(index: Index, queries, k: int, reps: int = 5,
             jax.jit(lambda qq, idx: search(idx, qq, k, algo=algo)))
 
     cands = {"matmul": _engine("matmul"), "scan": _engine("scan")}
-    if (index.metric in _PALLAS_METRICS and jax.default_backend() == "tpu"
-            and index.size <= (128 << 10)):
-        # above 128k rows the fused kernel's O(k·m·n) per-tile extraction
-        # loses by >20x (r4 measurement) — keep it out of the race rather
-        # than spend a tuning rep compiling a known loser
+    if index.metric in _PALLAS_METRICS and jax.default_backend() == "tpu":
+        # the fused engine races at EVERY corpus size: the old 128k cap
+        # guarded its O(k·m·n) per-tile extraction (a >20x loss at 500k,
+        # r4), but the two-level block-min select reduced the steady-state
+        # per-tile cost to one GEMM + one O(tm·tn) reduce, so the corpus
+        # scan is bandwidth-bound (~n·d·itemsize bytes per batch) and the
+        # race — not a constant — decides the crossover per shape bucket.
+        # Only non-TPU backends sit out (the kernel exists there solely
+        # as the interpret-mode test twin).
+        prepare_fused(index)
         cands["pallas"] = _engine("pallas")
     # value_read: engine choice must not be steered by a backend that
     # lies about readiness (observed: block_until_ready returning in
     # ~1 ms for TFLOP-scale batches) — each rep closes with a host read
-    return autotune.tune_best(key, cands, q, reps=reps, force=True,
-                              suspect_floor_s=suspect_floor_s,
-                              value_read=True)
+    winner, timings = autotune.tune_best(key, cands, q, reps=reps,
+                                         force=True,
+                                         suspect_floor_s=suspect_floor_s,
+                                         value_read=True)
+    if winner != "pallas":
+        # the tile-aligned corpus copy is ~a corpus of extra HBM; keep it
+        # only for the engine that won the race
+        index.__dict__.pop("_fused_pad", None)
+    return winner, timings
 
 
 def _search_pallas(index: Index, q, k, filter, valid_rows, precision):
     """Fused Pallas distance+top-k path (the perf path on TPU)."""
+    import os
+
     from ..ops import fused_knn
 
     mt = index.metric
     pen = _penalty_row(index, filter, valid_rows)
-    vals, idxs = fused_knn(q, index.dataset, k, metric=_PALLAS_METRICS[mt],
-                           data_norms=index.norms, penalty=pen,
-                           precision=precision)
+    ds, dn, sc = index.dataset, index.norms, index.scales
+    if not in_jax_trace():
+        # no-op on a matching key; builds or REALIGNS the cache after a
+        # RAFT_TPU_FUSED_TILES change (fused dispatch was already chosen
+        # here, so the corpus copy is earning its HBM)
+        prepare_fused(index)
+    cache = getattr(index, "_fused_pad", None)
+    if cache is not None and cache[0] != _fused_align_key(index):
+        cache = None   # stale geometry under a trace: inline pad instead
+    if cache is not None:
+        # tile-aligned corpus resident in HBM: no per-call pad copy
+        _, ds, dn, base_pen, sc = cache
+        pen = base_pen if pen is None else base_pen + jnp.pad(
+            pen, (0, ds.shape[0] - index.size))
+
+    # chunk queries to the fused engine's own budget: the kernel's VMEM
+    # working set is per-tile (independent of m), so the chunk exists to
+    # bound the (m, kp) output/accumulator footprint and the grid of a
+    # single dispatch (graph builds push m to corpus scale). Each chunk
+    # re-streams the corpus, so the default stays large — a 10k serving
+    # batch is one dispatch.
+    chunk = int(os.environ.get("RAFT_TPU_FUSED_QUERY_CHUNK", "16384"))
+    m = q.shape[0]
+
+    def one(qc):
+        return fused_knn(qc, ds, k, metric=_PALLAS_METRICS[mt],
+                         data_norms=dn, penalty=pen,
+                         precision=precision, scales=sc)
+
+    if m > chunk > 0:
+        vals, idxs = _chunked_queries(one, q, chunk, k)
+    else:
+        vals, idxs = one(q)
     if mt is DistanceType.L2SqrtExpanded:
         vals = jnp.sqrt(jnp.maximum(vals, 0.0))
     elif mt is DistanceType.InnerProduct:
@@ -413,7 +542,9 @@ def search(
     excluded. Used by the sharded path where the per-shard row count is only
     known inside shard_map (padding shards).
     ``algo``: "pallas" (fused distance+top-k kernel: the VMEM-resident
-    running-k path, role of detail/knn_brute_force.cuh:61 + select_warpsort),
+    running-k path with the two-level block-min select, role of
+    detail/knn_brute_force.cuh:61 + select_warpsort; streams every
+    storage dtype — f32/bf16/int8/uint8 — in its stored width),
     "matmul" (one-shot GEMM + top_k, query-chunked to a workspace budget),
     "scan" (composed-XLA streaming fallback, any metric), or "auto"
     (consults the ops.autotune measurement cache — populate it with
@@ -449,33 +580,30 @@ def search(
     expanded = mt in _PALLAS_METRICS
 
     if algo == "auto":
-        import os
-
         from ..ops import autotune
 
-        hit = autotune.lookup(autotune.shape_bucket(
-            "bf_search", n=n, m=q.shape[0], d=index.dim, k=k))
+        hit = autotune.lookup(_tune_key(index, q.shape[0], k))
         if hit in ("pallas", "matmul", "scan") and (
                 expanded or hit == "scan"):
             algo = hit
         elif not expanded:
             algo = "scan"
         else:
-            # untuned heuristic: matmul everywhere it can chunk (the
-            # block-min select keeps it competitive at any width); the
-            # fused pallas kernel's per-tile k-extraction is O(k·m·n) VPU
-            # work and measured 28x behind at 500k rows
-            # (scratch/exp_bf_engines.py, r4) — never auto-pick it above
-            # 128k rows
-            budget = int(os.environ.get("RAFT_TPU_MATMUL_WORKSPACE_MB",
-                                        "1024")) << 20
-            if n > (128 << 10) or budget // max(n * 4, 1) >= 8:
-                algo = "matmul"
+            # untuned heuristic: the fused engine owns corpus scale on
+            # TPU — it pays corpus reads only (~n·d·itemsize bytes per
+            # batch) where the GEMM engine materializes the (m, n)
+            # distance block through HBM plus a select pass — but auto
+            # only routes there when a prepare_fused cache is ALREADY
+            # attached: an untuned read-only query must not double the
+            # index's HBM footprint as a side effect, and trace-built
+            # indexes (shard_map shard-locals) could never cache at all.
+            # tune_search/make_searcher(algo='pallas') are the opt-ins;
+            # the measured race then owns the bucket.
+            if (jax.default_backend() == "tpu" and n >= (32 << 10)
+                    and getattr(index, "_fused_pad", None) is not None):
+                algo = "pallas"
             else:
-                algo = ("pallas" if jax.default_backend() == "tpu"
-                        else "scan")
-    if algo == "pallas" and index.store_dtype in (jnp.int8, jnp.uint8):
-        algo = "matmul"   # byte rows ride the GEMM engines (fused convert)
+                algo = "matmul"
     if algo == "pallas":
         expects(mt in _PALLAS_METRICS,
                 "algo='pallas' supports L2/cosine/IP, got %s", mt.name)
@@ -627,6 +755,13 @@ def make_searcher(index: Index, params=None, **opts):
     ``query_chunk``, ...)."""
     expects(params is None, "brute_force has no SearchParams; pass engine "
             "options as keywords")
+    if opts.get("algo") == "pallas":
+        # serving closures dispatch eagerly: align the corpus for the
+        # fused engine once at closure build, not on the first request.
+        # "auto" defers to the first eager dispatch (absorbed by serve
+        # warmup) so an index whose race winner is matmul never holds
+        # the extra corpus copy.
+        prepare_fused(index)
 
     def _fn(queries, k, res=None):
         return search(index, queries, k, res=res, **opts)

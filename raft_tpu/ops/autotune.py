@@ -102,12 +102,19 @@ def _log2_bucket(x: int) -> int:
     return max(0, int(x - 1).bit_length())
 
 
-def shape_bucket(family: str, **dims: int) -> str:
-    """Cache key: backend + device kind + family + log2-bucketed dims."""
+def shape_bucket(family: str, **dims) -> str:
+    """Cache key: backend + device kind + family + log2-bucketed dims.
+
+    Integer dims bucket by log2; string values pass through verbatim as
+    categorical tags (e.g. the brute-force race keys on the corpus
+    storage dtype — ``store='bfloat16'`` — because HBM-traffic-bound
+    crossovers move with the element width, and a winner measured for
+    one storage mode must not steer another's dispatch)."""
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", dev.platform).replace(" ", "_")
     parts = [dev.platform, kind, family]
-    parts += [f"{name}{_log2_bucket(v)}" for name, v in sorted(dims.items())]
+    parts += [f"{name}{_log2_bucket(v) if isinstance(v, int) else v}"
+              for name, v in sorted(dims.items())]
     return ":".join(parts)
 
 

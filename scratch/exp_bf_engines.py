@@ -17,6 +17,10 @@ queries = jax.random.normal(k2, (nq, d), jnp.float32)
 jax.block_until_ready((data, queries))
 bfi = brute_force.build(data, metric="sqeuclidean")
 bfi16 = brute_force.build(data, dtype=jnp.bfloat16)
+# tile-aligned corpus resident in HBM: without this the jitted pallas
+# path pays a corpus pad copy inside every call
+brute_force.prepare_fused(bfi)
+brute_force.prepare_fused(bfi16)
 log("# built")
 
 def wall(tp, calls=4):

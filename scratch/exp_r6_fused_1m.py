@@ -1,0 +1,99 @@
+"""r6 probe: the ungated fused engine at the BASELINE 1M scale.
+
+Races matmul vs the rewritten fused kernel (two-level block-min select,
+corpus-resident tiles) on two 500k parts sharing one executable — the
+same TwoPart shape the bench headline uses — and prints the
+decomposition the bench now records: gemm-only rate, matmul select
+overhead, fused rate. Also sweeps RAFT_TPU_FUSED_TILES when given as a
+comma-separated list in RAFT_TPU_FUSED_TILE_SWEEP (e.g.
+"512,1024;256,2048").
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/raft_tpu_xla_cache")
+sys.path.insert(0, "/root/repo")
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.neighbors import brute_force
+from raft_tpu.ops.autotune import measure_value_read_wall
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+n_part, parts, d, nq, k = 500_000, 2, 128, 10_000, 10
+keys = jax.random.split(jax.random.PRNGKey(0), parts + 1)
+data = [jax.random.normal(kk, (n_part, d), jnp.float32) for kk in keys[:-1]]
+queries = jax.random.normal(keys[-1], (nq, d), jnp.float32)
+jax.block_until_ready((data, queries))
+idxs = [brute_force.build(p) for p in data]
+for ix in idxs:
+    brute_force.prepare_fused(ix)
+log("# built + prepared")
+
+
+def wall(fn, calls=4):
+    perms = [jnp.take(queries, jax.random.permutation(
+        jax.random.PRNGKey(100 + i), nq), axis=0)
+        for i in range(calls + 1)]
+    jax.block_until_ready(perms)
+
+    def tp(q):
+        acc = None
+        for ix in idxs:
+            s = fn(q, ix)
+            acc = s if acc is None else acc + s
+        return acc
+
+    return measure_value_read_wall(tp, perms[:-1], warm_input=perms[-1])
+
+
+out = {}
+flops = 2.0 * nq * n_part * d * parts
+
+gemm = jax.jit(lambda q, ix: jnp.sum(jnp.where(jnp.isfinite(
+    jax.lax.dot_general(q, ix.dataset, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision("highest"))), 1.0, 0.0)))
+t = wall(gemm)
+out["gemm_only"] = {"s_per_call": t, "tflops": flops / t / 1e12}
+log(f"# gemm-only {t*1e3:.1f} ms = {flops/t/1e12:.1f} TFLOP/s")
+
+for algo in ("matmul", "pallas"):
+    fn = jax.jit(lambda q, ix, a=algo: jnp.sum(jnp.where(jnp.isfinite(
+        brute_force.search(ix, q, k, algo=a)[0]), 1.0, 0.0)))
+    try:
+        t = wall(fn)
+    except Exception as e:  # noqa: BLE001
+        out[algo] = {"error": f"{type(e).__name__}: {e}"}
+        log(f"# {algo} failed: {e}")
+        continue
+    out[algo] = {"s_per_call": t, "qps": nq / t, "tflops": flops / t / 1e12}
+    log(f"# {algo}: {nq/t:,.0f} QPS ({flops/t/1e12:.1f} TFLOP/s)")
+
+if "matmul" in out and "s_per_call" in out["matmul"]:
+    out["select_overhead_ms"] = (out["matmul"]["s_per_call"]
+                                 - out["gemm_only"]["s_per_call"]) * 1e3
+
+for cfg in [c for c in os.environ.get("RAFT_TPU_FUSED_TILE_SWEEP",
+                                      "").split(";") if c]:
+    os.environ["RAFT_TPU_FUSED_TILES"] = cfg
+    for ix in idxs:
+        brute_force.prepare_fused(ix)   # re-aligns to the new tn
+    fn = jax.jit(lambda q, ix: jnp.sum(jnp.where(jnp.isfinite(
+        brute_force.search(ix, q, k, algo="pallas")[0]), 1.0, 0.0)))
+    try:
+        t = wall(fn)
+    except Exception as e:  # noqa: BLE001
+        out[f"pallas@{cfg}"] = {"error": f"{type(e).__name__}: {e}"}
+        log(f"# pallas@{cfg} failed: {e}")
+        continue
+    out[f"pallas@{cfg}"] = {"s_per_call": t, "qps": nq / t,
+                            "tflops": flops / t / 1e12}
+    log(f"# pallas@{cfg}: {nq/t:,.0f} QPS")
+
+print(json.dumps(out, indent=1))

@@ -1,0 +1,167 @@
+"""Fused brute-force engine exactness vs the GEMM reference engine.
+
+The acceptance bar for the streaming fused kernel (ops/fused_knn.py) is
+bit-identical results against the matmul engine — index ORDER included,
+ties broken smallest-column exactly as ``lax.top_k`` breaks them — across
+every expanded metric, storage dtype, filter/validity mask and edge
+shape. All of it runs on CPU: the kernel in interpret mode (the same
+code Mosaic compiles on TPU), the >128k dispatch plumbing through the
+guarded XLA fallback (ops/guarded.py), so tier-1 exercises the ungated
+race path without TPU hardware in the loop.
+
+Budget note: tests deliberately share one (m, n, d, k) geometry wherever
+the assertion allows it — interpret-mode kernel compiles dominate the
+wall, and a shared shape means a shared cached executable.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.core import faults
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import brute_force
+
+METRICS = ["sqeuclidean", "euclidean", "cosine", "inner_product"]
+K = 20   # shared-geometry k; >16 so the kernel extract is a fori_loop
+         # (one loop body per merge site instead of k unrolled passes:
+         # interpret-mode compile wall is what tier-1 pays for)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(17)
+    return (rng.standard_normal((1900, 24)).astype(np.float32),
+            rng.standard_normal((40, 24)).astype(np.float32))
+
+
+def assert_engines_match(index, q, k, rtol=1e-5, **opts):
+    """pallas (fused) vs matmul (GEMM+top_k reference): identical index
+    arrays (order included) and matching distances."""
+    vp, ip = brute_force.search(index, q, k, algo="pallas", **opts)
+    vm, im = brute_force.search(index, q, k, algo="matmul", **opts)
+    np.testing.assert_array_equal(np.asarray(ip), np.asarray(im))
+    np.testing.assert_allclose(np.asarray(vp), np.asarray(vm),
+                               rtol=rtol, atol=1e-5)
+    return np.asarray(ip)
+
+
+class TestFusedEngineExactness:
+    @pytest.mark.parametrize("metric", METRICS)
+    def test_metric_parity(self, data, metric):
+        x, q = data
+        index = brute_force.build(x, metric=metric)
+        assert_engines_match(index, q, K)
+
+    def test_tie_order_matches_topk(self, data):
+        # quantized coordinates force massive distance ties; the fused
+        # extraction must retire them smallest-column-first, exactly
+        # lax.top_k's order (not merely the same index SET). Same
+        # geometry as test_metric_parity: executables are cache hits.
+        rng = np.random.default_rng(5)
+        x = rng.integers(-3, 4, data[0].shape).astype(np.float32)
+        q = rng.integers(-3, 4, data[1].shape).astype(np.float32)
+        for metric in ("sqeuclidean", "inner_product"):
+            index = brute_force.build(x, metric=metric)
+            assert_engines_match(index, q, K)
+
+    @pytest.mark.parametrize("dtype", ["bfloat16", "int8"])
+    def test_storage_dtype_parity(self, data, dtype):
+        # low-precision corpora stream through the kernel in their
+        # stored width; the math must match the GEMM engine's
+        # fused-convert path (uint8 covered in test_brute_force)
+        x, q = data
+        index = brute_force.build(x, dtype=dtype)
+        assert_engines_match(index, q, K, rtol=1e-4)
+
+    def test_filter_and_valid_rows_parity(self, data):
+        x, q = data
+        index = brute_force.build(x)
+        rng = np.random.default_rng(3)
+        keep = rng.random(len(x)) > 0.5
+        got = assert_engines_match(index, q, K,
+                                   filter=Bitset.from_mask(jnp.asarray(keep)))
+        assert keep[got[got >= 0]].all()
+        got = assert_engines_match(index, q, K,
+                                   valid_rows=jnp.asarray(700))
+        assert (got < 700).all()
+
+    def test_k_edges(self, data):
+        x, q = data
+        index = brute_force.build(x)
+        assert_engines_match(index, q, 1)     # k=1: single-slot buffer
+        assert_engines_match(index, q, 128)   # k=128: full-lane buffer
+
+    def test_shapes_off_tile_multiples(self):
+        # n and m straddling the tile boundaries exercise the pad +
+        # penalty row (pad rows must never surface as results)
+        rng = np.random.default_rng(8)
+        x = rng.standard_normal((1027, 17)).astype(np.float32)
+        q = rng.standard_normal((13, 17)).astype(np.float32)
+        index = brute_force.build(x)
+        got = assert_engines_match(index, q, 20)
+        assert (got < 1027).all()
+
+    def test_above_old_gate_interpret(self, monkeypatch):
+        """n just above the removed 128k cap, through the REAL kernel
+        (interpret mode; one corpus-wide tile keeps the grid one step)."""
+        monkeypatch.setenv("RAFT_TPU_FUSED_TILES", "8,163840")
+        rng = np.random.default_rng(13)
+        x = rng.standard_normal((131_200, 8)).astype(np.float32)
+        q = rng.standard_normal((8, 8)).astype(np.float32)
+        index = brute_force.build(x)
+        assert_engines_match(index, q, 3)
+
+    def test_above_old_gate_guarded_fallback(self):
+        """The ungated dispatch path at >128k rows with the kernel
+        failing: guarded_call must serve the exact GEMM fallback (the
+        plumbing the serving stack relies on), without demoting the site
+        for later calls (injected faults simulate per-call failure)."""
+        rng = np.random.default_rng(14)
+        x = rng.standard_normal((131_200, 8)).astype(np.float32)
+        q = rng.standard_normal((8, 8)).astype(np.float32)
+        index = brute_force.build(x)
+        vm, im = brute_force.search(index, q, 3, algo="matmul")
+        with faults.inject("kernel_compile", "brute_force.fused"):
+            vp, ip = brute_force.search(index, q, 3, algo="pallas")
+        np.testing.assert_array_equal(np.asarray(ip), np.asarray(im))
+        np.testing.assert_allclose(np.asarray(vp), np.asarray(vm),
+                                   rtol=1e-6)
+        from raft_tpu.ops.guarded import demoted_sites
+
+        assert "brute_force.fused" not in demoted_sites()
+
+    def test_query_chunking_matches_single_dispatch(self, data,
+                                                    monkeypatch):
+        # a chunk smaller than m routes through the lax.map path; results
+        # must be independent of the chunking
+        x, q = data
+        index = brute_force.build(x)
+        v1, i1 = brute_force.search(index, q, K, algo="pallas")
+        monkeypatch.setenv("RAFT_TPU_FUSED_QUERY_CHUNK", "16")
+        v2, i2 = brute_force.search(index, q, K, algo="pallas")
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+
+    def test_prepare_fused_caches_aligned_corpus(self, data):
+        x, q = data
+        index = brute_force.build(x)
+        brute_force.prepare_fused(index)
+        key, d_pad, norms_pad, base_pen, scales_pad = index._fused_pad
+        assert d_pad.shape[0] % 128 == 0 and d_pad.shape[1] % 128 == 0
+        assert np.isinf(np.asarray(base_pen)[len(x):]).all()
+        assert not np.isinf(np.asarray(base_pen)[: len(x)]).any()
+        # idempotent for the same tile geometry
+        again = brute_force.prepare_fused(index)
+        assert index._fused_pad[0] == key and again is None
+
+    @pytest.mark.slow
+    def test_500k_fused_interpret(self, monkeypatch):
+        """Corpus at the bench part scale through the real kernel
+        (interpret; wide tiles bound the unrolled grid)."""
+        monkeypatch.setenv("RAFT_TPU_FUSED_TILES", "8,65536")
+        rng = np.random.default_rng(15)
+        x = rng.standard_normal((500_000, 8)).astype(np.float32)
+        q = rng.standard_normal((8, 8)).astype(np.float32)
+        index = brute_force.build(x)
+        assert_engines_match(index, q, 10)
