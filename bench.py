@@ -775,6 +775,84 @@ def main():
                         break
         del parts_bf16
 
+    def cagra_decomposition(ci, eng_timings):
+        """Per-hop decomposition of the CAGRA traversal: candidate
+        fetch+score through each engine (the gather-tax evidence), the
+        resident-vector score alone, and the dedup+merge — plus the
+        gathered vs streamed byte counts per hop. All probes ride
+        value-read measurements; diagnostics must not cost the lane."""
+        from raft_tpu.matrix.select_k import select_k as _sel
+        from raft_tpu.neighbors import cagra as _cg
+        from raft_tpu.ops import graph_expand as _ge
+
+        deg = ci.graph_degree
+        w, itopk = 4, 32                  # probe anchor == the r5 headline
+        # the block self-describes its operating point: it rides on the
+        # sweep's OPENER entry, whose (itopk, width) can differ
+        decomp = {"probe_itopk": itopk, "probe_width": w}
+        kprime = min(deg, itopk)
+        m = queries.shape[0]
+        kk = jax.random.PRNGKey(5)
+        cand = jax.random.randint(kk, (m, w * deg), 0, ci.size)
+        parents = jax.random.randint(kk, (m, w), 0, ci.size,
+                                     dtype=jnp.int32)
+        mt = ci.metric
+
+        def _fin(x):
+            return jnp.sum(jnp.where(jnp.isfinite(x), x, 0.0))
+
+        def probe(name, fn, *args):
+            try:
+                decomp[name] = round(_autotune.measure(
+                    jax.jit(fn), *args, reps=3,
+                    suspect_floor_s=suspect_floor, value_read=True) * 1e3,
+                    2)
+            except Exception as e:  # noqa: BLE001
+                log(f"# cagra decomp probe {name} failed: "
+                    f"{type(e).__name__}: {e}")
+
+        # the old hop's HBM op: a random (m, w·deg) row gather + score
+        probe("gather_ms",
+              lambda q, c, ix: _fin(_cg._gather_score(
+                  ix._score_bf16, None, c, q, mt)), queries, cand, ci)
+        decomp["gathered_mb"] = round(m * w * deg * ci.dim * 2 / 1e6, 1)
+        store = getattr(ci, "_edge_store", None)
+        if store is not None:
+            # the new hop's HBM op: streamed contiguous edge tiles
+            probe("expand_ms",
+                  lambda q, p, ix: _fin(_ge.graph_expand(
+                      p, q, ix._edge_store[1], ix._edge_store[2], kprime,
+                      metric="ip" if mt.name == "InnerProduct" else "l2",
+                      degree=deg)[0]), queries, parents, ci)
+            meta = store[0]
+            itemsize = 2 if meta[0] == "bfloat16" else 1
+            decomp["streamed_mb"] = round(
+                m * w * meta[2] * meta[3] * itemsize / 1e6, 1)
+        # score alone on resident vectors — isolates fetch from math
+        vs = (getattr(ci, "_score_bf16", ci.dataset))[cand]
+        probe("score_ms", lambda q, v: _fin(_cg._query_dists(q, v, mt)),
+              queries, vs)
+        del vs
+        # dedup + merge at each engine's width (edge: w·kprime candidate
+        # columns vs gather: w·deg — the shrink the per-parent top-k'
+        # emission buys)
+        def _merge(c, ids):
+            dup = _cg._dup_mask(ids[:, itopk:], keep=ids[:, :itopk])
+            c = jnp.concatenate(
+                [c[:, :itopk], jnp.where(dup, jnp.inf, c[:, itopk:])],
+                axis=1)
+            return _fin(_sel(c, itopk, select_min=True)[0])
+
+        for tag, cw in (("merge_ms", w * kprime),
+                        ("merge_gather_ms", w * deg)):
+            probe(tag, _merge,
+                  jax.random.uniform(kk, (m, itopk + cw)),
+                  jax.random.randint(kk, (m, itopk + cw), 0, ci.size))
+        if eng_timings:
+            decomp["engine_timings_ms"] = {
+                kk_: round(v * 1e3, 1) for kk_, v in eng_timings.items()}
+        return decomp
+
     # --- cagra (config 4: graph_degree=64) ------------------------------
     with algo_section('cagra'):
         remaining = budget_s - (time.perf_counter() - t_start)
@@ -815,6 +893,29 @@ def main():
         cagra_build = time.perf_counter() - t0
         cagra.prepare_search(ci)
         log(f"# cagra built ({cagra_n} rows) in {cagra_build:.0f}s")
+        # engine race: the streamed edge-store hop (prepare_traversal +
+        # Pallas frontier expansion) vs the XLA gather hop, at the
+        # anchor config. The winner is cached; when edge wins the store
+        # stays attached and every algo-auto sweep search dispatches on
+        # it, when gather wins the store is dropped (no idle HBM).
+        eng_winner, eng_timings = "gather", {}
+        if jax.default_backend() == "tpu":
+            try:
+                eng_winner, eng_timings = cagra.tune_search(
+                    ci, queries, k,
+                    cagra.SearchParams(itopk_size=32, search_width=4,
+                                       max_iterations=5),
+                    reps=3, suspect_floor_s=suspect_floor)
+                log(f"# cagra engine race -> {eng_winner}")
+            except Exception as e:  # noqa: BLE001
+                log(f"# cagra engine race failed ({type(e).__name__}: "
+                    f"{e}); staying on gather")
+        try:
+            cagra_decomp = cagra_decomposition(ci, eng_timings)
+            log(f"# cagra decomposition: {cagra_decomp}")
+        except Exception as e:  # noqa: BLE001
+            log(f"# cagra decomposition failed ({type(e).__name__}: {e})")
+            cagra_decomp = {}
         # sweep (itopk, search_width, max_iterations); measured sweep
         # 2026-07-31 (see bench.py history): covering seeds + few hops
         # (40,4,5) targets the [0.95, 0.965] recall band the r4 sweep
@@ -833,10 +934,13 @@ def main():
                 continue
             rec = robust_call(lambda: device_recall(fn(queries, ci)[1], cgt),
                               "cagra recall")
+            extra = {"corpus_n": cagra_n, "engine": eng_winner}
+            if (itopk, width, mi) == opener:
+                extra["decomposition"] = cagra_decomp
             add_entry("raft_cagra",
                       f"raft_cagra.degree64.itopk{itopk}.w{width}"
                       f".mi{mi or 'auto'}",
-                      thr, lat, rec, cagra_build, {"corpus_n": cagra_n})
+                      thr, lat, rec, cagra_build, extra)
             if rec >= 0.995 and (itopk, width, mi) != opener:
                 break
 
@@ -867,6 +971,17 @@ def main():
         build_1m = time.perf_counter() - t0
         cagra.prepare_search(ci1m)
         log(f"# cagra 1M built in {build_1m:.0f}s")
+        # edge store at 1M: deg64×dim128 int8 = 8.2 GB — fits v5e HBM
+        # next to the f32 dataset + bf16 copy; a build/OOM failure just
+        # keeps the lane on the gather engine
+        eng_1m = "gather"
+        if jax.default_backend() == "tpu":
+            try:
+                cagra.prepare_traversal(ci1m)
+                eng_1m = "edge"
+            except Exception as e:  # noqa: BLE001
+                log(f"# cagra 1M prepare_traversal failed "
+                    f"({type(e).__name__}: {e}); gather engine")
         for itopk, width, mi in ((32, 4, 5), (40, 4, 5)):
             sp = cagra.SearchParams(itopk_size=itopk, search_width=width,
                                     max_iterations=mi)
@@ -882,7 +997,8 @@ def main():
                       f"raft_cagra.1M.degree64.itopk{itopk}.w{width}"
                       f".mi{mi}",
                       thr, lat, rec, build_1m,
-                      {"corpus_n": n, "reduced_sweep": True},
+                      {"corpus_n": n, "reduced_sweep": True,
+                       "engine": eng_1m},
                       baseline_key=None)
             if rec >= 0.95:
                 break

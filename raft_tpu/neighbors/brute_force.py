@@ -427,22 +427,11 @@ def tune_search(index: Index, queries, k: int, reps: int = 5,
     # the index rides as a jit ARGUMENT: closure-baking it would trace
     # the dataset into the HLO as a constant, which exceeds the tunnel's
     # remote-compile request limit at memory scale (observed HTTP 413 at
-    # 500k rows). The fresh_executable hook keeps that true on
-    # autotune's plausibility-floor re-measure path.
-    class _EngineFn:
-        def __init__(self, fitted):
-            self._f = fitted
-
-        def __call__(self, qq):
-            return self._f(qq, index)
-
-        def fresh_executable(self):
-            inner = self._f
-            return _EngineFn(jax.jit(lambda qq, idx: inner(qq, idx)))
-
+    # 500k rows). JitArgFn keeps that true on autotune's
+    # plausibility-floor re-measure path.
     def _engine(algo):
-        return _EngineFn(
-            jax.jit(lambda qq, idx: search(idx, qq, k, algo=algo)))
+        return autotune.JitArgFn(
+            jax.jit(lambda qq, idx: search(idx, qq, k, algo=algo)), index)
 
     cands = {"matmul": _engine("matmul"), "scan": _engine("scan")}
     if index.metric in _PALLAS_METRICS and jax.default_backend() == "tpu":

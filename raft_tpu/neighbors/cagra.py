@@ -48,12 +48,15 @@ from ..core.errors import expects
 from ..core.serialize import load_arrays, save_arrays
 from ..distance.distance_types import DistanceType, canonical_metric
 from ..matrix.select_k import select_k
-from ..utils import run_query_chunks
+from ..ops.guarded import guarded_call
+from ..utils import round_up_to, run_query_chunks
 from . import ivf_pq as ivf_pq_mod
 from . import refine as refine_mod
 
 __all__ = ["BuildAlgo", "IndexParams", "SearchParams", "Index", "build",
-           "build_knn_graph", "optimize", "search", "save", "load"]
+           "build_knn_graph", "optimize", "search", "save", "load",
+           "prepare_search", "prepare_traversal", "tune_search",
+           "make_searcher"]
 
 _SERIAL_VERSION = 2   # v2 adds optional seed_nodes
 
@@ -112,6 +115,14 @@ class SearchParams:
     # TPU; "auto"/"single_cta"/"multi_cta"/"multi_kernel" are all accepted
     # and run the same plan (XLA owns the occupancy tradeoffs)
     algo: str = "auto"
+    # hop engine: "edge" streams each parent's contiguous neighbor tile
+    # from the edge-resident candidate store (prepare_traversal) through
+    # the Pallas frontier-expansion kernel; "gather" is the composed-XLA
+    # random-row-gather path; "auto" consults the ops.autotune race cache
+    # (tune_search populates it) and otherwise picks "edge" only when a
+    # store is already attached on TPU — a read-only query never grows
+    # the index's HBM footprint as a side effect
+    engine: str = "auto"
 
 
 @jax.tree_util.register_pytree_node_class
@@ -144,11 +155,16 @@ class Index:
         # traversal-dtype caches travel WITH the index so jitted
         # functions can take it as an ARGUMENT (closure-baking the
         # dataset + bf16 copy as HLO constants exceeds remote-compile
-        # request limits at memory scale)
+        # request limits at memory scale); the edge-resident candidate
+        # store (prepare_traversal) rides the same way, its static meta
+        # tuple in aux_data so executables re-key on geometry changes
+        es = getattr(self, "_edge_store", None)
         leaves = (self.dataset, self.graph, self.seed_nodes,
                   getattr(self, "_score_bf16", None),
-                  getattr(self, "_score_i8", None))
-        return leaves, (self.metric,)
+                  getattr(self, "_score_i8", None),
+                  es[1] if es is not None else None,
+                  es[2] if es is not None else None)
+        return leaves, (self.metric, es[0] if es is not None else None)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
@@ -157,6 +173,8 @@ class Index:
             out._score_bf16 = leaves[3]
         if leaves[4] is not None:
             out._score_i8 = leaves[4]
+        if len(aux) > 1 and aux[1] is not None and leaves[5] is not None:
+            out._edge_store = (aux[1], leaves[5], leaves[6])
         return out
 
 
@@ -622,21 +640,64 @@ def _seed_dists(qc, vecs, mt):
     return jnp.maximum(q2 + v2[None, :] - 2.0 * ip, 0.0)
 
 
+def _dup_mask(cand, keep=None):
+    """(m, c) bool: ``cand[i, j]`` duplicates an entry of ``keep[i]`` or
+    an *earlier* ``cand[i, j' < j]``.
+
+    Sort-based replacement for the former O(c²)/O(c·itopk) broadcast
+    equality planes (``jnp.tril(eq)`` over (m, c, c) — VMEM-hungry at
+    itopk64·w4 and quadratic in ``search_width``): one stable argsort of
+    the concatenated ids brings every duplicate run together, a single
+    neighbor compare flags all but the run's first element, and the
+    inverse permutation (a second integer argsort) carries the flags
+    back. Stability makes "first" = lowest original position, and
+    ``keep`` entries precede equal candidates in the concat order, so
+    the semantics match the old masks exactly: any candidate equal to a
+    keep entry, or to an earlier candidate, is flagged."""
+    m, c = cand.shape
+    allv = cand if keep is None else jnp.concatenate([keep, cand], axis=1)
+    b = allv.shape[1] - c
+    order = jnp.argsort(allv, axis=1, stable=True)
+    sv = jnp.take_along_axis(allv, order, axis=1)
+    dup_s = jnp.concatenate(
+        [jnp.zeros((m, 1), bool), sv[:, 1:] == sv[:, :-1]], axis=1)
+    inv = jnp.argsort(order, axis=1, stable=True)
+    return jnp.take_along_axis(dup_s, inv, axis=1)[:, b:]
+
+
 @partial(jax.jit, static_argnames=("itopk", "width", "max_iter", "k",
-                                   "n_seeds", "mt_val", "min_iter"))
+                                   "n_seeds", "mt_val", "min_iter",
+                                   "engine", "kprime", "interp"))
 def _search_jit(dataset, dataset_score, score_scales, graph, qc, mask_bits,
-                seed_key, seed_rows, itopk, width, max_iter, k, n_seeds,
-                mt_val, min_iter=0):
-    """``dataset_score`` feeds the traversal's candidate gathers (bf16 in
-    the default bandwidth-saving mode, int8 + per-row ``score_scales`` in
-    the quarter-traffic mode); ``dataset`` (f32) re-scores the final
-    top-k exactly, so returned distances are exact regardless.
-    ``seed_rows``: optional (s,) shared covering seed set — scored by one
-    GEMM and mixed with the per-query random seeds."""
+                seed_key, seed_rows, edge_vecs, edge_aux, itopk, width,
+                max_iter, k, n_seeds, mt_val, min_iter=0,
+                engine="gather", kprime=0, interp=False):
+    """``dataset_score`` feeds the seed scoring and (engine="gather") the
+    traversal's candidate gathers (bf16 in the default bandwidth-saving
+    mode, int8 + per-row ``score_scales`` in the quarter-traffic mode);
+    ``dataset`` (f32) re-scores the final top-k exactly, so returned
+    distances are exact regardless. ``seed_rows``: optional (s,) shared
+    covering seed set — scored by one GEMM and mixed with the per-query
+    random seeds. ``engine="edge"``: the hop streams each parent's
+    contiguous neighbor tile from ``edge_vecs``/``edge_aux`` (the
+    prepare_traversal store) through the Pallas frontier-expansion
+    kernel, which emits a per-parent top-``kprime`` — the merge width
+    shrinks from width·degree to width·kprime."""
     mt = DistanceType(mt_val)
     m, dim = qc.shape
     n = dataset.shape[0]
     degree = graph.shape[1]
+    metric_s = "ip" if mt is DistanceType.InnerProduct else "l2"
+
+    if engine == "edge" and mask_bits is not None:
+        # the bitset filter in edge-major layout: the kernel adds this
+        # penalty in-VMEM, so filtered edges never reach the merge. One
+        # (n, degree) gather per CALL (not per hop), loop-invariant
+        pen_node = jnp.where(mask_bits, 0.0, jnp.inf).astype(jnp.float32)
+        edge_pen = jnp.pad(pen_node[graph],
+                           ((0, 0), (0, edge_vecs.shape[1] - degree)))
+    else:
+        edge_pen = None
 
     # seed the itopk buffer: per-query random nodes (random_seed init,
     # search_plan.cuh), plus the shared covering set when present
@@ -645,9 +706,7 @@ def _search_jit(dataset, dataset_score, score_scales, graph, qc, mask_bits,
     if mask_bits is not None:
         seed_d = jnp.where(mask_bits[seeds], seed_d, jnp.inf)
     # dedup identical random seeds (mark later occurrences)
-    eq = seeds[:, :, None] == seeds[:, None, :]       # [m, i, j] = s_i == s_j
-    dup = jnp.tril(eq, k=-1).any(axis=2)              # exists i < j equal
-    seed_d = jnp.where(dup, jnp.inf, seed_d)
+    seed_d = jnp.where(_dup_mask(seeds), jnp.inf, seed_d)
     if seed_rows is not None:
         svecs = dataset_score[seed_rows]              # (s, d) — tiny
         if score_scales is not None:
@@ -684,6 +743,8 @@ def _search_jit(dataset, dataset_score, score_scales, graph, qc, mask_bits,
         frontier_open = jnp.any(~explored & jnp.isfinite(buf_d))
         return (it < max_iter) & (frontier_open | (it < min_iter))
 
+    cand_w = width * (kprime if engine == "edge" else degree)
+
     def body(state):
         buf_i, buf_d, explored, it = state
         # pick top `width` unexplored parents (pickup_next_parents :51)
@@ -692,29 +753,48 @@ def _search_jit(dataset, dataset_score, score_scales, graph, qc, mask_bits,
         parent_ids = jnp.take_along_axis(buf_i, psel, axis=1)
         parent_ok = jnp.isfinite(jnp.take_along_axis(cand_d, psel, axis=1))
         explored = explored.at[jnp.arange(m)[:, None], psel].set(True)
+        psafe = jnp.where(parent_ok, parent_ids, 0)
 
-        # expand: graph neighbors of parents
-        cand = graph[jnp.where(parent_ok, parent_ids, 0)]    # (m, w, deg)
-        cand = cand.reshape(m, width * degree)
-        cand_ok = jnp.repeat(parent_ok, degree, axis=1)
-        # dedup vs itopk buffer (the hashmap stand-in). Without this,
-        # near convergence most of the block duplicates top buffer
-        # entries, floods the merge's top slots, and evicts genuinely
-        # new candidates — measured recall collapse 0.97 → 0.70
-        in_buf = jnp.any(cand[:, :, None] == buf_i[:, None, :], axis=2)
-        # dedup within the candidate block (mark later occurrences)
-        dup = jnp.tril(cand[:, :, None] == cand[:, None, :], k=-1).any(axis=2)
-        cand_ok = cand_ok & ~in_buf & ~dup
-        cd = _gather_score(dataset_score, score_scales, cand, qc, mt)
-        if mask_bits is not None:
-            cand_ok = cand_ok & mask_bits[cand]
+        if engine == "edge":
+            # streamed expansion: one contiguous edge-store tile per
+            # parent through the Pallas kernel (bitset penalty applied
+            # in-kernel), emitting per-parent top-kprime — only the
+            # (m, w, deg) int32 graph rows are still gathered, 1/dim-th
+            # of the former vector-gather bytes
+            from ..ops.graph_expand import graph_expand
+
+            pvals, pepos = graph_expand(psafe, qc, edge_vecs, edge_aux,
+                                        kprime, metric=metric_s,
+                                        degree=degree, pen=edge_pen,
+                                        interpret=interp)
+            nbr = graph[psafe]                               # (m, w, deg)
+            cand = jnp.take_along_axis(nbr, jnp.maximum(pepos, 0), axis=2)
+            # empty kernel slots (epos -1) must not alias a real node id:
+            # a phantom occurrence would dup-flag a later genuine one
+            cand = jnp.where(pepos >= 0, cand, -1).reshape(m, cand_w)
+            cd = pvals.reshape(m, cand_w)
+            cand_ok = (jnp.repeat(parent_ok, kprime, axis=1)
+                       & (pepos >= 0).reshape(m, cand_w))
+        else:
+            # expand: graph neighbors of parents (the random row gather)
+            cand = graph[psafe].reshape(m, cand_w)           # (m, w·deg)
+            cand_ok = jnp.repeat(parent_ok, degree, axis=1)
+            cd = _gather_score(dataset_score, score_scales, cand, qc, mt)
+            if mask_bits is not None:
+                cand_ok = cand_ok & mask_bits[cand]
+        # dedup vs itopk buffer (the hashmap stand-in) and within the
+        # candidate block. Without this, near convergence most of the
+        # block duplicates top buffer entries, floods the merge's top
+        # slots, and evicts genuinely new candidates — measured recall
+        # collapse 0.97 → 0.70 (sort-based: see _dup_mask)
+        cand_ok = cand_ok & ~_dup_mask(cand, keep=buf_i)
         cd = jnp.where(cand_ok, cd, jnp.inf)
 
         # merge candidates into itopk (bitonic merge analog :94-200)
         all_d = jnp.concatenate([buf_d, cd], axis=1)
         all_i = jnp.concatenate([buf_i, cand], axis=1)
         all_e = jnp.concatenate(
-            [explored, jnp.zeros((m, width * degree), bool)], axis=1)
+            [explored, jnp.zeros((m, cand_w), bool)], axis=1)
         new_d, sel = select_k(all_d, itopk, select_min=True)
         new_i = jnp.take_along_axis(all_i, sel, axis=1)
         new_e = jnp.take_along_axis(all_e, sel, axis=1)
@@ -755,6 +835,135 @@ def prepare_search(index: Index, candidate_dtype: str = "bfloat16") -> None:
             index._score_i8 = quantize_rows(index.dataset, jnp.int8)
 
 
+def prepare_traversal(index: Index, candidate_dtype: str = "int8") -> None:
+    """Eagerly build the edge-resident candidate store and attach it to
+    the index: for every node, its ``degree`` neighbors' quantized
+    vectors packed into one contiguous ``(n, deg_p, dim_p)`` HBM array
+    (plus a ``(n, 2, deg_p)`` f32 aux of per-edge dequant scales and
+    norms), so the ``engine="edge"`` hop streams one 8 KB tile per
+    expanded parent instead of ``degree`` random 128-256 B lines — the
+    GGNN co-location move (arXiv:1912.01059) in TPU form.
+
+    OPT-IN, exactly like ``brute_force.prepare_fused``: the store costs
+    ``n·deg_p·dim_p`` bytes at storage width (int8 default — 4.1 GB at
+    500k×deg64×dim128 — bf16 doubles that), so a read-only query never
+    doubles index HBM as a side effect; ``tune_search`` attaches it for
+    the race and drops it again if the gather engine wins. Idempotent on
+    a matching (dtype, degree) geometry — a second call is a no-op, no
+    HBM double-alloc. The store travels through the Index pytree, so
+    jitted functions taking the index as an argument reuse it; it is
+    derived data and is NOT serialized (rebuild after :func:`load`).
+    Never built under a jax trace (cache writes there would store
+    tracers)."""
+    from ..utils import in_jax_trace
+
+    if in_jax_trace():
+        return
+    expects(candidate_dtype in ("int8", "i8", "bfloat16", "bf16"),
+            "edge store dtype must be int8/bfloat16, got %r",
+            candidate_dtype)
+    int8 = candidate_dtype in ("int8", "i8")
+    dtype_str = "int8" if int8 else "bfloat16"
+    degree = index.graph_degree
+    deg_p = round_up_to(degree, 32)       # int8 sublane tile (bf16 needs 16)
+    dim_p = round_up_to(index.dim, 128)
+    meta = (dtype_str, degree, deg_p, dim_p)
+    cur = getattr(index, "_edge_store", None)
+    if cur is not None and cur[0] == meta:
+        return
+    g = index.graph
+    if int8:
+        from .brute_force import quantize_rows
+
+        cached = getattr(index, "_score_i8", None)
+        if cached is None:
+            cached = quantize_rows(index.dataset, jnp.int8)
+            index._score_i8 = cached   # int8 candidate_dtype searches reuse it
+        stored, scales = cached
+        en = (scales * scales) * jnp.sum(
+            jnp.square(stored.astype(jnp.float32)), axis=1)
+        es = scales[g]
+    else:
+        stored = getattr(index, "_score_bf16", None)
+        if stored is None:
+            stored = index.dataset.astype(jnp.bfloat16)
+            index._score_bf16 = stored
+        en = jnp.sum(jnp.square(stored.astype(jnp.float32)), axis=1)
+        es = jnp.ones(g.shape, jnp.float32)
+    pad_d, pad_f = deg_p - degree, dim_p - index.dim
+    if pad_d or pad_f:
+        # gather + pad under one jit write a single padded output buffer;
+        # eagerly, stored[g] then jnp.pad holds TWO copies of the store
+        # transiently (jnp.pad copies even at zero width) — 2x of 8.2 GB
+        # at the 1M int8 point would OOM a v5e.
+        ev = jax.jit(lambda s, gg: jnp.pad(
+            s[gg], ((0, 0), (0, pad_d), (0, pad_f))))(stored, g)
+    else:
+        ev = stored[g]
+    aux = jnp.stack([es, en[g]], axis=1)
+    if pad_d:
+        aux = jnp.pad(aux, ((0, 0), (0, 0), (0, pad_d)))
+    index._edge_store = (meta, ev, aux)
+
+
+def _tune_key(index: Index, m: int, k: int, p: "SearchParams",
+              store) -> str:
+    """Autotune bucket for the engine race. Dtype-aware: the edge store's
+    storage width (or the gather path's candidate_dtype) is part of the
+    key — HBM-traffic-bound crossovers move with the element width, so a
+    winner measured for one storage mode must not steer another's
+    dispatch (the brute-force race set the precedent)."""
+    from ..ops import autotune
+
+    sd = store[0][0] if store is not None else str(p.candidate_dtype)
+    return autotune.shape_bucket("cagra_search", n=index.size, m=m,
+                                 d=index.dim, k=k, deg=index.graph_degree,
+                                 itopk=max(p.itopk_size, k),
+                                 w=max(1, p.search_width), store=sd)
+
+
+def tune_search(index: Index, queries, k: int,
+                params: SearchParams | None = None, reps: int = 3,
+                suspect_floor_s: float = 0.0,
+                store_dtype: str = "int8"):
+    """Measure the traversal engines on-device for this shape class and
+    cache the winner (consulted by ``engine="auto"``): the streamed
+    edge-store hop (Pallas frontier expansion) races the XLA gather hop.
+    Attaches the edge store for the race and DROPS it again when the
+    gather engine wins — the store is ~``n·degree·dim`` bytes of extra
+    HBM and only earns it behind the winning engine. Call eagerly (not
+    under jit) — e.g. once at serving start, or from the bench harness.
+    Returns (winner, timings)."""
+    from ..ops import autotune
+
+    p = params or SearchParams()
+    q = jnp.asarray(queries, jnp.float32)
+    prepare_traversal(index, store_dtype)
+    prepare_search(index, p.candidate_dtype)
+    key = _tune_key(index, q.shape[0], k, p, index._edge_store)
+
+    # the index rides as a jit ARGUMENT (closure-baking the dataset +
+    # edge store as HLO constants exceeds remote-compile request limits
+    # at memory scale); JitArgFn keeps that true on autotune's
+    # plausibility-floor re-measure path
+    def _engine(eng):
+        return autotune.JitArgFn(jax.jit(
+            lambda qq, idx, e=eng: search(idx, qq, k, p, engine=e)), index)
+
+    cands = {"gather": _engine("gather"), "edge": _engine("edge")}
+    winner, timings = autotune.tune_best(key, cands, q, reps=reps,
+                                         force=True,
+                                         suspect_floor_s=suspect_floor_s,
+                                         value_read=True)
+    if winner != "edge":
+        index.__dict__.pop("_edge_store", None)
+        # the raced key carried the STORE dtype; with the store dropped,
+        # auto queries are storeless and key on candidate_dtype — mirror
+        # the verdict there so the measured gather win stays reachable
+        autotune.record(_tune_key(index, q.shape[0], k, p, None), winner)
+    return winner, timings
+
+
 @interop.auto_convert_output
 @tracing.annotate("raft_tpu::cagra::search")
 def search(
@@ -765,6 +974,7 @@ def search(
     filter: Optional[Bitset] = None,  # noqa: A002
     res=None,
     query_chunk: int = 0,
+    engine: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Batched-frontier graph traversal (search_single_cta analog).
 
@@ -772,6 +982,12 @@ def search(
     explicit ``query_chunk`` is given), queries traverse in host-level
     chunks with a cancellation/deadline checkpoint between dispatches —
     ``DeadlineExceeded`` carries the completed chunks' partial results.
+    ``engine``: overrides ``SearchParams.engine`` — "edge" (streamed
+    edge-store hop via the Pallas frontier-expansion kernel; requires /
+    eagerly builds the ``prepare_traversal`` store, and is guarded onto
+    the gather path on kernel failure), "gather" (composed-XLA random
+    row gather), or "auto" (autotune cache, then store-attached
+    heuristic).
     """
     p = params or SearchParams()
     q = jnp.asarray(queries, jnp.float32)
@@ -826,11 +1042,51 @@ def search(
     expects(p.algo in ("auto", "single_cta", "multi_cta", "multi_kernel"),
             "unknown cagra search algo %r", p.algo)
 
+    eng = engine or p.engine
+    expects(eng in ("auto", "edge", "gather"),
+            "unknown cagra traversal engine %r", eng)
+    store = getattr(index, "_edge_store", None)
+    if eng == "auto":
+        from ..ops import autotune
+
+        hit = autotune.lookup(_tune_key(index, q.shape[0], k, p, store))
+        if hit == "gather" or (hit == "edge" and store is not None):
+            eng = hit
+        elif store is not None and jax.default_backend() == "tpu":
+            # a store someone paid for implies the streamed hop; without
+            # one, auto never builds it — tune_search / prepare_traversal
+            # are the opt-ins (a read-only query must not double HBM)
+            eng = "edge"
+        else:
+            eng = "gather"
+    if eng == "edge" and store is None:
+        from ..utils import in_jax_trace
+
+        expects(not in_jax_trace(),
+                "engine='edge' requires prepare_traversal(index) before "
+                "tracing (the edge store cannot be built under jit)")
+        prepare_traversal(index)
+        store = index._edge_store
+    kprime = min(index.graph_degree, itopk)
+    interp = jax.default_backend() != "tpu"
+
     def run(qc, key=key):
-        return _search_jit(index.dataset, score, scales, index.graph, qc,
-                           mask_bits, key, index.seed_nodes, itopk, width,
-                           int(max_iter), k, n_seeds, index.metric.value,
-                           int(p.min_iterations))
+        def _go(e):
+            ev, ea = (store[1], store[2]) if e == "edge" else (None, None)
+            return _search_jit(index.dataset, score, scales, index.graph,
+                               qc, mask_bits, key, index.seed_nodes, ev,
+                               ea, itopk, width, int(max_iter), k,
+                               n_seeds, index.metric.value,
+                               int(p.min_iterations), engine=e,
+                               kprime=kprime, interp=interp)
+
+        if eng == "edge":
+            # a frontier-kernel failure demotes this site to the exact
+            # XLA gather path (ops/guarded.py) — one log line and a
+            # slower call, never the request
+            return guarded_call("cagra.graph_expand",
+                                lambda: _go("edge"), lambda: _go("gather"))
+        return _go("gather")
 
     if query_chunk <= 0 and deadline.carried(res) is not None:
         query_chunk = max(1, min(q.shape[0], 1024))
@@ -885,7 +1141,14 @@ def make_searcher(index: Index, params: SearchParams | None = None, **opts):
     (distances, indices)`` with the traversal policy frozen at closure
     build time, so repeated bucketed-shape calls hit the same cached
     executables. ``opts`` forwards to :func:`search` (``filter``,
-    ``query_chunk``, ...)."""
+    ``query_chunk``, ``engine``, ...). Pinning ``engine="edge"`` (via
+    opts or ``params.engine``) builds the edge-resident candidate store
+    at closure-build time, not on the first request — serve warmup then
+    only pays the per-shape compiles."""
+    eng = opts.get("engine") or (params.engine if params is not None
+                                 else None)
+    if eng == "edge":
+        prepare_traversal(index)
 
     def _fn(queries, k, res=None):
         return search(index, queries, k, params, res=res, **opts)

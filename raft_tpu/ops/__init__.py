@@ -9,4 +9,5 @@ per-tile full `lax.top_k` is a full sort; these kernels keep the GEMM on
 the MXU and maintain a running k-best in VMEM instead.
 """
 from .fused_knn import fused_knn  # noqa: F401
+from .graph_expand import graph_expand  # noqa: F401
 from .guarded import guarded_call  # noqa: F401

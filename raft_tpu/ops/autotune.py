@@ -286,6 +286,27 @@ def _perturbed(first, out_prev, r: int):
     return a0
 
 
+class JitArgFn:
+    """``tune_best`` candidate wrapper for engines whose jitted callable
+    takes a large operand (an index pytree) as a jit ARGUMENT —
+    closure-baking it would trace the arrays into the HLO as constants
+    and blow the tunnel's remote-compile request limit at memory scale.
+    Implements the ``fresh_executable`` protocol by re-wrapping the
+    fitted callable in a new outer jit with the operand still passed as
+    an argument."""
+
+    def __init__(self, fitted: Callable, arg):
+        self._f = fitted
+        self._arg = arg
+
+    def __call__(self, qq):
+        return self._f(qq, self._arg)
+
+    def fresh_executable(self) -> "JitArgFn":
+        inner = self._f
+        return JitArgFn(jax.jit(lambda qq, a: inner(qq, a)), self._arg)
+
+
 def _fresh_executable(fn: Callable) -> Callable:
     """A callable backed by a freshly-compiled executable.
 

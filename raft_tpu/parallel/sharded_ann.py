@@ -348,9 +348,11 @@ def search_cagra(index: ShardedCagra, queries, k: int,
         # neither random nor covering seeding can surface them
         valid = jnp.arange(data.shape[1], dtype=jnp.int32) < count[0]
         seed_rows = rest[0][0] if has_seeds else None
+        # gather engine explicitly: shard-local data lives only inside
+        # this trace, so an edge-resident store can never be attached
         d, i = cagra._search_jit(
             data[0], data[0], None, graph[0], qq, valid,
-            jax.random.key(sp.seed), seed_rows, itopk,
+            jax.random.key(sp.seed), seed_rows, None, None, itopk,
             width, int(max_iter), k, n_seeds, mt.value)
         gi = jnp.where(i >= 0, i + base[0], -1)
         gi = jnp.where(okf[0, 0], gi, -1)       # dead-shard containment

@@ -62,15 +62,25 @@ def count_compilations():
 
 
 def warmup(search_fn, ladder, dim: int, dtype=np.float32, registry=None,
-           name: str = "serve") -> int:
+           name: str = "serve", prepare=None) -> int:
     """Dispatch a dummy batch through ``search_fn`` at every ladder shape
     and block on each result. Returns the number of XLA compilations the
     sweep triggered (0 when the process is already warm). Records
     ``<name>.warmup.shapes`` (gauge) and ``<name>.warmup.compiles``
-    (counter)."""
+    (counter).
+
+    ``prepare``: optional zero-arg callable run BEFORE the sweep for
+    index-side cache builds that must not land on the first unlucky
+    request — e.g. ``lambda: brute_force.prepare_fused(index)`` or
+    ``lambda: cagra.prepare_traversal(index)`` (the edge-resident
+    candidate store is seconds of gather+pack at corpus scale, and the
+    jitted ladder shapes can only reuse it if it exists before their
+    first trace)."""
     from . import metrics as _metrics
 
     reg = registry or _metrics.default_registry
+    if prepare is not None:
+        prepare()
     shapes = 0
     with count_compilations() as cc:
         for mb in ladder.query_buckets:
