@@ -856,17 +856,18 @@ def main():
     # --- cagra (config 4: graph_degree=64) ------------------------------
     with algo_section('cagra'):
         remaining = budget_s - (time.perf_counter() - t_start)
-        # round 4: optimize()/seeds rework + ivf_pq candidate graph make
-        # a 500k build feasible; still budget-gated. One part only — the
-        # graph index demonstrates single-index scaling (the sharded form
-        # is dryrun_multichip's job).
-        cagra_n = part_n if remaining > 900 and part_n >= 500_000 else \
+        # round 6: knn_graph auto → nn_descent at 500k (the fused exact
+        # pass below RAFT_TPU_CAGRA_BRUTE_N) cut the build from 366.8s
+        # to minutes-fraction scale; the gates shrink accordingly. One
+        # part only — the graph index demonstrates single-index scaling
+        # (the sharded form is dryrun_multichip's job).
+        cagra_n = part_n if remaining > 700 and part_n >= 500_000 else \
             min(n, 100_000 if scale != "micro" else 20_000)
         cagra_env = os.environ.get("RAFT_TPU_BENCH_CAGRA_N")
         if cagra_env:
             cagra_n = int(cagra_env)
         else:
-            need_s = 700 if cagra_n > 50_000 else 120
+            need_s = 400 if cagra_n > 50_000 else 120
             from raft_tpu.core.errors import expects as _expects
             _expects(remaining > need_s,
                      "budget skip: %.0fs left < %ds needed for a %d-row "
@@ -891,8 +892,12 @@ def main():
             "cagra build")
         jax.block_until_ready(jax.tree.leaves(ci))
         cagra_build = time.perf_counter() - t0
+        # phase decomposition (knn_graph_s/optimize_s/seeds_s + which
+        # builder auto picked): the evidence block for build-time PRs
+        build_decomp = dict(getattr(ci, "build_stats", {}))
         cagra.prepare_search(ci)
-        log(f"# cagra built ({cagra_n} rows) in {cagra_build:.0f}s")
+        log(f"# cagra built ({cagra_n} rows) in {cagra_build:.0f}s: "
+            f"{build_decomp}")
         # engine race: the streamed edge-store hop (prepare_traversal +
         # Pallas frontier expansion) vs the XLA gather hop, at the
         # anchor config. The winner is cached; when edge wins the store
@@ -934,7 +939,8 @@ def main():
                 continue
             rec = robust_call(lambda: device_recall(fn(queries, ci)[1], cgt),
                               "cagra recall")
-            extra = {"corpus_n": cagra_n, "engine": eng_winner}
+            extra = {"corpus_n": cagra_n, "engine": eng_winner,
+                     "build_decomposition": build_decomp}
             if (itopk, width, mi) == opener:
                 extra["decomposition"] = cagra_decomp
             add_entry("raft_cagra",
@@ -945,22 +951,22 @@ def main():
                 break
 
     # --- cagra at the BASELINE 1M scale (the lane's missing point) ------
-    # The graph build is the cost: knn_graph auto → brute →
-    # _parted_brute_graph (two 500k-part programs sharing one executable;
-    # the 1M single-program compile hang never happens), but the n²·d
-    # exact pass is ~2.6e17 FLOP ≈ 25 min of MXU time — so the lane is
-    # budget-gated OFF by default and runs a REDUCED sweep (one config,
-    # no vs_baseline ratio: a one-point sweep is not the Pareto frontier
-    # the A100 baseline derivation describes). RAFT_TPU_BENCH_CAGRA_1M=1
-    # forces it; =0 skips regardless of budget.
+    # The graph build is the cost. knn_graph auto → nn_descent at 1M
+    # (O(rounds·n·C·d), batch-shaped programs — the 1M single-program
+    # compile hang structurally cannot happen), which replaced the
+    # parted exact pass whose n²·d ≈ 2.6e17 FLOP was ~25 min of MXU
+    # time. Still budget-gated (build + optimize + sweep is minutes) and
+    # a REDUCED sweep (one config, no vs_baseline ratio: a one-point
+    # sweep is not the Pareto frontier the A100 baseline derivation
+    # describes). RAFT_TPU_BENCH_CAGRA_1M=1 forces; =0 skips regardless.
     with algo_section('cagra_1m'):
         remaining = budget_s - (time.perf_counter() - t_start)
         from raft_tpu.core.errors import expects as _expects
         force_1m = os.environ.get("RAFT_TPU_BENCH_CAGRA_1M")
         _expects(force_1m != "0" and n >= 1_000_000,
                  "cagra 1M skip: forced=%s n=%d", force_1m, n)
-        _expects(force_1m == "1" or (not hurry and remaining > 2200),
-                 "cagra 1M skip: %.0fs left < 2200s for the parted exact "
+        _expects(force_1m == "1" or (not hurry and remaining > 1200),
+                 "cagra 1M skip: %.0fs left < 1200s for the nn_descent "
                  "graph build (set RAFT_TPU_BENCH_CAGRA_1M=1 to force)",
                  remaining)
         t0 = time.perf_counter()
@@ -969,8 +975,9 @@ def main():
             "cagra 1M build", tries=1)
         jax.block_until_ready(jax.tree.leaves(ci1m))
         build_1m = time.perf_counter() - t0
+        decomp_1m = dict(getattr(ci1m, "build_stats", {}))
         cagra.prepare_search(ci1m)
-        log(f"# cagra 1M built in {build_1m:.0f}s")
+        log(f"# cagra 1M built in {build_1m:.0f}s: {decomp_1m}")
         # edge store at 1M: deg64×dim128 int8 = 8.2 GB — fits v5e HBM
         # next to the f32 dataset + bf16 copy; a build/OOM failure just
         # keeps the lane on the gather engine
@@ -998,10 +1005,66 @@ def main():
                       f".mi{mi}",
                       thr, lat, rec, build_1m,
                       {"corpus_n": n, "reduced_sweep": True,
-                       "engine": eng_1m},
+                       "engine": eng_1m,
+                       "build_decomposition": decomp_1m},
                       baseline_key=None)
             if rec >= 0.95:
                 break
+
+    # --- graph-build race: fused exact all-pairs vs NN-descent ----------
+    # The two CAGRA graph builders at one shape (100k×128 at k=96, the
+    # real build's intermediate degree): wall-clock race plus the
+    # approximate builder's graph-edge recall against the exact graph.
+    # The winner is recorded in the autotune bucket build_knn_graph's
+    # algo="auto" consults, so the race steers later builds of this
+    # shape class the way the search-engine races steer dispatch.
+    # RAFT_TPU_BENCH_GRAPH_LANE=1 forces / =0 skips.
+    with algo_section('graph_build'):
+        remaining = budget_s - (time.perf_counter() - t_start)
+        from raft_tpu.core.errors import expects as _expects
+        force_gl = os.environ.get("RAFT_TPU_BENCH_GRAPH_LANE")
+        _expects(force_gl != "0" and n >= 100_000,
+                 "graph lane skip: forced=%s n=%d", force_gl, n)
+        _expects(force_gl == "1" or (not hurry and remaining > 400),
+                 "graph lane skip: %.0fs left < 400s", remaining)
+        gn, gk = 100_000, 96
+        gdata = np.asarray(data[:gn])
+        t0 = time.perf_counter()
+        g_exact = cagra.build_knn_graph(gdata, gk, algo="brute")
+        brute_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        g_nnd = cagra.build_knn_graph(gdata, gk, algo="nn_descent")
+        nnd_s = time.perf_counter() - t0
+        # graph-edge recall vs exact, chunked on device (equal chunks,
+        # every slot valid -> the mean of chunk recalls is exact)
+        ge, gj = jnp.asarray(g_exact), jnp.asarray(g_nnd)
+        step = gn // 10
+        g_rec = float(np.mean([device_recall(gj[c:c + step],
+                                             ge[c:c + step])
+                               for c in range(0, gn, step)]))
+        # the verdict steers later algo="auto" builds of this shape
+        # class, so speed alone must not crown a degraded graph: the
+        # approximate builder only wins with edge recall at the bar the
+        # PR's quality gate is built on (optimize() + the exact re-rank
+        # absorb ~0.9; below it, downstream search recall drifts)
+        winner = ("nn_descent" if nnd_s < brute_s and g_rec >= 0.9
+                  else "brute")
+        from raft_tpu.distance.distance_types import DistanceType as _DT
+        _autotune.record(cagra._graph_algo_key(gn, d, gk,
+                                               _DT.L2Expanded), winner)
+        log(f"# graph build race: brute(fused) {brute_s:.0f}s vs "
+            f"nn_descent {nnd_s:.0f}s (edge recall {g_rec:.4f}) "
+            f"-> {winner}")
+        add_entry("cagra_build", f"cagra_build.race100k.k{gk}",
+                  min(brute_s, nnd_s), None, g_rec,
+                  min(brute_s, nnd_s),
+                  {"corpus_n": gn, "graph_k": gk,
+                   "brute_fused_s": round(brute_s, 1),
+                   "nn_descent_s": round(nnd_s, 1), "winner": winner,
+                   "recall_note": "graph-edge recall of nn_descent vs "
+                                  "the exact graph"},
+                  batch=gn, baseline_key=None)
+        del gdata, g_exact, g_nnd, ge, gj
 
     # --- ivf_pq capacity (config 3's structural win: 2M rows) -----------
     # PQ's reason to exist is corpora where raw f32 pressures memory

@@ -176,6 +176,15 @@ _PALLAS_METRICS = {
 }
 
 
+def fused_capable(metric) -> bool:
+    """Whether the streaming fused kernel can serve ``metric`` — the
+    public predicate callers (e.g. the CAGRA graph build's engine
+    choice) consult instead of reading ``_PALLAS_METRICS``."""
+    from ..distance.distance_types import canonical_metric
+
+    return canonical_metric(metric) in _PALLAS_METRICS
+
+
 def _penalty_row(index: Index, filter, valid_rows):
     """(n,) additive min-space penalty: +inf on excluded rows, else 0."""
     if filter is None and valid_rows is None:

@@ -12,6 +12,14 @@ the graph is host data between rounds anyway). Candidates are scored with
 one gather+einsum and merged into the (n, k) lists by ``select_k``;
 convergence = fraction of list entries that changed in a round
 (termination_threshold, nn_descent_types.hpp:53).
+
+NOTE: this is the original reference-shaped port, kept for its direct
+API and parity tests. ``cagra.build`` (``BuildAlgo.NN_DESCENT``) and
+``cagra.build_knn_graph(algo="nn_descent")`` route through the
+device-resident batched rewrite in ``raft_tpu/ops/nn_descent.py``
+instead — same algorithm family, but state never round-trips the host
+between rounds and every round shape is a cached executable (see
+docs/perf.md "Index build").
 """
 from __future__ import annotations
 

@@ -11,3 +11,4 @@ the MXU and maintain a running k-best in VMEM instead.
 from .fused_knn import fused_knn  # noqa: F401
 from .graph_expand import graph_expand  # noqa: F401
 from .guarded import guarded_call  # noqa: F401
+from .nn_descent import build_graph as nn_descent_graph  # noqa: F401

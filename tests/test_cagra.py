@@ -154,10 +154,13 @@ class TestCagra:
 
     def test_knn_graph_ivf_pq_path(self, dataset):
         """The reference's ivf_pq+refine path stays available above the
-        brute cutover (forced here via algo=)."""
-        g = cagra.build_knn_graph(dataset[:2000], 8, algo="ivf_pq")
-        assert g.shape == (2000, 8)
-        assert (g != np.arange(2000)[:, None]).all()
+        brute cutover (forced here via algo=). 1200 rows: the path cost
+        is compile-dominated, so the corpus only needs to clear the
+        n_lists floor — the r8 graph-build suite added ~14s of tier-1
+        and this rung gave ~5s of it back."""
+        g = cagra.build_knn_graph(dataset[:1200], 8, algo="ivf_pq")
+        assert g.shape == (1200, 8)
+        assert (g != np.arange(1200)[:, None]).all()
 
     def test_candidate_dtype_int8(self, built_index, dataset, queries):
         _, idx = cagra.search(built_index, queries, k=10,

@@ -1,0 +1,225 @@
+"""CAGRA graph-build fast paths (build_knn_graph rework).
+
+Covers the two TPU-native builders: the fused all-pairs route must be
+BIT-IDENTICAL to the matmul reference engine (the fused kernel retires
+ties in lax.top_k order, so the whole graph matches — order included),
+and batched NN-descent (ops/nn_descent.py) must reach ≥0.9 graph-edge
+recall deterministically, fall back to the exact path under the
+``cagra.nn_descent`` guard, and be invariant to its batch partition
+(round-delayed updates: every batch reads the previous round's state).
+
+Budget note: the fused tests pin one corpus-wide tile (one interpret
+grid step) and share one (1000, 24, k=19) geometry with the guarded /
+fallback tests so interpret-mode executables are cache hits.
+"""
+import numpy as np
+import pytest
+
+from ann_utils import calc_recall, naive_knn
+from raft_tpu.core import faults
+from raft_tpu.neighbors import cagra
+from raft_tpu.ops import nn_descent as nnd
+
+
+def clustered(n, d, seed=0, intrinsic=8, clusters=50):
+    """Low-intrinsic-dimension clustered mixture — the bench corpus
+    shape. NN-descent's convergence (like IVF recall) is measured on
+    the workload's structure, not on distance-concentrated uniform
+    noise."""
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal((intrinsic, d)).astype(np.float32)
+    w /= np.linalg.norm(w, axis=1, keepdims=True)
+    cz = rng.standard_normal((clusters, intrinsic)).astype(np.float32)
+    z = (cz[rng.integers(0, clusters, n)]
+         + rng.standard_normal((n, intrinsic)).astype(np.float32))
+    return (z @ w + 0.1 * rng.standard_normal((n, d)).astype(np.float32)
+            ).astype(np.float32)
+
+
+def exact_graph_oracle(x, k, chunk=2000):
+    """Exact (n, k) self-excluded kNN graph via the NumPy oracle,
+    query-chunked so the (chunk, n) distance block bounds host memory."""
+    out = []
+    for c0 in range(0, len(x), chunk):
+        _, ids = naive_knn(x, x[c0:c0 + chunk], k + 1)
+        rows = np.arange(c0, min(c0 + chunk, len(x)))[:, None]
+        order = np.argsort(~(ids != rows), axis=1, kind="stable")[:, :k]
+        out.append(np.take_along_axis(ids, order, axis=1))
+    return np.concatenate(out)
+
+
+@pytest.fixture(scope="module")
+def small():
+    rng = np.random.default_rng(11)
+    return rng.standard_normal((1000, 24)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def small_matmul_graph(small):
+    return cagra.build_knn_graph(small, 19, algo="brute", engine="matmul")
+
+
+class TestFusedGraph:
+    def test_fused_bit_identical_to_matmul(self, small, small_matmul_graph,
+                                           monkeypatch):
+        # one corpus-wide tile keeps the interpret grid at one step
+        monkeypatch.setenv("RAFT_TPU_FUSED_TILES", "1024,1024")
+        g_f = cagra.build_knn_graph(small, 19, algo="brute",
+                                    engine="fused")
+        np.testing.assert_array_equal(g_f, small_matmul_graph)
+
+    def test_fused_guarded_falls_back_bit_identical(self, small,
+                                                    small_matmul_graph,
+                                                    monkeypatch):
+        """Kernel failure mid-sweep: the brute_force.fused guard serves
+        the GEMM engine — same graph — without demoting the site
+        (injected faults simulate per-call failure)."""
+        monkeypatch.setenv("RAFT_TPU_FUSED_TILES", "1024,1024")
+        with faults.inject("kernel_compile", "brute_force.fused"):
+            g_f = cagra.build_knn_graph(small, 19, algo="brute",
+                                        engine="fused")
+        np.testing.assert_array_equal(g_f, small_matmul_graph)
+        from raft_tpu.ops.guarded import demoted_sites
+
+        assert "brute_force.fused" not in demoted_sites()
+
+    def test_fused_parted_bit_identical_to_matmul(self, small,
+                                                  monkeypatch):
+        """The parted sweep shares the engine choice: per-part fused
+        searches (eager prepare_fused BEFORE the jit trace, valid_rows
+        masking the tail pad — part 1 here is 488/512 valid) must merge
+        to the same graph as the matmul parted path, bit for bit."""
+        monkeypatch.setenv("RAFT_TPU_FUSED_TILES", "1024,1024")
+        monkeypatch.setenv("RAFT_TPU_CAGRA_BRUTE_PART_N", "600")
+        g_m = cagra.build_knn_graph(small, 19, algo="brute",
+                                    engine="matmul")
+        g_f = cagra.build_knn_graph(small, 19, algo="brute",
+                                    engine="fused")
+        np.testing.assert_array_equal(g_f, g_m)
+
+    def test_progress_hook(self, small):
+        calls = []
+        cagra.build_knn_graph(
+            small, 19, algo="brute", engine="matmul", batch=256,
+            progress=lambda done, total, s: calls.append((done, total)))
+        assert calls == [(256, 1000), (512, 1000), (768, 1000),
+                         (1000, 1000)]
+
+
+class TestNnDescentGraph:
+    def test_recall_and_determinism(self):
+        x = clustered(1000, 32, seed=5)
+        k = 16
+        g1 = nnd.build_graph(x, k, rounds=5, seed=3)
+        want = exact_graph_oracle(x, k)
+        r = calc_recall(g1, want)
+        assert r >= 0.9, f"nn_descent graph recall {r}"
+        assert (g1 != np.arange(len(x))[:, None]).all()   # no self edges
+        assert g1.min() >= 0 and g1.max() < len(x)        # all slots valid
+        # jax PRNG + stable sorts: bit-identical per seed across runs
+        g2 = nnd.build_graph(x, k, rounds=5, seed=3)
+        np.testing.assert_array_equal(g1, g2)
+
+    @pytest.mark.slow
+    def test_batch_invariance(self):
+        """Round-delayed updates make the result independent of the
+        batch partition (batch=1024 on 1600 rows exercises the
+        wrapped-tail multi-batch path AND its update-rate row masking).
+        Slow lane: the second batch shape recompiles the whole round
+        program — ~3s of pure compile the tier-1 wall can't spare."""
+        x = clustered(1600, 32, seed=5)
+        g1 = nnd.build_graph(x, 16, rounds=8, seed=3)
+        g2 = nnd.build_graph(x, 16, rounds=8, seed=3, batch=1024)
+        np.testing.assert_array_equal(g1, g2)
+
+    def test_init_graph_warm_start(self):
+        """Seeding from candidate lists (the IVF-PQ pass contract): an
+        exact init must survive a descent round ~intact (entries are
+        only displaced by strictly better candidates, modulo ties).
+        Same (n, d, k, batch) geometry as the determinism test — the
+        round executables are cache hits."""
+        x = clustered(1000, 32, seed=9)
+        want = exact_graph_oracle(x, 16)
+        g = cagra.build_knn_graph(x, 16, algo="nn_descent", nnd_rounds=1,
+                                  init_graph=want)
+        assert calc_recall(g, want) >= 0.99
+
+    def test_guarded_fallback_parity(self, small, small_matmul_graph):
+        """Builder failure → the exact path (bit-identical to a direct
+        brute build at this size), no demotion from an injected fault."""
+        with faults.inject("kernel_compile", "cagra.nn_descent"):
+            got = cagra.build_knn_graph(small, 19, algo="nn_descent")
+        np.testing.assert_array_equal(got, small_matmul_graph)
+        from raft_tpu.ops.guarded import demoted_sites
+
+        assert "cagra.nn_descent" not in demoted_sites()
+
+    @pytest.mark.slow
+    def test_recall_at_20k(self):
+        """The issue's quality bar at the builder's real operating
+        regime: ≥0.9 graph-edge recall at 20k rows on the bench corpus
+        shape (determinism is asserted at 1k above — the mechanism is
+        scale-invariant)."""
+        x = clustered(20_000, 64, seed=7, intrinsic=16, clusters=200)
+        k = 32
+        g = cagra.build_knn_graph(x, k, algo="nn_descent")
+        r = calc_recall(g, exact_graph_oracle(x, k))
+        assert r >= 0.9, f"nn_descent 20k graph recall {r}"
+
+
+class TestAutoPolicy:
+    def test_threshold_and_race_verdict(self, monkeypatch):
+        from raft_tpu.distance.distance_types import DistanceType
+
+        l2 = DistanceType.L2Expanded
+        monkeypatch.setenv("RAFT_TPU_CAGRA_BRUTE_N", "500")
+        assert cagra._resolve_graph_algo(400, 32, 16, "auto", l2) == "brute"
+        assert cagra._resolve_graph_algo(600, 32, 16, "auto", l2) == \
+            "nn_descent"
+        assert cagra._resolve_graph_algo(600, 32, 16, "ivf_pq", l2) == \
+            "ivf_pq"
+        # a recorded race verdict (the bench graph lane writes these)
+        # overrides the threshold for its shape bucket — but only for
+        # its OWN metric tag
+        from raft_tpu.ops import autotune
+
+        key = cagra._graph_algo_key(600, 32, 16, l2)
+        autotune.record(key, "ivf_pq", persist=False)
+        try:
+            assert cagra._resolve_graph_algo(600, 32, 16, "auto", l2) == \
+                "ivf_pq"
+            ip = DistanceType.InnerProduct
+            assert cagra._resolve_graph_algo(600, 32, 16, "auto", ip) == \
+                "nn_descent"
+        finally:
+            autotune.forget(key)
+
+    def test_unsupported_metric_routes_around_nn_descent(self, small,
+                                                         monkeypatch):
+        """A descent-incapable metric must never reach the guarded
+        builder: auto resolves to ivf_pq above the brute threshold, and
+        an explicit ask raises BEFORE guarded_call — neither may persist
+        a cagra.nn_descent demotion."""
+        from raft_tpu.core.errors import RaftError
+        from raft_tpu.distance.distance_types import DistanceType
+        from raft_tpu.ops import nn_descent as nnd_mod
+        from raft_tpu.ops.guarded import demoted_sites
+
+        cos = DistanceType.CosineExpanded
+        assert not nnd_mod.supports(cos)
+        monkeypatch.setenv("RAFT_TPU_CAGRA_BRUTE_N", "500")
+        assert cagra._resolve_graph_algo(600, 32, 16, "auto", cos) == \
+            "ivf_pq"
+        with pytest.raises(RaftError, match="nn_descent supports"):
+            cagra.build_knn_graph(small, 19, metric=cos,
+                                  algo="nn_descent")
+        assert "cagra.nn_descent" not in demoted_sites()
+
+    def test_build_stats_attached(self):
+        x = clustered(500, 16, seed=2)
+        idx = cagra.build(x, cagra.IndexParams(
+            intermediate_graph_degree=16, graph_degree=8, seed=0))
+        st = idx.build_stats
+        assert st["knn_algo"] == "brute" and st["n"] == 500
+        assert all(st[key] >= 0.0 for key in
+                   ("knn_graph_s", "optimize_s", "seeds_s"))
