@@ -698,6 +698,87 @@ def main():
                                  f".nprobe{best_probes}.bf16")
             del fihs
 
+    # --- serving_latency: p50/p99 per-request latency at fixed recall ---
+    # The ROADMAP "kill the dispatch floor" success metric: requests
+    # served through the serve/ runtime (admission -> coalesce -> bucket
+    # pad -> dispatch -> demux) with stage telemetry sampling EVERY
+    # batch, so the entry decomposes per-request latency into the five
+    # stages the dispatch-floor attack must move (queue_wait /
+    # bucket_pad / dispatch / device / demux, straight from the
+    # <name>.stage.* histograms). Recall is fixed by construction: the
+    # serving closure is the ivf_flat sweep's best qualifying probe
+    # config over the same index parts, so the lane reports that entry's
+    # measured recall. Closed-loop at bounded in-flight depth — an
+    # open-loop flood would only measure queue saturation.
+    with algo_section('serving_latency'):
+        from raft_tpu.serve import metrics as serve_metrics
+        from raft_tpu.serve.batcher import BucketLadder, MicroBatcher
+
+        remaining = budget_s - (time.perf_counter() - t_start)
+        from raft_tpu.core.errors import expects as _expects
+        _expects(remaining > 240, "serving lane skip: %.0fs left < 240s",
+                 remaining)
+        sp_serve = ivf_flat.SearchParams(n_probes=best_probes)
+        flat_name = f"raft_ivf_flat.nlist1024.nprobe{best_probes}"
+        flat_entry = next((e for e in entries if e["name"] == flat_name),
+                          None)
+        kb_serve = 16          # one k bucket; requests ask k=10
+        sfn_serve = jax.jit(lambda q, idx, s=sp_serve: ivf_flat.search(
+            idx, q, kb_serve, s))
+        tp_serve = TwoPart(sfn_serve, fis, offsets, kb_serve)
+
+        def serve_search(q, kk, res=None):
+            return tp_serve(jnp.asarray(q))
+
+        reg_serve = serve_metrics.Registry()
+        qhost = np.asarray(queries[:1000])
+        b = MicroBatcher(serve_search, d,
+                         ladder=BucketLadder((16, 64), (kb_serve,)),
+                         registry=reg_serve, name="serve",
+                         trace_sample=1.0, max_wait_s=0.002)
+        try:
+            warm_compiles = b.warmup()
+            rng_s = np.random.default_rng(11)
+            n_req, inflight_cap = 200, 8
+            req_sizes = rng_s.choice(
+                [1, 2, 4, 8, 16, 32], size=n_req,
+                p=[.3, .2, .2, .15, .1, .05])
+            t0 = time.perf_counter()
+            inflight = []
+            for m in req_sizes:
+                s0 = int(rng_s.integers(0, len(qhost) - int(m)))
+                inflight.append(b.submit(qhost[s0:s0 + int(m)], k))
+                if len(inflight) >= inflight_cap:
+                    inflight.pop(0).result(300)
+            for r in inflight:
+                r.result(300)
+            serve_wall = time.perf_counter() - t0
+        finally:
+            b.close()
+        snap = reg_serve.snapshot()
+        lat = snap["histograms"]["serve.latency_s"]
+        stage_hists = {s: snap["histograms"][f"serve.stage.{s}_s"]
+                       for s in ("queue_wait", "bucket_pad", "dispatch",
+                                 "device", "demux")}
+        add_entry(
+            "serving_latency",
+            f"serving_latency.ivf_flat.nprobe{best_probes}",
+            serve_wall, lat["p50"],
+            flat_entry["recall"] if flat_entry else -1.0, 0.0,
+            {"p50_ms": round(lat["p50"] * 1e3, 2),
+             "p99_ms": round(lat["p99"] * 1e3, 2),
+             "stage_p50_ms": {s: round(h["p50"] * 1e3, 3)
+                              for s, h in stage_hists.items()},
+             "stage_p99_ms": {s: round(h["p99"] * 1e3, 3)
+                              for s, h in stage_hists.items()},
+             "requests": n_req, "closed_loop_inflight": inflight_cap,
+             "batches": int(snap["counters"]["serve.batches"]),
+             "warmup_compiles": warm_compiles,
+             "steady_state_recompiles": int(
+                 serve_metrics.counter("serve.recompiles").value),
+             "recall_source": flat_name, "trace_sample": 1.0},
+            batch=n_req, baseline_key=None)
+
     # --- ivf_pq (config 3) + refine -------------------------------------
     # kernel round 4: pq_bits=4 with pq_dim=d (same 512 code bits/row as
     # pq64x8 but an 8x narrower one-hot decode) + int8-quantized LUT (the

@@ -15,7 +15,8 @@ from .kvp import KeyValuePair
 from .resources import DeviceResources, Resources, device_resources_manager
 from .interop import (as_device_array, auto_convert_output, convert_output,
                       output_as, set_output_as)
-from . import faults, logging, operators, raft_format, serialize, tracing
+from . import (events, faults, logging, operators, raft_format, serialize,
+               tracing)
 
 __all__ = [
     "Bitset",
@@ -29,6 +30,7 @@ __all__ = [
     "fail",
     "InterruptedException",
     "synchronize",
+    "events",
     "faults",
     "KeyValuePair",
     "DeviceResources",

@@ -1,0 +1,118 @@
+"""Flight recorder: a bounded ring of structured operational events.
+
+The resilience machinery (guarded kernel demotions, fault injections,
+deadline sheds, dead shards, autotune race verdicts, post-warmup XLA
+recompiles) degrades *gracefully* — which means silently, unless the
+degradations are recorded somewhere an operator can replay. This module
+is that somewhere: a process-local, dependency-free ring of structured
+events, each stamped with the trace IDs active when it fired
+(:func:`raft_tpu.core.tracing.bind_trace` — the serving batcher binds
+the requests it is dispatching), so "which requests got slow, and why"
+has an answer after the fact.
+
+Design constraints (mirrors :mod:`raft_tpu.serve.metrics`):
+
+* **bounded**: a deque ring (default 512 events) — recording never
+  grows without bound no matter how noisy a degradation storm is;
+* **cheap and dependency-free**: plain dicts under one lock, no jax
+  import at module load — recordable from any layer without cycles;
+* **exportable**: :func:`to_jsonl` / :func:`export_jsonl` dump the ring
+  as JSON-lines for offline triage; :mod:`raft_tpu.serve.debugz` folds
+  the tail into its ops snapshot.
+
+Event shape: ``{"seq", "ts", "kind", "site", "trace_id", ...details}``.
+``trace_id`` is a string when exactly one trace was bound, a list when
+a multi-request batch was in flight, None outside any binding.
+
+Well-known kinds (open set — emitters define meaning):
+``guarded_demotion``, ``fault_injected``, ``deadline_shed``,
+``deadline_exceeded``, ``dispatch_error``, ``shard_marked``,
+``autotune_verdict``, ``xla_compile``, ``corrupt_index``.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["record", "recent", "counts", "to_jsonl", "export_jsonl",
+           "set_capacity", "clear", "DEFAULT_CAPACITY"]
+
+DEFAULT_CAPACITY = 512
+
+_lock = threading.Lock()
+_ring: collections.deque = collections.deque(maxlen=DEFAULT_CAPACITY)
+_seq = 0
+
+
+def record(kind: str, site: str, trace_id=None, **details) -> dict:
+    """Append one event. ``trace_id=None`` stamps the trace IDs bound on
+    this thread (see module docstring); pass an explicit ID when the
+    originating request is known (e.g. a shed, which happens outside the
+    dispatch binding)."""
+    global _seq
+    if trace_id is None:
+        from . import tracing
+
+        ids = tracing.current_traces()
+        trace_id = ids[0] if len(ids) == 1 else (list(ids) if ids else None)
+    e = {"ts": time.time(), "kind": kind, "site": site, "trace_id": trace_id}
+    if details:
+        e.update(details)
+    with _lock:
+        _seq += 1
+        e["seq"] = _seq
+        _ring.append(e)
+    return e
+
+
+def recent(n: Optional[int] = None, kind: Optional[str] = None) -> List[dict]:
+    """Most recent events, oldest first; ``kind`` filters. ``n=None``
+    returns everything in the ring, ``n=0`` returns nothing."""
+    with _lock:
+        items = list(_ring)
+    if kind is not None:
+        items = [e for e in items if e["kind"] == kind]
+    if n is None:
+        return items
+    return items[-n:] if n > 0 else []
+
+
+def counts() -> dict:
+    """Events per kind currently in the ring (NOT lifetime totals — the
+    ring is bounded; lifetime counts live in the metrics registry)."""
+    out: dict = {}
+    with _lock:
+        for e in _ring:
+            out[e["kind"]] = out.get(e["kind"], 0) + 1
+    return out
+
+
+def to_jsonl(n: Optional[int] = None, kind: Optional[str] = None) -> str:
+    """The ring (tail ``n``, optionally filtered) as JSON-lines."""
+    items = recent(n, kind)
+    return "\n".join(json.dumps(e, sort_keys=True) for e in items) \
+        + ("\n" if items else "")
+
+
+def export_jsonl(path: str, n: Optional[int] = None) -> int:
+    """Write the ring to ``path`` as JSONL; returns the event count."""
+    items = recent(n)
+    with open(path, "w") as f:
+        for e in items:
+            f.write(json.dumps(e, sort_keys=True) + "\n")
+    return len(items)
+
+
+def set_capacity(n: int) -> None:
+    """Resize the ring (keeps the newest events)."""
+    global _ring
+    with _lock:
+        _ring = collections.deque(_ring, maxlen=int(n))
+
+
+def clear() -> None:
+    with _lock:
+        _ring.clear()

@@ -144,13 +144,39 @@ def fired(kind: str, site: str) -> Optional[Fault]:
     _load_env()
     if not _injected and not _env_faults:
         return None
+    hit = None
+    first = False
     with _lock:
         _seen_sites.add(site)
         for f in _injected + _env_faults:
             if f.matches(kind, site):
                 f.fires += 1
-                return f
-    return None
+                first = f.fires == 1
+                hit = f
+                break
+    if hit is not None:
+        _emit_fire(kind, site, first)
+    return hit
+
+
+def _emit_fire(kind: str, site: str, first: bool) -> None:
+    """Telemetry for a fired fault: a site-labeled counter on EVERY fire,
+    but a flight-recorder event (stamped with the active trace IDs) only
+    on the fault's FIRST — a per-batch drill firing 50x/s must not churn
+    the bounded ring out of the demotion/shed events that reconstruct
+    its blast radius; the counter carries the magnitude. Outside the
+    probe lock; never raises (telemetry must not change fault
+    semantics)."""
+    try:
+        if first:
+            from . import events as _events
+
+            _events.record("fault_injected", site, fault_kind=kind)
+        from ..serve import metrics as _metrics
+
+        _metrics.counter(f"faults.fired.{kind}.{site}").inc()
+    except Exception:  # noqa: BLE001 - telemetry must not break injection
+        pass
 
 
 def check(kind: str, site: str) -> None:

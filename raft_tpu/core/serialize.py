@@ -416,7 +416,22 @@ def load_arrays(path_or_file, expect_kind: str | None = None):
                 arrays[name] = deserialize_array(f)
         return kind, version, meta, arrays
 
-    if isinstance(path_or_file, (str, bytes, os.PathLike)):
-        with open(path_or_file, "rb") as f:
-            return _read(f)
-    return _read(path_or_file)
+    try:
+        if isinstance(path_or_file, (str, bytes, os.PathLike)):
+            with open(path_or_file, "rb") as f:
+                return _read(f)
+        return _read(path_or_file)
+    except CorruptIndexError as e:
+        # a corrupt load is an operational event, not just an exception:
+        # the caller may contain it (mark_shard_failed, retry a replica)
+        # and the ops surface must still show it happened
+        try:
+            from . import events as _events
+
+            _events.record("corrupt_index", e.section, error=str(e))
+            from ..serve import metrics as _metrics
+
+            _metrics.counter("serialize.corrupt_load").inc()
+        except Exception:  # noqa: BLE001 - telemetry must not mask the error
+            pass
+        raise

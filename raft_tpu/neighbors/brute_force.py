@@ -681,6 +681,7 @@ def search(
 
 
 @interop.auto_convert_output
+@tracing.annotate("raft_tpu::brute_force::knn")
 def knn(dataset, queries, k, metric="sqeuclidean", metric_arg: float = 2.0,
         tile_size: int = 8192):
     """One-shot build+search (the reference's free-function ``knn``)."""

@@ -30,9 +30,9 @@ import jax
 
 from ..core import logging as rlog
 
-__all__ = ["shape_bucket", "lookup", "record", "forget", "measure",
-           "measure_throughput", "measure_value_read_wall", "tune_best",
-           "cache_path", "load_cache", "save_cache",
+__all__ = ["shape_bucket", "lookup", "record", "forget", "entries",
+           "measure", "measure_throughput", "measure_value_read_wall",
+           "tune_best", "cache_path", "load_cache", "save_cache",
            "TimingUnreliableError"]
 
 
@@ -136,6 +136,24 @@ def record(key: str, choice: str, persist: bool = True) -> None:
         save_cache()
     else:
         _EPHEMERAL.add(key)
+    if ":guard:" not in key:
+        # flight recorder: race verdicts steer future dispatch, so they
+        # are operational events (guard demotions already record their
+        # own richer guarded_demotion event — skip the double entry)
+        try:
+            from ..core import events as _events
+
+            _events.record("autotune_verdict", key, choice=choice,
+                           persist=persist)
+        except Exception:  # noqa: BLE001 - telemetry must not break tuning
+            pass
+
+
+def entries() -> Dict[str, str]:
+    """Point-in-time copy of every cached verdict (engine race winners
+    AND guard demotions) — the debugz verdict table."""
+    load_cache()
+    return dict(_MEM_CACHE)
 
 
 def forget(key: str) -> None:

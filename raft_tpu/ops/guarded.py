@@ -70,6 +70,12 @@ def _demote(site: str, err: Exception, persist: bool) -> None:
             from ..serve import metrics as serve_metrics
 
             serve_metrics.counter("guarded.demotions").inc()
+            # flight recorder: stamped with the trace IDs of whatever
+            # requests were in flight when the kernel path died
+            from ..core import events as core_events
+
+            core_events.record("guarded_demotion", site,
+                               error=f"{type(err).__name__}: {err}")
         except Exception:  # noqa: BLE001 - telemetry must not break containment
             pass
     autotune.record(

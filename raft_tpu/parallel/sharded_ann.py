@@ -53,13 +53,44 @@ def _shard_health(index, family: str) -> np.ndarray:
     return ok
 
 
-def _health_gate(ok: np.ndarray, allow_partial: bool) -> None:
+def _health_gate(ok: np.ndarray, allow_partial: bool,
+                 family: str = "") -> None:
     """Dead shards without ``allow_partial=True`` are an error, not a
     silently-degraded answer — and ZERO surviving shards is total
     failure, not a degraded answer: an all-(+inf, -1) result piped
-    downstream would silently wrap-index with -1."""
-    if not ok.all() and (not allow_partial or not ok.any()):
-        raise ShardsDownError(ok)
+    downstream would silently wrap-index with -1.
+
+    A tolerated degraded merge (``allow_partial=True`` with dead shards)
+    is counted under ``sharded.degraded_searches.<family>`` — the signal
+    previously surfaced only through the serve batcher's per-response
+    bookkeeping, invisible to direct callers."""
+    if not ok.all():
+        if not allow_partial or not ok.any():
+            raise ShardsDownError(ok)
+        try:
+            from ..serve import metrics as _metrics
+
+            _metrics.counter(f"sharded.degraded_searches.{family}").inc()
+        except Exception:  # noqa: BLE001 - telemetry must not fail a search
+            pass
+
+
+def _mark_shard(shards_ok: np.ndarray, family: str, i: int, ok: bool) -> None:
+    """Set the sticky health flag; flight-record only an actual state
+    TRANSITION — a health-check loop re-asserting the same state every
+    second must not fill the bounded ring (per-search degradation is the
+    counter above)."""
+    changed = bool(shards_ok[i]) != bool(ok)
+    shards_ok[i] = ok
+    if not changed:
+        return
+    try:
+        from ..core import events as _events
+
+        _events.record("shard_marked", f"sharded_ann.{family}.shard{i}",
+                       ok=bool(ok))
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def _shard_mask(mesh, ok: np.ndarray) -> jax.Array:
@@ -122,7 +153,7 @@ class ShardedIvfFlat:
     def mark_shard_failed(self, i: int, ok: bool = False) -> None:
         """Flag shard ``i`` unhealthy: its results are masked out of every
         merge until re-marked ok (search then needs allow_partial=True)."""
-        self.shards_ok[i] = ok
+        _mark_shard(self.shards_ok, "ivf_flat", i, ok)
 
     @property
     def n_shards(self) -> int:
@@ -201,7 +232,7 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
     select_min = is_min_close(mt)
     comms = _comms_of(index.mesh, res)
     ok = _shard_health(index, "ivf_flat")
-    _health_gate(ok, allow_partial)
+    _health_gate(ok, allow_partial, "ivf_flat")
 
     has_scales = index.scales is not None
 
@@ -258,7 +289,7 @@ class ShardedCagra:
 
     def mark_shard_failed(self, i: int, ok: bool = False) -> None:
         """Flag shard ``i`` unhealthy (see ShardedIvfFlat.mark_shard_failed)."""
-        self.shards_ok[i] = ok
+        _mark_shard(self.shards_ok, "cagra", i, ok)
 
     @property
     def n_shards(self) -> int:
@@ -339,7 +370,7 @@ def search_cagra(index: ShardedCagra, queries, k: int,
     select_min = mt is not DistanceType.InnerProduct
     comms = _comms_of(index.mesh, res)
     ok = _shard_health(index, "cagra")
-    _health_gate(ok, allow_partial)
+    _health_gate(ok, allow_partial, "cagra")
 
     has_seeds = index.seeds is not None
 
@@ -405,7 +436,7 @@ class ShardedIvfPq:
 
     def mark_shard_failed(self, i: int, ok: bool = False) -> None:
         """Flag shard ``i`` unhealthy (see ShardedIvfFlat.mark_shard_failed)."""
-        self.shards_ok[i] = ok
+        _mark_shard(self.shards_ok, "ivf_pq", i, ok)
 
     @property
     def n_shards(self) -> int:
@@ -470,7 +501,7 @@ def search_ivf_pq(index: ShardedIvfPq, queries, k: int,
     select_min = is_min_close(mt)
     comms = _comms_of(index.mesh, res)
     ok = _shard_health(index, "ivf_pq")
-    _health_gate(ok, allow_partial)
+    _health_gate(ok, allow_partial, "ivf_pq")
     # dummy host offsets: _search_chunk reads offsets/sizes from the traced
     # args, never from the Index (search() does, but we bypass it)
     dummy_off = np.zeros(index.centers_rot.shape[1] + 1, np.int64)

@@ -15,7 +15,9 @@ operational metrics.
                 tracing-span timing (dependency-free)
 - ``admission`` bounded request queue, backpressure, deadline shedding
 - ``batcher``   BucketLadder + MicroBatcher (coalesce/pad/dispatch/demux)
-- ``warmup``    ladder pre-compile + XLA compilation-count instrumentation
+- ``warmup``    ladder pre-compile + recompile watch + compile counting
+- ``debugz``    exportable ops snapshot/text surface + background writer
+                (docs/observability.md)
 
 Submodules import lazily, so telemetry-only consumers (ops/guarded
 demotion events, core/tracing span timing) pull in none of the
@@ -26,7 +28,7 @@ from __future__ import annotations
 import importlib
 from typing import Any
 
-_SUBMODULES = ("admission", "batcher", "metrics", "warmup")
+_SUBMODULES = ("admission", "batcher", "debugz", "metrics", "warmup")
 _EXPORTS = {
     "MicroBatcher": "batcher",
     "BucketLadder": "batcher",
@@ -35,6 +37,7 @@ _EXPORTS = {
     "SearchResult": "admission",
     "QueueFullError": "admission",
     "count_compilations": "warmup",
+    "SnapshotWriter": "debugz",
 }
 
 __all__ = list(_SUBMODULES) + list(_EXPORTS)

@@ -29,6 +29,7 @@ import time
 from collections import deque
 from typing import Callable, List, NamedTuple, Optional
 
+from ..core import events, tracing
 from ..core.deadline import Deadline, DeadlineExceeded
 from ..core.errors import RaftError
 
@@ -56,18 +57,24 @@ class Request:
     ``queries`` is a host (m, d) float32 block; ``k`` the requested
     neighbor count; ``deadline`` an optional
     :class:`~raft_tpu.core.deadline.Deadline` enforced at admission pop,
-    pre-dispatch and between search chunks.
+    pre-dispatch and between search chunks. Every request carries a
+    ``trace_id`` (generated when not supplied) that stage decompositions
+    and flight-recorder events are stamped with; ``dequeued_at`` is
+    stamped by the batcher worker when stage telemetry is enabled
+    (queue-wait measurement).
     """
 
-    __slots__ = ("queries", "k", "deadline", "enqueued_at", "_event",
-                 "_result", "_error")
+    __slots__ = ("queries", "k", "deadline", "enqueued_at", "trace_id",
+                 "dequeued_at", "_event", "_result", "_error")
 
     def __init__(self, queries, k: int, deadline: Optional[Deadline] = None,
-                 enqueued_at: float = 0.0):
+                 enqueued_at: float = 0.0, trace_id: Optional[str] = None):
         self.queries = queries
         self.k = int(k)
         self.deadline = deadline
         self.enqueued_at = enqueued_at
+        self.trace_id = trace_id or tracing.new_trace_id()
+        self.dequeued_at = 0.0
         self._event = threading.Event()
         self._result: Optional[SearchResult] = None
         self._error: Optional[BaseException] = None
@@ -115,6 +122,7 @@ class AdmissionQueue:
 
         reg = registry or _metrics.default_registry
         self.max_depth = int(max_depth)
+        self._prefix = prefix
         self._clock = clock
         self._items: deque = deque()
         self._lock = threading.Lock()
@@ -149,9 +157,17 @@ class AdmissionQueue:
 
     def shed(self, req: Request) -> None:
         """Complete ``req`` exceptionally as shed (deadline spent before
-        its dispatch) and count it."""
+        its dispatch) and count it. The shed lands in the flight recorder
+        stamped with the request's trace ID — a shed request produced no
+        work, so the recorder is its only footprint."""
         self._shed_n.inc()
         spent = req.deadline.seconds if req.deadline is not None else 0.0
+        try:
+            events.record("deadline_shed", f"{self._prefix}.shed",
+                          trace_id=req.trace_id, budget_s=spent,
+                          rows=req.rows, k=req.k)
+        except Exception:  # noqa: BLE001 - telemetry must not strand
+            pass           # the future
         req.set_exception(DeadlineExceeded(
             f"raft_tpu serve: request shed (deadline of {spent:.4g}s "
             "spent before dispatch); partial results empty", partial=None))

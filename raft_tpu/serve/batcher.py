@@ -41,21 +41,42 @@ A popped batch splits per k bucket before dispatch (one k per
 executable), so heavily mixed-k traffic trades fill ratio for
 k-padding — watch ``<name>.batch_fill`` and give hot k values their own
 bucket rather than widening an existing one.
+
+**Request-lifecycle telemetry** (docs/observability.md): every request
+carries a trace ID, and with ``trace_sample > 0`` (ctor arg or the
+``RAFT_TPU_TRACE_SAMPLE`` env knob) sampled batches record a five-stage
+latency decomposition per request — ``queue_wait`` (submit → worker
+pop), ``bucket_pad`` (coalesce + zero-pad), ``dispatch`` (host-side
+search-call wall), ``device`` (a ``block_until_ready`` probe — measured
+only on sampled batches, so steady-state dispatch stays asynchronous),
+``demux`` (device→host transfer + per-request slicing) — into
+``<name>.stage.*_s`` histograms and the sampled span log
+(:func:`raft_tpu.core.tracing.recent_spans`). The worker binds the
+batch's trace IDs around dispatch, so demotions/faults/recompiles
+firing mid-batch land in the flight recorder stamped with the requests
+they hit. With sampling off the hot path pays one falsy check per
+probe site.
 """
 from __future__ import annotations
 
+import math
 import threading
 import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
 
-from ..core import faults, logging as rlog
+from ..core import events, faults, logging as rlog, tracing
 from ..core.deadline import Deadline, DeadlineExceeded
 from ..core.errors import expects
+from . import warmup as _warmup
 from .admission import AdmissionQueue, Request, SearchResult
 
 __all__ = ["BucketLadder", "MicroBatcher"]
+
+# the five per-request stages (docs/observability.md)
+STAGES = ("queue_wait", "bucket_pad", "dispatch", "device", "demux")
 
 
 class BucketLadder:
@@ -108,7 +129,10 @@ class MicroBatcher:
     3-tuple ending in ``shards_ok`` for degraded sharded searchers) must
     accept any ladder shape; ``dim`` is the query width used for padding
     and warmup. ``autostart=False`` lets tests enqueue a deterministic
-    backlog before the worker drains it.
+    backlog before the worker drains it. ``trace_sample`` is the
+    request-telemetry sampling rate (None reads ``RAFT_TPU_TRACE_SAMPLE``,
+    validated; 0 disables stage decomposition entirely — see module
+    docstring).
     """
 
     def __init__(self, search_fn: Callable, dim: int, *,
@@ -119,6 +143,7 @@ class MicroBatcher:
                  registry=None,
                  name: str = "serve",
                  autostart: bool = True,
+                 trace_sample: Optional[float] = None,
                  clock: Callable[[], float] = time.monotonic):
         from . import metrics as _metrics
 
@@ -130,6 +155,23 @@ class MicroBatcher:
         self._name = name
         self._clock = clock
         self._reg = registry or _metrics.default_registry
+        rate = tracing.sample_rate(trace_sample)
+        # stage telemetry: None = off (the hot path checks exactly this);
+        # every ceil(1/rate)-th batch gets the full five-stage story
+        self._probe_every = math.ceil(1.0 / rate) if rate > 0 else 0
+        self._probe_tick = 0
+        self._stages = None
+        if self._probe_every:
+            self._stages = {s: self._reg.histogram(f"{name}.stage.{s}_s")
+                            for s in STAGES}
+        try:
+            # always-on recompile stream: a post-warmup recompile must be
+            # visible in any snapshot (serve.recompiles + xla_compile
+            # events labeled with this batcher's dispatch buckets)
+            _warmup.install_recompile_watch()
+        except RuntimeError as e:
+            rlog.log_warn("serve %s: recompile watch unavailable (%s)",
+                          name, e)
         self.queue = AdmissionQueue(queue_depth, registry=self._reg,
                                     prefix=name, clock=clock)
         r = self._reg
@@ -199,8 +241,6 @@ class MicroBatcher:
         """Pre-compile every ladder shape through the live search path;
         returns the number of XLA compilations that took (0 on a warm
         process). See :func:`raft_tpu.serve.warmup.warmup`."""
-        from . import warmup as _warmup
-
         return _warmup.warmup(self._search, self.ladder, self._dim,
                               registry=self._reg, name=self._name)
 
@@ -216,6 +256,10 @@ class MicroBatcher:
             # operator knob: simulate a stalled worker/device
             # (RAFT_TPU_FAULTS='slow_dispatch@<name>.batch=0.1')
             faults.sleep_if(f"{self._name}.batch")
+            if self._stages is not None:
+                now = self._clock()
+                for r in batch:
+                    r.dequeued_at = now
             groups: dict = {}
             for r in batch:
                 groups.setdefault(self.ladder.bucket_k(r.k), []).append(r)
@@ -228,6 +272,13 @@ class MicroBatcher:
                     rlog.log_warn(
                         "serve %s: batch dispatch failed (%s: %s)",
                         self._name, type(e).__name__, e)
+                    try:
+                        events.record(
+                            "dispatch_error", f"{self._name}.batch",
+                            trace_id=[r.trace_id for r in reqs],
+                            error=f"{type(e).__name__}: {e}")
+                    except Exception:  # noqa: BLE001 - a record failure
+                        pass           # must not strand the futures
                     for r in reqs:
                         if not r.done():
                             r.set_exception(e)
@@ -249,8 +300,15 @@ class MicroBatcher:
                 live.append(r)
         if not live:
             return
+        # stage-telemetry probe decision: one falsy check when disabled;
+        # when enabled, every _probe_every-th group tells the full story
+        probe = False
+        if self._stages is not None:
+            self._probe_tick += 1
+            probe = (self._probe_tick - 1) % self._probe_every == 0
         rows = sum(r.rows for r in live)
         mb = self.ladder.bucket_queries(rows)
+        t_pad = self._clock() if probe else 0.0
         block = np.zeros((mb, self._dim), np.float32)
         offs: List[int] = []
         off = 0
@@ -258,19 +316,34 @@ class MicroBatcher:
             block[off:off + r.rows] = r.queries
             offs.append(off)
             off += r.rows
+        pad_dt = self._clock() - t_pad if probe else 0.0
         t0 = self._clock()
         try:
-            out = self._search(block, kb,
-                               res=self._tightest_deadline(live))
+            # bind the batch's trace IDs + label the compile context:
+            # a demotion, fault or recompile firing inside the search is
+            # stamped with the requests (and shape bucket) it hit
+            with tracing.bind_trace(*(r.trace_id for r in live)), \
+                    _warmup.compile_context(f"{self._name}:{mb}x{kb}"):
+                out = self._search(block, kb,
+                                   res=self._tightest_deadline(live))
         except DeadlineExceeded as e:
             self._deliver_partial(kb, live, offs, e)
             return
         dt = self._clock() - t0
+        device_dt = 0.0
+        if probe:
+            # the off-hot-path device probe: dispatch is asynchronous, so
+            # the search call above returns before the device finishes;
+            # only sampled batches pay this sync (steady state never does)
+            t_dev = self._clock()
+            jax.block_until_ready(out)
+            device_dt = self._clock() - t_dev
         shards_ok = None
         if isinstance(out, tuple) and len(out) == 3:
             d, i, shards_ok = out
         else:
             d, i = out
+        t_dmx = self._clock() if probe else 0.0
         d = np.asarray(d)
         i = np.asarray(i)
         if shards_ok is not None:
@@ -278,11 +351,33 @@ class MicroBatcher:
             self._healthy.set(int(ok.sum()))
             if not ok.all():
                 self._degraded.inc()
+        results = [SearchResult(d[o:o + r.rows, :r.k],
+                                i[o:o + r.rows, :r.k], shards_ok)
+                   for r, o in zip(live, offs)]
+        demux_dt = self._clock() - t_dmx if probe else 0.0
         now = self._clock()
-        for r, o in zip(live, offs):
-            r.set_result(SearchResult(d[o:o + r.rows, :r.k],
-                                      i[o:o + r.rows, :r.k], shards_ok))
+        for r, res_r in zip(live, results):
+            r.set_result(res_r)
             self._latency.observe(now - r.enqueued_at)
+        if probe:
+            # AFTER delivery, and guarded: a failing observer (a
+            # user-supplied registry) must not fail a batch whose
+            # results were already computed, nor delay them behind
+            # 5 histogram writes per co-batched request
+            try:
+                tel = self._stages
+                bucket = f"{mb}x{kb}"
+                for r in live:
+                    stages = {"queue_wait": max(0.0, r.dequeued_at
+                                                - r.enqueued_at),
+                              "bucket_pad": pad_dt, "dispatch": dt,
+                              "device": device_dt, "demux": demux_dt}
+                    for s, v in stages.items():
+                        tel[s].observe(v)
+                    tracing.log_spans(r.trace_id, stages, rows=r.rows,
+                                      k=r.k, bucket=bucket)
+            except Exception:  # noqa: BLE001 - telemetry must not
+                pass           # break serving
         self._served.inc(len(live))
         self._batches.inc()
         self._reg.counter(f"{self._name}.dispatch.{mb}x{kb}").inc()
@@ -323,6 +418,12 @@ class MicroBatcher:
                 own = (pd[o:done, :r.k], pi[o:done, :r.k])
             covered = max(0, done - o)
             self._dlx.inc()
+            try:
+                events.record("deadline_exceeded", f"{self._name}.dispatch",
+                              trace_id=r.trace_id, rows=r.rows,
+                              covered_rows=covered)
+            except Exception:  # noqa: BLE001 - telemetry must not strand
+                pass           # the future
             r.set_exception(DeadlineExceeded(
                 f"raft_tpu serve: deadline exceeded mid-batch; "
                 f"{covered} of {r.rows} query rows completed "

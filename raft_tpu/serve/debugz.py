@@ -1,0 +1,217 @@
+"""The exportable ops surface: one place an operator (or a test, or a
+post-mortem) reads the serving runtime's live state.
+
+``/debugz`` in spirit: :func:`snapshot` assembles a JSON-safe dict of
+everything the telemetry layer knows — the metrics registry, the bucket
+ladder's occupancy (per-bucket dispatch counts + admission queue
+depth), the autotune verdict table, the guarded-demotion table, the
+flight-recorder tail, the sampled span log, and any armed faults —
+and :func:`render_text` renders the same as a human-readable page.
+:class:`SnapshotWriter` persists snapshots on an interval so a crashed
+or wedged process leaves its last state on disk.
+
+Everything here is read-only over layers that are already process-local
+and lock-cheap; a snapshot never blocks the serving hot path.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Optional
+
+from ..core import events, faults, tracing
+
+__all__ = ["snapshot", "render_text", "write_snapshot", "SnapshotWriter"]
+
+
+def _ladder_view(batcher, reg_snap: dict) -> dict:
+    """Bucket-ladder occupancy: dispatch counts per (rows × k) shape plus
+    live queue state (``reg_snap``: the snapshot already computed for the
+    metrics key — one instant, not two, and no double percentile sort)."""
+    prefix = f"{batcher._name}.dispatch."
+    dispatch = {name[len(prefix):]: int(v)
+                for name, v in reg_snap["counters"].items()
+                if name.startswith(prefix)}
+    return {
+        "query_buckets": list(batcher.ladder.query_buckets),
+        "k_buckets": list(batcher.ladder.k_buckets),
+        "dispatches": {f"{mb}x{kb}": dispatch.get(f"{mb}x{kb}", 0)
+                       for mb, kb in batcher.ladder.shapes()},
+        "queue_depth": len(batcher.queue),
+        "queue_max_depth": batcher.queue.max_depth,
+        "queue_closed": batcher.queue.closed,
+    }
+
+
+def _json_safe(obj):
+    """Strict-JSON scrub: non-finite floats (an empty histogram's
+    min/max/percentiles are NaN) become None — a post-mortem snapshot
+    must parse under every strict JSON reader (jq, JSON.parse), not only
+    Python's lenient loads."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    return obj
+
+
+def snapshot(batcher=None, registry=None, events_n: int = 50,
+             spans_n: int = 20) -> dict:
+    """Point-in-time ops snapshot (strict-JSON-safe: no NaN/Inf leaves).
+
+    ``batcher``: include its bucket-ladder occupancy and queue state.
+    ``registry``: metrics source. When None: the batcher's own registry
+    (its dispatch/stage metrics live there, wherever the operator put
+    them), else the default process registry (also home of
+    ``guarded.demotions`` / ``serve.recompiles``).
+    ``events_n`` / ``spans_n``: flight-recorder / span-log tail sizes
+    (0 = omit the tail).
+    """
+    from ..ops import autotune, guarded
+    from . import metrics as _metrics
+
+    if registry is None and batcher is not None:
+        registry = batcher._reg
+    reg = registry or _metrics.default_registry
+    reg_snap = reg.snapshot()
+    out = {
+        "ts": time.time(),
+        "metrics": reg_snap,
+        "autotune": autotune.entries(),
+        "demotions": guarded.demoted_sites(),
+        "events": events.recent(events_n),
+        "event_counts": events.counts(),
+        "spans": tracing.recent_spans(spans_n),
+        "faults_armed": [
+            {"kind": f.kind, "pattern": f.pattern, "count": f.count,
+             "value": f.value, "fires": f.fires} for f in faults.active()],
+    }
+    if batcher is not None:
+        out["ladder"] = _ladder_view(batcher, reg_snap)
+    # scrub the WHOLE snapshot, not just the metrics sub-dict: an armed
+    # fault's value or an event detail can carry inf/NaN too
+    return _json_safe(out)
+
+
+def _fmt_hist(name: str, h: dict) -> str:
+    # unit by naming convention: only *_s histograms are seconds —
+    # ratio histograms (batch_fill, padding_waste) render unitless
+    u = "s" if name.endswith("_s") else ""
+    return (f"  {name}: n={h['count']} p50={h['p50']:.4g}{u} "
+            f"p90={h['p90']:.4g}{u} p99={h['p99']:.4g}{u} max={h['max']:.4g}{u}")
+
+
+def render_text(batcher=None, registry=None, events_n: int = 20,
+                spans_n: int = 5) -> str:
+    """Human-readable rendering of :func:`snapshot` (the text half of the
+    text/JSON ops surface; the Prometheus export stays
+    ``metrics.render_text``)."""
+    s = snapshot(batcher, registry, events_n=events_n, spans_n=spans_n)
+    lines = [f"== raft_tpu debugz @ {time.strftime('%Y-%m-%dT%H:%M:%S')} =="]
+    if "ladder" in s:
+        lad = s["ladder"]
+        lines += ["", "-- bucket ladder --",
+                  f"  queue: {lad['queue_depth']}/{lad['queue_max_depth']}"
+                  f"{' (closed)' if lad['queue_closed'] else ''}"]
+        lines += [f"  {shape}: {n} dispatches"
+                  for shape, n in lad["dispatches"].items()]
+    m = s["metrics"]
+    lines += ["", "-- counters --"]
+    lines += [f"  {k}: {v:g}" for k, v in m["counters"].items()]
+    lines += ["", "-- gauges --"]
+    lines += [f"  {k}: {v:g}" for k, v in m["gauges"].items()]
+    hists = m["histograms"]
+    if hists:
+        lines += ["", "-- histograms --"]
+        lines += [_fmt_hist(k, h) for k, h in hists.items() if h["count"]]
+    if s["demotions"]:
+        lines += ["", "-- guarded demotions --"]
+        lines += [f"  {site}: {why}" for site, why in s["demotions"].items()]
+    if s["autotune"]:
+        lines += ["", "-- autotune verdicts --"]
+        lines += [f"  {k} -> {v}" for k, v in sorted(s["autotune"].items())]
+    if s["faults_armed"]:
+        lines += ["", "-- armed faults --"]
+        lines += [f"  {f['kind']}@{f['pattern']} fires={f['fires']}"
+                  for f in s["faults_armed"]]
+    if s["events"]:
+        lines += ["", f"-- flight recorder (last {len(s['events'])}) --"]
+        for e in s["events"]:
+            extra = {k: v for k, v in e.items()
+                     if k not in ("seq", "ts", "kind", "site", "trace_id")}
+            lines.append(
+                f"  #{e['seq']} {e['kind']} @ {e['site']}"
+                + (f" trace={e['trace_id']}" if e.get("trace_id") else "")
+                + (f" {extra}" if extra else ""))
+    if s["spans"]:
+        lines += ["", f"-- sampled request spans (last {len(s['spans'])}) --"]
+        for sp in s["spans"]:
+            stages = " ".join(f"{k}={v * 1e3:.2f}ms"
+                              for k, v in sp["stages"].items())
+            lines.append(f"  {sp['trace_id']}: {stages}")
+    return "\n".join(lines) + "\n"
+
+
+def write_snapshot(path: str, batcher=None, registry=None) -> dict:
+    """Write one JSON snapshot atomically (tmp + rename); returns it."""
+    s = snapshot(batcher, registry)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(s, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return s
+
+
+class SnapshotWriter:
+    """Background ops-snapshot persistence: a daemon thread writing
+    :func:`write_snapshot` to ``path`` every ``interval_s`` (and once on
+    ``stop``, so the final state always lands). Context-manager form
+    scopes it to a serving run."""
+
+    def __init__(self, path: str, interval_s: float = 10.0, batcher=None,
+                 registry=None):
+        self.path = path
+        self.interval_s = float(interval_s)
+        self._batcher = batcher
+        self._registry = registry
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def write_once(self) -> dict:
+        return write_snapshot(self.path, self._batcher, self._registry)
+
+    def start(self) -> "SnapshotWriter":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="debugz-snapshots", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.write_once()
+            except Exception:  # noqa: BLE001 - a failed write must not
+                pass           # kill the writer (disk full, path gone)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.interval_s + 5.0)
+            self._thread = None
+        try:
+            self.write_once()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __enter__(self) -> "SnapshotWriter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,5 +1,5 @@
 """Startup warmup for the bucket ladder, plus compilation-count
-instrumentation.
+instrumentation and the always-on recompile watch.
 
 The recompile-avoidance guarantee of :mod:`raft_tpu.serve.batcher` is
 only worth anything if every ladder shape is compiled BEFORE traffic
@@ -9,21 +9,39 @@ through the live search closure at every (query-bucket × k-bucket)
 shape and blocks on the results, so steady-state serving hits only
 cached executables.
 
-:func:`count_compilations` is the matching measurement: it wraps
-``jax._src.compiler.backend_compile`` — the single funnel both the jit
-cache-miss path and ``compile_or_get_cached`` route through on jax
-0.4.x — and counts invocations, letting the load test assert the
-headline property literally: after warmup, a stream of mixed-size
-requests causes **zero** new XLA compilations.
+The matching measurement wraps ``jax._src.compiler.backend_compile`` —
+the single funnel both the jit cache-miss path and
+``compile_or_get_cached`` route through on jax 0.4.x — and comes in two
+layers:
+
+* :func:`install_recompile_watch` patches the funnel ONCE per process
+  (idempotent) with a spy that (a) increments the always-on
+  ``serve.compiles`` total, and (b) for compiles carrying a
+  non-warmup :func:`compile_context` label (the batcher sets its
+  ``<name>:<rows>x<k>`` shape bucket around every dispatch) — i.e. a
+  SERVING-PATH post-warmup recompile, the rare degradation signal —
+  additionally increments ``serve.recompiles`` and records an
+  ``xla_compile`` flight-recorder event. Warmup-context and unlabeled
+  compiles (a warmup sweep, an index build mid-serve) are counted but
+  get no ring event: hundreds of legitimate first compiles must not
+  churn the demotion/shed events out of the bounded recorder.
+  ``serve.recompiles`` reads 0 right after a clean warmup.
+* :func:`count_compilations` subscribes a counter to that stream for
+  the duration of a block, letting the load test assert the headline
+  property literally: after warmup, a stream of mixed-size requests
+  causes **zero** new XLA compilations.
 """
 from __future__ import annotations
 
 import contextlib
+import threading
+from typing import List, Optional
 
 import jax
 import numpy as np
 
-__all__ = ["CompileCounter", "count_compilations", "warmup"]
+__all__ = ["CompileCounter", "count_compilations", "warmup",
+           "install_recompile_watch", "compile_context"]
 
 
 class CompileCounter:
@@ -34,31 +52,106 @@ class CompileCounter:
         self.count = 0
 
 
-@contextlib.contextmanager
-def count_compilations():
-    """Count XLA compilations during the block (yields a
-    :class:`CompileCounter`). Raises if this jax version moved the
-    compile funnel — a vacuous zero would silently gut the load test's
-    recompile assertion."""
-    from jax._src import compiler as _compiler  # versioned private API
+# persistent watch state: original funnel + live subscriber counters
+_watch_lock = threading.Lock()
+_watch_subs: List[CompileCounter] = []
+_watch_installed = False
+_ctx = threading.local()        # .label (str), .warmup (bool)
+
+
+def _compile_funnel():
+    """The versioned private compile funnel (raises RuntimeError if this
+    jax moved it — a vacuous zero would silently gut every recompile
+    assertion, and callers degrading gracefully catch RuntimeError)."""
+    try:
+        from jax._src import compiler as _compiler  # versioned private API
+    except ImportError as e:
+        raise RuntimeError(
+            f"jax._src.compiler not importable on jax {jax.__version__} "
+            f"({e}); update serve.warmup to this version's compile "
+            "funnel") from e
 
     orig = getattr(_compiler, "backend_compile", None)
     if orig is None:
         raise RuntimeError(
             "jax._src.compiler.backend_compile not found on jax "
-            f"{jax.__version__}; update count_compilations() to this "
-            "version's compile funnel")
+            f"{jax.__version__}; update serve.warmup to this version's "
+            "compile funnel")
+    return _compiler, orig
+
+
+@contextlib.contextmanager
+def compile_context(label: str, warmup: bool = False):
+    """Label compiles observed by the watch for the dynamic extent of the
+    block (thread-local — the batcher worker labels its own dispatches).
+    ``warmup=True`` additionally exempts them from ``serve.recompiles``.
+    Cheap: two attribute writes; safe with the watch uninstalled."""
+    prev = (getattr(_ctx, "label", None), getattr(_ctx, "warmup", False))
+    _ctx.label, _ctx.warmup = label, warmup
+    try:
+        yield
+    finally:
+        _ctx.label, _ctx.warmup = prev
+
+
+def install_recompile_watch() -> None:
+    """Install the persistent compile spy (idempotent; see module
+    docstring). Raises RuntimeError when the compile funnel moved."""
+    global _watch_installed
+    with _watch_lock:
+        if _watch_installed:
+            return
+        _compiler, orig = _compile_funnel()
+
+        def _spy(*args, **kwargs):
+            with _watch_lock:
+                subs = list(_watch_subs)
+            for c in subs:
+                c.count += 1
+            label = getattr(_ctx, "label", None)
+            in_warmup = bool(getattr(_ctx, "warmup", False))
+            try:
+                from . import metrics as _metrics
+
+                # total compile magnitude, visible in any snapshot
+                _metrics.counter("serve.compiles").inc()
+                # SERVING-PATH post-warmup recompiles are the rare
+                # degradation signal: only those earn a flight-recorder
+                # event + the serve.recompiles counter (the batcher
+                # labels every dispatch). A warmup sweep is ~100+
+                # compiles and an operator building a second index
+                # mid-serve is hundreds of legitimate first compiles —
+                # per-compile ring events would churn the demotion/shed
+                # events out of the bounded ring (same dampening as
+                # faults._emit_fire / sharded _mark_shard).
+                if label is not None and not in_warmup:
+                    from ..core import events as _events
+
+                    _events.record("xla_compile", label, warmup=False)
+                    _metrics.counter("serve.recompiles").inc()
+            except Exception:  # noqa: BLE001 - telemetry must not break compiles
+                pass
+            return orig(*args, **kwargs)
+
+        _compiler.backend_compile = _spy
+        _watch_installed = True
+
+
+@contextlib.contextmanager
+def count_compilations():
+    """Count XLA compilations during the block (yields a
+    :class:`CompileCounter`). Installs the persistent watch on first use
+    and subscribes to it — nested/concurrent blocks each see every
+    compile. Raises if this jax version moved the compile funnel."""
+    install_recompile_watch()
     counter = CompileCounter()
-
-    def _spy(*args, **kwargs):
-        counter.count += 1
-        return orig(*args, **kwargs)
-
-    _compiler.backend_compile = _spy
+    with _watch_lock:
+        _watch_subs.append(counter)
     try:
         yield counter
     finally:
-        _compiler.backend_compile = orig
+        with _watch_lock:
+            _watch_subs.remove(counter)
 
 
 def warmup(search_fn, ladder, dim: int, dtype=np.float32, registry=None,
@@ -67,7 +160,8 @@ def warmup(search_fn, ladder, dim: int, dtype=np.float32, registry=None,
     and block on each result. Returns the number of XLA compilations the
     sweep triggered (0 when the process is already warm). Records
     ``<name>.warmup.shapes`` (gauge) and ``<name>.warmup.compiles``
-    (counter).
+    (counter); warmup compiles are exempt from ``serve.recompiles``
+    (they are the warmup, not a post-warmup regression).
 
     ``prepare``: optional zero-arg callable run BEFORE the sweep for
     index-side cache builds that must not land on the first unlucky
@@ -86,9 +180,11 @@ def warmup(search_fn, ladder, dim: int, dtype=np.float32, registry=None,
         for mb in ladder.query_buckets:
             q = np.zeros((mb, int(dim)), dtype)
             for kb in ladder.k_buckets:
-                out = search_fn(q, kb)
-                # block: compiles are lazy until the dispatch executes
-                jax.block_until_ready((out[0], out[1]))
+                with compile_context(f"{name}:warmup:{mb}x{kb}",
+                                     warmup=True):
+                    out = search_fn(q, kb)
+                    # block: compiles are lazy until the dispatch executes
+                    jax.block_until_ready((out[0], out[1]))
                 shapes += 1
     reg.gauge(f"{name}.warmup.shapes").set(shapes)
     reg.counter(f"{name}.warmup.compiles").inc(cc.count)
