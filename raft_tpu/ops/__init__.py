@@ -12,3 +12,4 @@ from .fused_knn import fused_knn  # noqa: F401
 from .graph_expand import graph_expand  # noqa: F401
 from .guarded import guarded_call  # noqa: F401
 from .nn_descent import build_graph as nn_descent_graph  # noqa: F401
+from .ring_topk import merge as ring_topk_merge  # noqa: F401

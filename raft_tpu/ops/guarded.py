@@ -4,7 +4,9 @@ equivalents on compile/execution failure.
 Every custom-kernel engine in this library has an exact composed-XLA
 equivalent (that is what the parity tests assert; gated sites today:
 ``select_k`` KPASS, the ivf_flat/ivf_pq scans, ``brute_force.fused``,
-and ``cagra.graph_expand`` → the XLA gather hop), so a Pallas failure —
+``cagra.graph_expand`` → the XLA gather hop, and the sharded merge's
+``sharded.ring_topk`` → the allgather + ``knn_merge_parts`` program),
+so a Pallas failure —
 a Mosaic lowering bug on a new chip generation, a scoped-VMEM
 compile-OOM on an unrehearsed shape, a driver hiccup — should cost one
 log line and a slower call, never the request or the process. The

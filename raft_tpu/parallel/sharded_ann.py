@@ -11,12 +11,24 @@ TPU design: per-shard index arrays are **stacked along a leading axis and
 sharded over the mesh** with `jax.sharding` (shape (p, ...) with spec
 P(AXIS, ...)); the single-chip pure-array search cores
 (ivf_flat.search_arrays, cagra._search_jit internals) run inside one
-`shard_map`, then an `all_gather` of the (k)-wide result lists crosses ICI
-for the merge — vectors never move between chips. Shard row counts are
-padded to a common size; source ids carry GLOBAL row numbers so the merge
-is trivial.
+`shard_map`, then the per-shard (k)-wide result lists merge across ICI —
+vectors never move between chips. Shard row counts are padded to a
+common size; source ids carry GLOBAL row numbers so the merge is
+trivial.
+
+The cross-shard merge dispatches through :mod:`raft_tpu.ops.ring_topk`:
+either the reference allgather + ``knn_merge_parts`` path or a ring
+merge (``ppermute`` hops in XLA, or the Pallas ``make_async_remote_copy``
+kernel on TPU) that keeps candidates device-resident with O(k) ICI
+traffic per hop. All engines are bit-identical (order included), so the
+ring engines are gated behind ``guarded_call("sharded.ring_topk")`` with
+the allgather path as containment. Dead shards contribute (±inf, −1)
+sentinel rows inside whichever engine runs, so the ``allow_partial``
+degraded-merge contract survives unchanged.
 """
 from __future__ import annotations
+
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -27,15 +39,97 @@ from ..comms import AxisComms
 from ..core import faults
 from ..core.errors import ShardsDownError, expects
 from ..distance.distance_types import DistanceType, canonical_metric, is_min_close
-from ..neighbors import brute_force, cagra, ivf_flat, ivf_pq
+from ..neighbors import cagra, ivf_flat, ivf_pq
+from ..ops import ring_topk
 from ..utils import cdiv, shard_map_compat
 
 __all__ = ["ShardedIvfFlat", "build_ivf_flat", "search_ivf_flat",
            "ShardedCagra", "build_cagra", "search_cagra",
            "ShardedIvfPq", "build_ivf_pq", "search_ivf_pq",
-           "make_searcher"]
+           "make_searcher", "ops_snapshot"]
 
 AXIS = "shard"
+
+# guarded site for the ring merge engines (ops/guarded.py): a ring
+# compile/execution failure demotes this process to the bit-identical
+# allgather merge
+MERGE_SITE = ring_topk.MERGE_SITE
+
+# family -> merge engine that actually served the most recent search
+# (the ops surface: serve/debugz.py reports which engine is serving).
+# Shared with ops.ring_topk so sharded_knn's chokepoint reports here too.
+_ACTIVE_ENGINE = ring_topk.active_engines
+
+# live sharded indexes (weak: an operator dropping an index must not leak
+# it through the ops surface) — debugz reads per-family shards_ok here
+_LIVE = weakref.WeakSet()
+
+
+def _merged_shard_search(mesh, family: str, local_fn, in_specs, arrays,
+                         m: int, k: int, select_min: bool, comms,
+                         merge_engine=None):
+    """One chokepoint for every sharded family's cross-shard merge:
+    resolve the engine (param/env override → autotune verdict → backend
+    default), run ``local_fn`` (per-shard candidates, dead shards
+    already masked to sentinel rows) under ``shard_map`` with that
+    engine's merge, and gate the ring engines behind
+    ``guarded_call(MERGE_SITE)`` falling back to the bit-identical
+    allgather program. Returns replica-identical (distances, ids)."""
+    p = mesh.shape[AXIS]
+    # ring engines permute over the raw mesh axis: an injected
+    # communicator restricted to subgroups keeps the allgather path
+    plain_axis = getattr(comms, "groups", True) is None
+    eng = ring_topk.resolve_engine(m, k, p, override=merge_engine,
+                                   plain_axis=plain_axis, mesh=mesh)
+
+    def run(e):
+        def body(*xs):
+            d, gi = local_fn(*xs)
+            return ring_topk.merge(d, gi, k, select_min, comms=comms,
+                                   axis=AXIS, axis_size=p, engine=e)
+        return shard_map_compat(body, mesh=mesh, in_specs=tuple(in_specs),
+                                out_specs=(P(), P()), check=False)(*arrays)
+
+    return ring_topk.guarded_dispatch(family, eng, run)
+
+
+def ops_snapshot() -> dict:
+    """The sharded-serving ops surface (read by serve/debugz.py):
+    per-family shard health of every live index, the merge engine each
+    family's latest search actually resolved, and how many ring-merge
+    calls this process served through the allgather fallback."""
+    fams: dict = {}
+    # WeakSet iteration is python-level and raises RuntimeError if a
+    # build thread registers an index mid-snapshot (the background
+    # SnapshotWriter case); retry rather than lose the whole section
+    for _ in range(4):
+        try:
+            live = list(_LIVE)
+            break
+        except RuntimeError:
+            continue
+    else:
+        live = []
+    for idx in live:
+        ent = fams.setdefault(idx.family, {"indexes": 0, "shards_ok": []})
+        ent["indexes"] += 1
+        ent["shards_ok"].append(
+            [bool(b) for b in np.asarray(idx.shards_ok, bool)])
+    for fam, eng in dict(_ACTIVE_ENGINE).items():
+        fams.setdefault(fam, {"indexes": 0, "shards_ok": []})
+        fams[fam]["merge_engine"] = eng
+    demotions = 0.0
+    try:
+        from ..serve import metrics as _metrics
+
+        demotions = _metrics.counter("sharded.ring.demotions").value
+    except Exception:  # noqa: BLE001
+        pass
+    from ..ops import guarded
+
+    return {"families": fams,
+            "ring_demotions": int(demotions),
+            "ring_demoted": MERGE_SITE in guarded.demoted_sites()}
 
 
 def _shard_health(index, family: str) -> np.ndarray:
@@ -132,6 +226,8 @@ def _stack_pad(arrs: list[np.ndarray], pad_value=0,
 class ShardedIvfFlat:
     """Stacked per-shard IVF-Flat arrays, leading axis sharded over AXIS."""
 
+    family = "ivf_flat"
+
     def __init__(self, mesh, data, data_norms, source_ids, centers,
                  center_norms, offsets, sizes, n_total, metric, max_rows_tbl,
                  scales=None):
@@ -149,6 +245,7 @@ class ShardedIvfFlat:
         self.scales = scales                # (p, R) f32, int8 mode only
         # sticky per-shard health flags (see mark_shard_failed)
         self.shards_ok = np.ones(mesh.shape[AXIS], bool)
+        _LIVE.add(self)
 
     def mark_shard_failed(self, i: int, ok: bool = False) -> None:
         """Flag shard ``i`` unhealthy: its results are masked out of every
@@ -215,14 +312,19 @@ def build_ivf_flat(dataset, mesh: Mesh,
 
 def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
                     params: ivf_flat.SearchParams | None = None,
-                    res=None, allow_partial: bool = False):
-    """Replicated queries → per-shard local search → allgather + merge.
+                    res=None, allow_partial: bool = False,
+                    merge_engine: str | None = None):
+    """Replicated queries → per-shard local search → cross-shard merge
+    (ring or allgather engine; see :func:`_merged_shard_search`).
 
     ``allow_partial=True`` accepts dead shards (``index.shards_ok`` or an
     armed ``shard_dead``/``shard_timeout`` fault): their contributions
     are masked out of the merge and the return becomes
     ``(distances, indices, shards_ok)`` reporting the loss. Default
     (False) raises :class:`ShardsDownError` when any shard is dead.
+    ``merge_engine``: force one of ``ops.ring_topk.ENGINES`` (or
+    ``"auto"``); default consults ``RAFT_TPU_SHARDED_MERGE`` and the
+    autotune verdict for this shape bucket.
     """
     sp = params or ivf_flat.SearchParams()
     q = jnp.asarray(queries, jnp.float32)
@@ -245,13 +347,11 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
             args[0], args[1], args[2], args[3], args[4], args[5], args[6],
             qq, k, n_probes, max_rows, mt, scales=sc)
         # dead-shard containment: an invalid shard's list is all
-        # (+inf, -1), so the merge is over survivors only
+        # (+inf, -1) sentinel rows, so the merge is over survivors only
         bad = jnp.inf if select_min else -jnp.inf
         d = jnp.where(okf[0, 0], d, bad)
         i = jnp.where(okf[0, 0], i, -1)
-        all_d = comms.allgather(d)              # (p, m, k)
-        all_i = comms.allgather(i)
-        return brute_force.knn_merge_parts(all_d, all_i, select_min)
+        return d, i
 
     in_specs = [P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
                 P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
@@ -262,17 +362,16 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
     if has_scales:
         in_specs.append(P(AXIS, None))
         arrays.append(index.scales)
-    shmap = shard_map_compat(
-        local, mesh=index.mesh,
-        in_specs=tuple(in_specs),
-        out_specs=(P(), P()),
-        check=False)
-    d, i = shmap(*arrays)
+    d, i = _merged_shard_search(index.mesh, "ivf_flat", local, in_specs,
+                                arrays, q.shape[0], k, select_min, comms,
+                                merge_engine)
     return (d, i, ok) if allow_partial else (d, i)
 
 
 class ShardedCagra:
     """Stacked per-shard CAGRA graphs, leading axis sharded over AXIS."""
+
+    family = "cagra"
 
     def __init__(self, mesh, data, graphs, bases, counts, n_total, metric,
                  seeds=None):
@@ -286,6 +385,7 @@ class ShardedCagra:
         self.seeds = seeds      # (p, s) per-shard covering seed rows
                                 # (sorted unique; invalid-id padded)
         self.shards_ok = np.ones(mesh.shape[AXIS], bool)
+        _LIVE.add(self)
 
     def mark_shard_failed(self, i: int, ok: bool = False) -> None:
         """Flag shard ``i`` unhealthy (see ShardedIvfFlat.mark_shard_failed)."""
@@ -353,10 +453,12 @@ def build_cagra(dataset, mesh: Mesh,
 
 def search_cagra(index: ShardedCagra, queries, k: int,
                  params: cagra.SearchParams | None = None,
-                 res=None, allow_partial: bool = False):
-    """Replicated queries → per-shard graph traversal → allgather + merge.
+                 res=None, allow_partial: bool = False,
+                 merge_engine: str | None = None):
+    """Replicated queries → per-shard graph traversal → cross-shard merge.
 
-    ``allow_partial``: degraded-merge contract of :func:`search_ivf_flat`.
+    ``allow_partial``/``merge_engine``: contract of
+    :func:`search_ivf_flat`.
     """
     sp = params or cagra.SearchParams()
     q = jnp.asarray(queries, jnp.float32)
@@ -389,9 +491,7 @@ def search_cagra(index: ShardedCagra, queries, k: int,
         gi = jnp.where(okf[0, 0], gi, -1)       # dead-shard containment
         bad = jnp.inf if select_min else -jnp.inf
         d = jnp.where(gi >= 0, d, bad)
-        all_d = comms.allgather(d)
-        all_i = comms.allgather(gi)
-        return brute_force.knn_merge_parts(all_d, all_i, select_min)
+        return d, gi
 
     in_specs = [P(AXIS, None, None), P(AXIS, None, None), P(AXIS), P(AXIS),
                 P(AXIS, None), P()]
@@ -400,12 +500,9 @@ def search_cagra(index: ShardedCagra, queries, k: int,
     if has_seeds:
         in_specs.append(P(AXIS, None))
         arrays.append(index.seeds)
-    shmap = shard_map_compat(
-        local, mesh=index.mesh,
-        in_specs=tuple(in_specs),
-        out_specs=(P(), P()),
-        check=False)
-    d, i = shmap(*arrays)
+    d, i = _merged_shard_search(index.mesh, "cagra", local, in_specs,
+                                arrays, q.shape[0], k, select_min, comms,
+                                merge_engine)
     return (d, i, ok) if allow_partial else (d, i)
 
 
@@ -415,6 +512,8 @@ class ShardedIvfPq:
     The BASELINE north-star layout (sharded IVF-PQ over a worker mesh): one
     compressed index per shard row block, merged per-query at search time.
     """
+
+    family = "ivf_pq"
 
     def __init__(self, mesh, codes, source_ids, centers_rot, codebooks,
                  rotations, offsets, sizes, n_total, metric, pq_bits,
@@ -433,6 +532,7 @@ class ShardedIvfPq:
         self.codebook_kind = codebook_kind
         self._sizes_host = sizes_host   # list of per-shard np size arrays
         self.shards_ok = np.ones(mesh.shape[AXIS], bool)
+        _LIVE.add(self)
 
     def mark_shard_failed(self, i: int, ok: bool = False) -> None:
         """Flag shard ``i`` unhealthy (see ShardedIvfFlat.mark_shard_failed)."""
@@ -487,11 +587,13 @@ def build_ivf_pq(dataset, mesh: Mesh,
 
 def search_ivf_pq(index: ShardedIvfPq, queries, k: int,
                   params: ivf_pq.SearchParams | None = None,
-                  res=None, allow_partial: bool = False):
-    """Replicated queries → per-shard LUT search → allgather + merge
-    (knn_merge_parts.cuh:172 pattern over the comms allgather).
+                  res=None, allow_partial: bool = False,
+                  merge_engine: str | None = None):
+    """Replicated queries → per-shard LUT search → cross-shard merge
+    (knn_merge_parts.cuh:172 role, ring or allgather engine).
 
-    ``allow_partial``: degraded-merge contract of :func:`search_ivf_flat`.
+    ``allow_partial``/``merge_engine``: contract of
+    :func:`search_ivf_flat`.
     """
     sp = params or ivf_pq.SearchParams()
     q = jnp.asarray(queries, jnp.float32)
@@ -515,21 +617,18 @@ def search_ivf_pq(index: ShardedIvfPq, queries, k: int,
         i = jnp.where(okf[0, 0], i, -1)     # dead-shard containment
         bad = jnp.inf if select_min else -jnp.inf
         d = jnp.where(i >= 0, d, bad)       # padded rows carry id -1
-        all_d = comms.allgather(d)
-        all_i = comms.allgather(i)
-        return brute_force.knn_merge_parts(all_d, all_i, select_min)
+        return d, i
 
-    shmap = shard_map_compat(
-        local, mesh=index.mesh,
-        in_specs=(P(AXIS, None, None), P(AXIS, None), P(AXIS, None, None),
-                  P(AXIS, *([None] * (index.codebooks.ndim - 1))),
-                  P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
-                  P(AXIS, None), P()),
-        out_specs=(P(), P()),
-        check=False)
-    d, i = shmap(index.codes, index.source_ids, index.centers_rot,
-                 index.codebooks, index.rotations, index.offsets,
-                 index.sizes, _shard_mask(index.mesh, ok), q)
+    in_specs = (P(AXIS, None, None), P(AXIS, None), P(AXIS, None, None),
+                P(AXIS, *([None] * (index.codebooks.ndim - 1))),
+                P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
+                P(AXIS, None), P())
+    arrays = (index.codes, index.source_ids, index.centers_rot,
+              index.codebooks, index.rotations, index.offsets,
+              index.sizes, _shard_mask(index.mesh, ok), q)
+    d, i = _merged_shard_search(index.mesh, "ivf_pq", local, in_specs,
+                                arrays, q.shape[0], k, select_min, comms,
+                                merge_engine)
     return (d, i, ok) if allow_partial else (d, i)
 
 

@@ -7,10 +7,12 @@ raft-dask + cuML kneighbors).
 
 TPU design: the dataset is sharded along a mesh axis with `jax.sharding`;
 `jax.shard_map` runs the single-chip tiled search per shard, local indices
-are rebased to global ids from the shard's axis index, and an
-`all_gather` over ICI brings the (k)-sized candidate lists together for the
-merge — the only cross-chip traffic is p×k entries per query, never raw
-vectors.
+are rebased to global ids from the shard's axis index, and the (k)-sized
+candidate lists merge across ICI (:mod:`raft_tpu.ops.ring_topk`:
+allgather + ``knn_merge_parts``, or the bit-identical ring engines with
+O(k) traffic per hop) — cross-chip traffic is candidate lists only,
+never raw vectors. Results come back device-resident: nothing on this
+path blocks on readiness, callers sync when they consume.
 """
 from __future__ import annotations
 
@@ -24,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.errors import expects
 from ..distance.distance_types import is_min_close
 from ..neighbors import brute_force
+from ..ops import ring_topk
 from ..utils import cdiv, shard_map_compat
 
 __all__ = ["ShardedIndex", "build", "search", "dryrun"]
@@ -71,55 +74,71 @@ def build(dataset, mesh: Mesh, metric="sqeuclidean", metric_arg: float = 2.0) ->
 
 
 def search(index: ShardedIndex, queries, k: int, tile_size: int = 8192,
-           algo: str | None = None) -> Tuple[jax.Array, jax.Array]:
+           algo: str | None = None, merge_engine: str | None = None
+           ) -> Tuple[jax.Array, jax.Array]:
     """Sharded search: per-shard top-k then cross-shard merge.
 
-    Queries are replicated; the result is replicated (every chip holds the
-    merged answer, as after the reference's allgather+merge).
+    Queries are replicated; the result is replicated (every chip holds
+    the merged answer) and DEVICE-RESIDENT — this path never blocks on
+    readiness; callers sync when they consume the arrays.
+
+    ``merge_engine``: force one of ``ops.ring_topk.ENGINES`` (ring or
+    allgather merge — bit-identical); default resolves via
+    ``RAFT_TPU_SHARDED_MERGE`` / the autotune verdict / backend.
     """
     select_min = is_min_close(index.metric)
     shard_rows = index.shard_rows
     n_total = index.n_total
+    p = index.n_shards
     metric, metric_arg = index.metric, index.metric_arg
     # the per-shard compute runs on the mesh's devices, not the default
     # backend: only use the fused Pallas path when the mesh is TPU
     if algo is None:
         mesh_platform = index.mesh.devices.flat[0].platform
         algo = "auto" if mesh_platform == "tpu" else "scan"
-
-    def local_search(data_shard, q):
-        rank = jax.lax.axis_index(AXIS)
-        base = rank * shard_rows
-        # local exact search on this shard's rows; padding rows (only the
-        # tail shard has them) are masked inside the tiled scan so they can
-        # never displace true candidates from the local top-k
-        n_valid_local = jnp.clip(n_total - base, 0, shard_rows)
-        local = brute_force.build(data_shard, metric, metric_arg)
-        dist, idx = brute_force.search(local, q, k, tile_size=tile_size,
-                                       valid_rows=n_valid_local, algo=algo)
-        gidx = jnp.where(idx >= 0, idx + base, -1)
-        bad = jnp.inf if select_min else -jnp.inf
-        dist = jnp.where(gidx >= 0, dist, bad)
-        # p×k candidates per query cross ICI; vectors never move
-        all_dist = jax.lax.all_gather(dist, AXIS)   # (p, m, k)
-        all_idx = jax.lax.all_gather(gidx, AXIS)
-        return brute_force.knn_merge_parts(all_dist, all_idx, select_min)
-
-    shmap = shard_map_compat(
-        local_search,
-        mesh=index.mesh,
-        in_specs=(P(AXIS, None), P()),
-        out_specs=(P(), P()),
-        check=False,
-    )
     q = jnp.asarray(queries, jnp.float32)
-    return shmap(index.dataset, q)
+    eng = ring_topk.resolve_engine(q.shape[0], k, p, override=merge_engine,
+                                   mesh=index.mesh)
+
+    def mk(merge_eng):
+        def local_search(data_shard, qq):
+            rank = jax.lax.axis_index(AXIS)
+            base = rank * shard_rows
+            # local exact search on this shard's rows; padding rows (only
+            # the tail shard has them) are masked inside the tiled scan so
+            # they can never displace true candidates from the local top-k
+            n_valid_local = jnp.clip(n_total - base, 0, shard_rows)
+            local = brute_force.build(data_shard, metric, metric_arg)
+            dist, idx = brute_force.search(local, qq, k,
+                                           tile_size=tile_size,
+                                           valid_rows=n_valid_local,
+                                           algo=algo)
+            gidx = jnp.where(idx >= 0, idx + base, -1)
+            bad = jnp.inf if select_min else -jnp.inf
+            dist = jnp.where(gidx >= 0, dist, bad)
+            # only candidate lists cross ICI; vectors never move
+            return ring_topk.merge(dist, gidx, k, select_min, axis=AXIS,
+                                   axis_size=p, engine=merge_eng)
+
+        return shard_map_compat(
+            local_search,
+            mesh=index.mesh,
+            in_specs=(P(AXIS, None), P()),
+            out_specs=(P(), P()),
+            check=False,
+        )
+
+    return ring_topk.guarded_dispatch(
+        "knn", eng, lambda e: mk(e)(index.dataset, q))
 
 
-def dryrun(n_devices: int) -> None:
+def dryrun(n_devices: int, ring_check: bool = True) -> None:
     """Driver hook: build an n-device mesh on whatever devices exist and run
     one full sharded search step on tiny shapes, verifying against the
-    single-chip answer."""
+    single-chip answer. ``ring_check=False`` skips the ring-engine
+    cross-check (a second full search compile, ~4 s on the CPU mesh):
+    the driver artifact keeps it; tier-1 covers the same path in
+    tests/test_ring_topk.py."""
     devices = jax.devices()
     if len(devices) < n_devices:
         # single real TPU chip under the driver: fall back to the virtual
@@ -137,15 +156,29 @@ def dryrun(n_devices: int) -> None:
     q = rng.standard_normal((32, 64)).astype(np.float32)
     index = build(data, mesh)
     # pin both sides to the scan engine: the check below is exact-equality
-    # on indices, which different engines may break on fp ties
+    # on indices, which different engines may break on fp ties. Results
+    # stay device-resident (no block_until_ready on the search path —
+    # the np.asarray reads below are the sync point).
     dist, idx = jax.jit(
         lambda qq: search(index, qq, k=5, tile_size=128, algo="scan"))(q)
-    jax.block_until_ready((dist, idx))
     # verify against single-device exact search (scan path: the comparison
     # is exact-equality on indices, so both sides must use the same engine)
     local = brute_force.build(data)
     ref_d, ref_i = brute_force.search(local, q, 5, tile_size=512, algo="scan")
     np.testing.assert_array_equal(np.asarray(idx), np.asarray(ref_i))
+    ring_note = ""
+    if ring_check:
+        # the ring merge engine must be BIT-identical to the allgather
+        # merge (order included) — the driver artifact carries the
+        # cross-engine check at the same scale as the single-chip one
+        dist_r, idx_r = search(index, q, k=5, tile_size=128, algo="scan",
+                               merge_engine="ring")
+        np.testing.assert_array_equal(np.asarray(idx_r), np.asarray(idx))
+        np.testing.assert_array_equal(np.asarray(dist_r), np.asarray(dist))
+        ring_note = "; ring merge bit-identical"
+    # report the engine that actually SERVED (fallbacks included), not a
+    # fresh resolution
+    eng = ring_topk.active_engines.get("knn", "-")
     print(f"dryrun_multichip ok: sharded brute force over {n_devices} "
           f"devices x {len(data) // n_devices + 1} rows, merged top-5 "
-          "matches single-chip exactly")
+          f"matches single-chip exactly{ring_note} [engine={eng}]")

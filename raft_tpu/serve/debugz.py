@@ -91,6 +91,15 @@ def snapshot(batcher=None, registry=None, events_n: int = 50,
             {"kind": f.kind, "pattern": f.pattern, "count": f.count,
              "value": f.value, "fires": f.fires} for f in faults.active()],
     }
+    # sharded-serving health: per-family shards_ok of every live sharded
+    # index, the merge engine actually serving each family, and the ring
+    # demotion count (previously visible only as bare counters)
+    try:
+        from ..parallel import sharded_ann
+
+        out["sharded"] = sharded_ann.ops_snapshot()
+    except Exception:  # noqa: BLE001 - surface must render without parallel/
+        pass
     if batcher is not None:
         out["ladder"] = _ladder_view(batcher, reg_snap)
     # scrub the WHOLE snapshot, not just the metrics sub-dict: an armed
@@ -129,6 +138,19 @@ def render_text(batcher=None, registry=None, events_n: int = 20,
     if hists:
         lines += ["", "-- histograms --"]
         lines += [_fmt_hist(k, h) for k, h in hists.items() if h["count"]]
+    sh = s.get("sharded") or {}
+    if sh.get("families"):
+        lines += ["", "-- sharded search --"]
+        for fam, ent in sorted(sh["families"].items()):
+            ok = ent.get("shards_ok") or []
+            health = " ".join(
+                "".join(".X"[not b] for b in per) for per in ok) or "-"
+            lines.append(
+                f"  {fam}: engine={ent.get('merge_engine') or '-'} "
+                f"indexes={ent.get('indexes', 0)} shards[{health}]")
+        lines.append(
+            f"  ring demotions: {sh.get('ring_demotions', 0)}"
+            + (" (site demoted)" if sh.get("ring_demoted") else ""))
     if s["demotions"]:
         lines += ["", "-- guarded demotions --"]
         lines += [f"  {site}: {why}" for site, why in s["demotions"].items()]

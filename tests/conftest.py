@@ -43,6 +43,23 @@ def res():
     return Resources(seed=0)
 
 
+@pytest.fixture(scope="session")
+def multichip_mesh():
+    """The CPU multi-device emulation lane (``multichip`` marker): an
+    8-device mesh over the virtual CPU devices this conftest forces via
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (the driver's
+    dryrun runs the same body in a subprocess with the same flag). Skips
+    rather than fails when the interpreter was initialized without the
+    flag, so ``multichip`` tests are runnable standalone too."""
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("multichip lane needs the 8-device virtual CPU mesh "
+                    "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    return Mesh(np.array(devs[:8]), ("shard",))
+
+
 # The CI box has ONE CPU core (nproc=1), so the smoke lane is a measured
 # file subset, not parallelism:
 #   python -m pytest -q -m "smoke and not slow"
