@@ -732,10 +732,32 @@ def main():
 
         reg_serve = serve_metrics.Registry()
         qhost = np.asarray(queries[:1000])
+        # quality half of the lane (docs/observability.md "Quality"):
+        # a recall sentinel re-executes sampled served requests through
+        # the exact brute-force parts (the GT executables) and the lane
+        # records its rolling serve.recall estimate next to the latency
+        # numbers. Sampled shapes are padded to one fixed row count so
+        # the reference costs exactly one extra compile.
+        from raft_tpu.serve.quality import RecallSentinel
+        _ref_tp = TwoPart(gt_search_jit, bfs, offsets, k)
+        _ref_rows = 64
+
+        def _sentinel_ref(qs, kk):
+            m = qs.shape[0]
+            pad = np.zeros((_ref_rows, d), np.float32)
+            pad[:m] = qs
+            rd, ri = _ref_tp(jnp.asarray(pad))
+            return (np.asarray(rd)[:m, :kk], np.asarray(ri)[:m, :kk])
+
+        sentinel = RecallSentinel(_sentinel_ref, sample=0.25,
+                                  registry=reg_serve, family="ivf_flat",
+                                  engine=f"nprobe{best_probes}",
+                                  window=64, max_pending=16)
         b = MicroBatcher(serve_search, d,
                          ladder=BucketLadder((16, 64), (kb_serve,)),
                          registry=reg_serve, name="serve",
-                         trace_sample=1.0, max_wait_s=0.002)
+                         trace_sample=1.0, max_wait_s=0.002,
+                         sentinel=sentinel)
         try:
             warm_compiles = b.warmup()
             rng_s = np.random.default_rng(11)
@@ -755,7 +777,11 @@ def main():
             serve_wall = time.perf_counter() - t0
         finally:
             b.close()
+            sentinel.drain(120.0)
+            sentinel.close()
         snap = reg_serve.snapshot()
+        sent_snap = sentinel.snapshot()
+        serve_recall = sentinel.estimate("ivf_flat")
         lat = snap["histograms"]["serve.latency_s"]
         stage_hists = {s: snap["histograms"][f"serve.stage.{s}_s"]
                        for s in ("queue_wait", "bucket_pad", "dispatch",
@@ -776,6 +802,15 @@ def main():
              "warmup_compiles": warm_compiles,
              "steady_state_recompiles": int(
                  serve_metrics.counter("serve.recompiles").value),
+             # the online estimate next to the offline recall: these two
+             # agreeing is the sentinel's calibration check
+             "serve_recall_estimate": None if serve_recall is None
+             else round(serve_recall, 4),
+             "recall_sentinel": {
+                 "sampled": sent_snap["sampled"],
+                 "scored": sent_snap["scored"],
+                 "dropped": sent_snap["dropped"],
+                 "sample_rate": 0.25},
              "recall_source": flat_name, "trace_sample": 1.0},
             batch=n_req, baseline_key=None)
 

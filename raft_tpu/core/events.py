@@ -27,12 +27,21 @@ a multi-request batch was in flight, None outside any binding.
 Well-known kinds (open set — emitters define meaning):
 ``guarded_demotion``, ``fault_injected``, ``deadline_shed``,
 ``deadline_exceeded``, ``dispatch_error``, ``shard_marked``,
-``autotune_verdict``, ``xla_compile``, ``corrupt_index``.
+``autotune_verdict``, ``xla_compile``, ``corrupt_index``,
+``recall_regression``, ``slo_breach``.
+
+Details are scrubbed JSON-safe at record time: non-finite floats become
+None, numpy scalars/arrays become python values/lists (large arrays a
+shape summary), exceptions become ``"Type: message"`` strings, unknown
+objects their repr — so ``to_jsonl`` and the debugz snapshot can never
+be broken by a hostile payload (an exception object in a
+``dispatch_error``, an inf distance in a ``recall_regression``).
 """
 from __future__ import annotations
 
 import collections
 import json
+import math
 import threading
 import time
 from typing import List, Optional
@@ -42,9 +51,45 @@ __all__ = ["record", "recent", "counts", "to_jsonl", "export_jsonl",
 
 DEFAULT_CAPACITY = 512
 
+# arrays above this many elements are summarized, not inlined — one
+# stray (10k, 128) distance matrix must not bloat the ring
+_ARRAY_INLINE_MAX = 32
+
 _lock = threading.Lock()
 _ring: collections.deque = collections.deque(maxlen=DEFAULT_CAPACITY)
 _seq = 0
+
+
+def _json_safe(v, depth: int = 0):
+    """Best-effort JSON-safe scrub (duck-typed: this module must stay
+    numpy/jax-free). Never raises — worst case is a repr string."""
+    try:
+        if v is None or isinstance(v, (bool, int, str)):
+            return v
+        if isinstance(v, float):
+            return v if math.isfinite(v) else None
+        if isinstance(v, BaseException):
+            return f"{type(v).__name__}: {v}"
+        if depth >= 6:
+            return repr(v)
+        if isinstance(v, dict):
+            return {str(k): _json_safe(x, depth + 1) for k, x in v.items()}
+        if isinstance(v, (list, tuple, set, frozenset)):
+            return [_json_safe(x, depth + 1) for x in v]
+        # numpy/jax arrays: small ones inline as (scrubbed) lists, large
+        # ones as a shape summary
+        if hasattr(v, "dtype") and hasattr(v, "shape"):
+            if getattr(v, "size", _ARRAY_INLINE_MAX + 1) <= _ARRAY_INLINE_MAX:
+                return _json_safe(v.tolist(), depth + 1)
+            return f"array(shape={tuple(v.shape)}, dtype={v.dtype})"
+        if hasattr(v, "item"):            # numpy scalar
+            return _json_safe(v.item(), depth + 1)
+        return repr(v)
+    except Exception:  # noqa: BLE001 - scrub must never raise
+        try:
+            return repr(v)
+        except Exception:  # noqa: BLE001
+            return "<unprintable>"
 
 
 def record(kind: str, site: str, trace_id=None, **details) -> dict:
@@ -58,9 +103,10 @@ def record(kind: str, site: str, trace_id=None, **details) -> dict:
 
         ids = tracing.current_traces()
         trace_id = ids[0] if len(ids) == 1 else (list(ids) if ids else None)
-    e = {"ts": time.time(), "kind": kind, "site": site, "trace_id": trace_id}
+    e = {"ts": time.time(), "kind": kind, "site": site,
+         "trace_id": _json_safe(trace_id)}
     if details:
-        e.update(details)
+        e.update({k: _json_safe(v) for k, v in details.items()})
     with _lock:
         _seq += 1
         e["seq"] = _seq
@@ -93,8 +139,8 @@ def counts() -> dict:
 def to_jsonl(n: Optional[int] = None, kind: Optional[str] = None) -> str:
     """The ring (tail ``n``, optionally filtered) as JSON-lines."""
     items = recent(n, kind)
-    return "\n".join(json.dumps(e, sort_keys=True) for e in items) \
-        + ("\n" if items else "")
+    return "\n".join(json.dumps(e, sort_keys=True, default=repr)
+                     for e in items) + ("\n" if items else "")
 
 
 def export_jsonl(path: str, n: Optional[int] = None) -> int:
@@ -102,7 +148,7 @@ def export_jsonl(path: str, n: Optional[int] = None) -> int:
     items = recent(n)
     with open(path, "w") as f:
         for e in items:
-            f.write(json.dumps(e, sort_keys=True) + "\n")
+            f.write(json.dumps(e, sort_keys=True, default=repr) + "\n")
     return len(items)
 
 

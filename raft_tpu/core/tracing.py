@@ -200,18 +200,20 @@ def child_span(name: str, out: Optional[Dict[str, float]] = None
 
 
 # -- sampling knob ---------------------------------------------------------
-def sample_rate(value=None) -> float:
-    """Resolve and validate the request-trace sampling rate.
+def sample_rate(value=None, env: str = "RAFT_TPU_TRACE_SAMPLE",
+                name: str = "trace_sample") -> float:
+    """Resolve and validate a sampling-rate knob.
 
-    ``value=None`` reads ``RAFT_TPU_TRACE_SAMPLE`` (default ``0`` =
-    sampling off); an explicit value (float or string) bypasses the
-    env. The rate must parse as a float in [0, 1] — anything else
-    raises ValueError at construction time, not silently at the first
-    sampled request."""
+    ``value=None`` reads the ``env`` variable (default
+    ``RAFT_TPU_TRACE_SAMPLE``; ``0`` = sampling off); an explicit value
+    (float or string) bypasses the env. The rate must parse as a float
+    in [0, 1] — anything else raises ValueError at construction time,
+    not silently at the first sampled request. Other samplers (the
+    recall sentinel's ``RAFT_TPU_RECALL_SAMPLE``) reuse this validation
+    by passing their own ``env``/``name``."""
     # blame the actual source: the env var only on the env-read path
-    src = "RAFT_TPU_TRACE_SAMPLE" if value is None else "trace_sample"
-    raw = os.environ.get("RAFT_TPU_TRACE_SAMPLE", "0") if value is None \
-        else value
+    src = env if value is None else name
+    raw = os.environ.get(env, "0") if value is None else value
     try:
         r = float(raw)
     except (TypeError, ValueError):

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["count_sizes", "plan_offsets", "scatter_build", "scatter_extend",
-           "gather_dense", "streaming_build"]
+           "gather_dense", "streaming_build", "list_skew"]
 
 _ALIGN = 8   # sublane multiple: keeps list starts DMA-friendly
 
@@ -128,6 +128,31 @@ def gather_dense(arrays: Sequence[jax.Array], offsets: np.ndarray,
     rows = (jnp.take(jnp.asarray(offsets[:-1]), list_of)
             + (pos - jnp.take(jnp.asarray(starts), list_of)))
     return [jnp.take(a, rows, axis=0) for a in arrays], list_of.astype(jnp.int32)
+
+
+def list_skew(sizes: np.ndarray) -> dict:
+    """List-size skew summary shared by the IVF health reports
+    (docs/observability.md "Quality"): a few hot lists carrying most of
+    the rows means probe budgets blow up (``max_rows`` follows the
+    largest probed lists) and recall concentrates risk — the classic
+    unbalanced-kmeans failure the balanced trainer exists to avoid."""
+    s = np.asarray(sizes, np.float64)
+    if s.size == 0 or s.sum() == 0:
+        return {"n_lists": int(s.size), "rows": 0, "empty_lists": int(s.size)}
+    mean = float(s.mean())
+    return {
+        "n_lists": int(s.size),
+        "rows": int(s.sum()),
+        "min": int(s.min()),
+        "mean": round(mean, 1),
+        "p99": int(np.percentile(s, 99)),
+        "max": int(s.max()),
+        # coefficient of variation + largest/mean: the two skew numbers
+        # an operator compares across builds
+        "cv": round(float(s.std() / max(mean, 1e-30)), 4),
+        "max_over_mean": round(float(s.max() / max(mean, 1e-30)), 2),
+        "empty_lists": int((s == 0).sum()),
+    }
 
 
 def streaming_build(batches, params, build_fn, extend_fn, replace_fn,

@@ -32,7 +32,9 @@ from ..matrix.select_k import select_k
 from ..utils import hdot, in_jax_trace, round_up_to, run_query_chunks
 
 __all__ = ["Index", "build", "search", "knn", "knn_merge_parts", "save",
-           "load", "tune_search", "make_searcher", "prepare_fused"]
+           "load", "tune_search", "make_searcher", "prepare_fused",
+           "health", "quantization_error", "health_sample_rows",
+           "int8_scale_report"]
 
 # v2: store_dtype meta + uint16-framed bf16 datasets + int8 scales; v1
 # files (plain f32) remain readable
@@ -145,6 +147,73 @@ def build(dataset: jax.Array, metric="sqeuclidean", metric_arg: float = 2.0,
         deq = dequantize_rows(stored, scales)
         norms = jnp.sum(deq * deq, axis=1)
     return Index(stored, norms, mt, metric_arg, scales)
+
+
+def health_sample_rows(n: int, sample: int):
+    """Deterministic evenly-spread row sample for the health reports
+    (numpy int array; empty for an empty index — a mid-streaming-build
+    index with 0 rows must report, not raise): no RNG, so two snapshots
+    of the same index agree."""
+    import numpy as np
+
+    if n <= 0:
+        return np.zeros((0,), np.int64)
+    take = max(1, min(int(sample), int(n)))
+    return np.unique(np.linspace(0, n - 1, take).astype(np.int64))
+
+
+def quantization_error(original, dequantized) -> dict:
+    """Measured reconstruction error of a quantized copy vs its f32
+    original (sampled rows): relative Frobenius RMSE + worst absolute
+    component error — the health-report form shared by every family that
+    keeps both representations."""
+    import numpy as np
+
+    o = np.asarray(original, np.float32)
+    dq = np.asarray(dequantized, np.float32)
+    err = o - dq
+    denom = max(float(np.sqrt((o * o).mean())), 1e-30)
+    return {"rel_rmse": round(float(np.sqrt((err * err).mean())) / denom, 6),
+            "max_abs_err": round(float(np.abs(err).max()), 6)}
+
+
+def int8_scale_report(scales) -> dict:
+    """Sampled per-row int8 scale stats for a health report: the f32
+    originals are not retained by int8 stores, so the report carries the
+    quantization *step bound* ``max_scale/2`` per component rather than
+    a measured reconstruction error. Shared by every family with an
+    int8 storage mode (brute_force, ivf_flat)."""
+    import numpy as np
+
+    sc = np.asarray(scales, np.float64)
+    return {"int8": {
+        "mean_scale": round(float(sc.mean()), 6),
+        "max_scale": round(float(sc.max()), 6),
+        "max_abs_err_bound": round(float(sc.max()) / 2.0, 6)}}
+
+
+def health(index: Index, sample: int = 256) -> dict:
+    """Index health report (docs/observability.md "Quality"): geometry,
+    storage width, and — for int8 stores — sampled per-row scale stats
+    (see :func:`int8_scale_report`)."""
+    import numpy as np
+
+    report = {
+        "family": "brute_force", "n": int(index.size),
+        "dim": int(index.dim), "metric": index.metric.name,
+        "store_dtype": str(jnp.dtype(index.store_dtype)),
+        "fused_cache": getattr(index, "_fused_pad", None) is not None,
+    }
+    dt = jnp.dtype(index.store_dtype)
+    if dt == jnp.int8 and index.scales is not None:
+        rows = health_sample_rows(index.size, sample)
+        if rows.size:
+            report["quant"] = int8_scale_report(index.scales[rows])
+    elif dt == jnp.bfloat16:
+        report["quant"] = {"bfloat16": {"rel_step": 2.0 ** -8}}
+    elif dt == jnp.uint8:
+        report["quant"] = {"uint8": {"exact": True}}
+    return report
 
 
 def _tile_distances(q, q_norm, tile, tile_norm, mt, metric_arg):

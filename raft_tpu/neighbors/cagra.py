@@ -59,7 +59,7 @@ from . import refine as refine_mod
 __all__ = ["BuildAlgo", "IndexParams", "SearchParams", "Index", "build",
            "build_knn_graph", "optimize", "search", "save", "load",
            "prepare_search", "prepare_traversal", "tune_search",
-           "make_searcher"]
+           "make_searcher", "health"]
 
 _SERIAL_VERSION = 2   # v2 adds optional seed_nodes
 
@@ -1345,6 +1345,90 @@ def load(path) -> Index:
         seeds = jnp.asarray(np.unique(np.asarray(seeds)), jnp.int32)
     return Index(jnp.asarray(arrs["dataset"]), jnp.asarray(arrs["graph"]),
                  DistanceType(meta["metric"]), seeds)
+
+
+def health(index: Index, sample: int = 256) -> dict:
+    """Index health report (docs/observability.md "Quality"): graph
+    connectivity + quantization quality.
+
+    The fixed out-degree graph's quality signal is its **in-degree
+    distribution**: a node no edge points at is unreachable by traversal
+    (only random/covering seeding can surface it), and a heavy-tailed
+    in-degree concentrates traffic on hub rows. Because the index keeps
+    the f32 dataset next to its quantized traversal caches
+    (``prepare_search``/``prepare_traversal``), the report carries a
+    *measured* sampled reconstruction error per cache, not just a bound.
+    """
+    from .brute_force import health_sample_rows, quantization_error
+
+    # the connectivity half is graph-derived and the graph is immutable
+    # post-build, but computing it means pulling the WHOLE graph to host
+    # (256 MB at 1M x deg64) + a full bincount — far too heavy to repeat
+    # inside every 10s SnapshotWriter tick once the index is watched.
+    # Cache it on the index keyed by the array identities (both alive as
+    # long as the index is).
+    key = (id(index.graph), id(index.seed_nodes))
+    cached = getattr(index, "_health_conn_cache", None)
+    if cached is not None and cached[0] == key:
+        conn = cached[1]
+    elif index.size == 0:
+        # an empty graph must report, not raise (np.min on an empty
+        # in-degree array would)
+        conn = {"graph_degree": int(index.graph.shape[1]),
+                "in_degree": {"min": 0, "mean": 0.0, "p99": 0, "max": 0},
+                "unreachable_nodes": 0, "unreachable_frac": 0.0,
+                "unseeded_unreachable": 0, "seed_nodes": 0}
+        index._health_conn_cache = (key, conn)
+    else:
+        g = np.asarray(index.graph)
+        n, deg = g.shape
+        flat = g.reshape(-1)
+        indeg = np.bincount(flat[(flat >= 0) & (flat < n)], minlength=n)
+        unreachable = indeg == 0
+        seeds = None if index.seed_nodes is None \
+            else np.asarray(index.seed_nodes)
+        # unreachable AND outside the covering seed set: invisible to
+        # traversal except through random seeding — the number that
+        # predicts a recall ceiling
+        unseeded = unreachable.copy()
+        if seeds is not None and seeds.size:
+            valid = seeds[(seeds >= 0) & (seeds < n)]
+            unseeded[valid] = False
+        conn = {
+            "graph_degree": int(deg),
+            "in_degree": {
+                "min": int(indeg.min()),
+                "mean": round(float(indeg.mean()), 2),
+                "p99": int(np.percentile(indeg, 99)),
+                "max": int(indeg.max())},
+            "unreachable_nodes": int(unreachable.sum()),
+            "unreachable_frac": round(float(unreachable.mean()), 5),
+            "unseeded_unreachable": int(unseeded.sum()),
+            "seed_nodes": 0 if seeds is None else int(seeds.shape[0]),
+        }
+        index._health_conn_cache = (key, conn)
+    report = {"family": "cagra", "n": int(index.size),
+              "dim": int(index.dim), "metric": index.metric.name, **conn}
+    rows = health_sample_rows(index.size, sample)
+    quant = {}
+    orig = np.asarray(index.dataset[rows]) if rows.size else None
+    i8 = getattr(index, "_score_i8", None)
+    if i8 is not None and rows.size:
+        q8, sc = i8
+        deq = np.asarray(q8[rows], np.float32) * np.asarray(sc[rows])[:, None]
+        quant["int8"] = quantization_error(orig, deq)
+    bf = getattr(index, "_score_bf16", None)
+    if bf is not None and rows.size:
+        quant["bfloat16"] = quantization_error(
+            orig, np.asarray(bf[rows], np.float32))
+    es = getattr(index, "_edge_store", None)
+    if es is not None:
+        ev = es[1]
+        quant["edge_store"] = {"dtype": str(ev.dtype),
+                               "shape": tuple(int(s) for s in ev.shape)}
+    if quant:
+        report["quant"] = quant
+    return report
 
 
 def make_searcher(index: Index, params: SearchParams | None = None, **opts):

@@ -38,7 +38,7 @@ from ..utils import cdiv, hdot, in_jax_trace, run_query_chunks
 
 __all__ = ["IndexParams", "SearchParams", "Index", "build",
            "build_from_batches", "extend", "search", "prepare_scan",
-           "reconstruct", "save", "load", "make_searcher"]
+           "reconstruct", "save", "load", "make_searcher", "health"]
 
 # v2: store_dtype meta + uint16-framed bf16 rows + int8 scales; v1 files
 # (dense f32) remain readable
@@ -593,6 +593,35 @@ def load(path) -> Index:
         centers, jnp.sum(centers * centers, axis=1), offsets,
         DistanceType(meta["metric"]),
         list_sizes_arr=np.diff(offsets), scales=scales)
+
+
+def health(index: Index, sample: int = 256) -> dict:
+    """Index health report (docs/observability.md "Quality"): list-size
+    skew (the probe-budget and recall-concentration signal) + storage
+    width. int8 stores report sampled per-row scale stats over real rows
+    (slack rows carry no data) — the quantization step bound, since the
+    f32 originals are not retained."""
+    from ._list_layout import list_skew
+    from .brute_force import health_sample_rows, int8_scale_report
+
+    report = {
+        "family": "ivf_flat", "n": int(index.size), "dim": int(index.dim),
+        "metric": index.metric.name,
+        "store_dtype": str(jnp.dtype(index.data.dtype)),
+        "lists": list_skew(index.list_sizes),
+    }
+    dt = jnp.dtype(index.data.dtype)
+    if dt == jnp.int8 and index.scales is not None:
+        rows = health_sample_rows(index.data.shape[0], sample)
+        sid = np.asarray(index.source_ids[rows])
+        sc = np.asarray(index.scales[rows], np.float64)[sid >= 0]
+        if sc.size:
+            report["quant"] = int8_scale_report(sc)
+    elif dt == jnp.bfloat16:
+        report["quant"] = {"bfloat16": {"rel_step": 2.0 ** -8}}
+    elif dt == jnp.uint8:
+        report["quant"] = {"uint8": {"exact": True}}
+    return report
 
 
 def make_searcher(index: Index, params: SearchParams | None = None, **opts):

@@ -54,7 +54,8 @@ from .ivf_flat import _candidate_rows, _probe_budget
 
 __all__ = ["CodebookGen", "IndexParams", "SearchParams", "Index", "build",
            "build_from_batches", "extend", "search", "prepare_scan", "save",
-           "load", "pack_codes", "unpack_codes", "reconstruct"]
+           "load", "pack_codes", "unpack_codes", "reconstruct",
+           "make_searcher", "health"]
 
 _SERIAL_VERSION = 1
 
@@ -827,6 +828,46 @@ def load(path) -> Index:
         DistanceType(meta["metric"]), meta["pq_bits"],
         CodebookGen(meta["codebook_kind"]),
         list_sizes_arr=np.diff(offsets))
+
+
+def health(index: Index, sample: int = 256) -> dict:
+    """Index health report (docs/observability.md "Quality"): list-size
+    skew, PQ geometry, and sampled **codeword utilization** — the
+    PQ-specific quality signal available without the f32 originals: a
+    subspace using a small fraction of its 2^bits codewords has
+    collapsed codebook training (all residuals near one centroid), which
+    caps the resolution — and therefore the recall — of every list scan.
+    """
+    from ._list_layout import list_skew
+    from .brute_force import health_sample_rows
+
+    report = {
+        "family": "ivf_pq", "n": int(index.size), "dim": int(index.dim),
+        "metric": index.metric.name,
+        "lists": list_skew(index.list_sizes),
+        "pq": {"pq_dim": int(index.pq_dim), "pq_bits": int(index.pq_bits),
+               "book_size": int(index.pq_book_size),
+               "rot_dim": int(index.rot_dim),
+               "codebook_kind": index.codebook_kind.name,
+               "compression": round(
+                   index.dim * 4.0 / max(index.pq_dim, 1), 1)},
+    }
+    cap = int(index.codes.shape[0])
+    if cap:
+        rows = health_sample_rows(cap, sample)
+        sid = np.asarray(index.source_ids[rows])
+        codes = np.asarray(index.codes[rows])[sid >= 0]
+        if codes.size:
+            used = np.array([np.unique(codes[:, s]).size
+                             for s in range(codes.shape[1])], np.float64)
+            # utilization saturates at the sample size on tiny samples —
+            # report the bound so the number stays interpretable
+            denom = min(index.pq_book_size, codes.shape[0])
+            report["pq"]["codeword_utilization"] = {
+                "mean": round(float(used.mean() / denom), 4),
+                "min": round(float(used.min() / denom), 4),
+                "sampled_rows": int(codes.shape[0])}
+    return report
 
 
 def make_searcher(index: Index, params: SearchParams | None = None, **opts):

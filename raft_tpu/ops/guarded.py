@@ -72,6 +72,9 @@ def _demote(site: str, err: Exception, persist: bool) -> None:
             from ..serve import metrics as serve_metrics
 
             serve_metrics.counter("guarded.demotions").inc()
+            # per-site magnitude: the SLO engine's demotion-rate target
+            # and the drift-guard test read site-labeled counts
+            serve_metrics.counter(f"guarded.demotions.{site}").inc()
             # flight recorder: stamped with the trace IDs of whatever
             # requests were in flight when the kernel path died
             from ..core import events as core_events

@@ -46,7 +46,7 @@ from ..utils import cdiv, shard_map_compat
 __all__ = ["ShardedIvfFlat", "build_ivf_flat", "search_ivf_flat",
            "ShardedCagra", "build_cagra", "search_cagra",
            "ShardedIvfPq", "build_ivf_pq", "search_ivf_pq",
-           "make_searcher", "ops_snapshot"]
+           "make_searcher", "ops_snapshot", "health"]
 
 AXIS = "shard"
 
@@ -130,6 +130,34 @@ def ops_snapshot() -> dict:
     return {"families": fams,
             "ring_demotions": int(demotions),
             "ring_demoted": MERGE_SITE in guarded.demoted_sites()}
+
+
+def health(index) -> dict:
+    """Sharded-index health report (docs/observability.md "Quality"):
+    per-shard real row counts + the sticky ``shards_ok`` flags — the
+    numbers that say how much of the corpus a degraded merge is actually
+    serving, and whether the row split is balanced enough that one
+    shard's loss costs ~1/p recall rather than a hot partition."""
+    if isinstance(index, ShardedCagra):
+        counts = np.asarray(index.counts, np.int64)
+    elif isinstance(index, (ShardedIvfFlat, ShardedIvfPq)):
+        counts = np.asarray(index.sizes, np.int64).sum(axis=1)
+    else:
+        raise TypeError(
+            f"no health report for sharded type {type(index).__name__}")
+    ok = [bool(b) for b in np.asarray(index.shards_ok, bool)]
+    served = int(counts[np.asarray(ok, bool)].sum())
+    return {
+        "family": f"sharded_{index.family}",
+        "n_shards": int(index.n_shards),
+        "shards_ok": ok,
+        "healthy_shards": int(sum(ok)),
+        "n_total": int(index.n_total),
+        "shard_rows": [int(c) for c in counts],
+        "served_rows": served,
+        "served_frac": round(served / max(int(index.n_total), 1), 4),
+        "row_skew": round(float(counts.max() / max(counts.min(), 1)), 3),
+    }
 
 
 def _shard_health(index, family: str) -> np.ndarray:

@@ -18,6 +18,10 @@ operational metrics.
 - ``warmup``    ladder pre-compile + recompile watch + compile counting
 - ``debugz``    exportable ops snapshot/text surface + background writer
                 (docs/observability.md)
+- ``quality``   online recall sentinel + index health introspection
+                (docs/observability.md "Quality")
+- ``slo``       declarative SLO engine over the metrics registry
+                (burn-rate windows, slo_breach events)
 
 Submodules import lazily, so telemetry-only consumers (ops/guarded
 demotion events, core/tracing span timing) pull in none of the
@@ -28,7 +32,8 @@ from __future__ import annotations
 import importlib
 from typing import Any
 
-_SUBMODULES = ("admission", "batcher", "debugz", "metrics", "warmup")
+_SUBMODULES = ("admission", "batcher", "debugz", "metrics", "quality",
+               "slo", "warmup")
 _EXPORTS = {
     "MicroBatcher": "batcher",
     "BucketLadder": "batcher",
@@ -38,6 +43,9 @@ _EXPORTS = {
     "QueueFullError": "admission",
     "count_compilations": "warmup",
     "SnapshotWriter": "debugz",
+    "RecallSentinel": "quality",
+    "SLOEngine": "slo",
+    "Targets": "slo",
 }
 
 __all__ = list(_SUBMODULES) + list(_EXPORTS)

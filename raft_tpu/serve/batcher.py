@@ -132,7 +132,10 @@ class MicroBatcher:
     backlog before the worker drains it. ``trace_sample`` is the
     request-telemetry sampling rate (None reads ``RAFT_TPU_TRACE_SAMPLE``,
     validated; 0 disables stage decomposition entirely — see module
-    docstring).
+    docstring). ``sentinel``: an optional
+    :class:`~raft_tpu.serve.quality.RecallSentinel` — served requests
+    are offered to it after delivery for online recall estimation
+    (docs/observability.md "Quality").
     """
 
     def __init__(self, search_fn: Callable, dim: int, *,
@@ -144,6 +147,7 @@ class MicroBatcher:
                  name: str = "serve",
                  autostart: bool = True,
                  trace_sample: Optional[float] = None,
+                 sentinel=None,
                  clock: Callable[[], float] = time.monotonic):
         from . import metrics as _metrics
 
@@ -155,6 +159,10 @@ class MicroBatcher:
         self._name = name
         self._clock = clock
         self._reg = registry or _metrics.default_registry
+        # optional quality probe (serve/quality.RecallSentinel): served
+        # requests are offered AFTER delivery; its disabled cost is one
+        # None check here plus one flag check inside offer()
+        self._sentinel = sentinel
         rate = tracing.sample_rate(trace_sample)
         # stage telemetry: None = off (the hot path checks exactly this);
         # every ceil(1/rate)-th batch gets the full five-stage story
@@ -359,6 +367,18 @@ class MicroBatcher:
         for r, res_r in zip(live, results):
             r.set_result(res_r)
             self._latency.observe(now - r.enqueued_at)
+        if self._sentinel is not None:
+            # recall sampling: AFTER delivery (results are already in
+            # callers' hands) and guarded — the sentinel contract is
+            # never-blocks, but a hostile replacement must not strand a
+            # served batch either
+            try:
+                for r, res_r in zip(live, results):
+                    self._sentinel.offer(
+                        r.queries, r.k, res_r.distances, res_r.indices,
+                        trace_id=r.trace_id)
+            except Exception:  # noqa: BLE001 - telemetry must not break
+                pass           # serving
         if probe:
             # AFTER delivery, and guarded: a failing observer (a
             # user-supplied registry) must not fail a batch whose

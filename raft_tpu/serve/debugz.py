@@ -61,7 +61,7 @@ def _json_safe(obj):
 
 
 def snapshot(batcher=None, registry=None, events_n: int = 50,
-             spans_n: int = 20) -> dict:
+             spans_n: int = 20, slo=None) -> dict:
     """Point-in-time ops snapshot (strict-JSON-safe: no NaN/Inf leaves).
 
     ``batcher``: include its bucket-ladder occupancy and queue state.
@@ -71,6 +71,12 @@ def snapshot(batcher=None, registry=None, events_n: int = 50,
     ``guarded.demotions`` / ``serve.recompiles``).
     ``events_n`` / ``spans_n``: flight-recorder / span-log tail sizes
     (0 = omit the tail).
+    ``slo``: a :class:`~raft_tpu.serve.slo.SLOEngine` to evaluate into
+    the ``slo`` section; None uses the process-installed engine
+    (``slo.install``). The quality sections ride automatically: every
+    live :class:`~raft_tpu.serve.quality.RecallSentinel` reports under
+    ``quality`` and every ``quality.watch_index``-registered index
+    under ``health``.
     """
     from ..ops import autotune, guarded
     from . import metrics as _metrics
@@ -78,6 +84,18 @@ def snapshot(batcher=None, registry=None, events_n: int = 50,
     if registry is None and batcher is not None:
         registry = batcher._reg
     reg = registry or _metrics.default_registry
+    # SLO verdicts FIRST: an evaluation crossing into breach records an
+    # slo_breach event, and this snapshot's flight-recorder tail (read
+    # below) must already contain it
+    slo_report = None
+    try:
+        from . import slo as _slo
+
+        eng = slo if slo is not None else _slo.installed()
+        if eng is not None:
+            slo_report = eng.evaluate()
+    except Exception:  # noqa: BLE001 - a broken engine must not take
+        pass           # down the snapshot
     reg_snap = reg.snapshot()
     out = {
         "ts": time.time(),
@@ -100,6 +118,20 @@ def snapshot(batcher=None, registry=None, events_n: int = 50,
         out["sharded"] = sharded_ann.ops_snapshot()
     except Exception:  # noqa: BLE001 - surface must render without parallel/
         pass
+    # quality half of the ops surface (docs/observability.md "Quality"):
+    # sentinel rolling-recall estimates + watched-index health reports
+    try:
+        from . import quality as _quality
+
+        q = _quality.ops_snapshot()
+        if q["sentinels"]:
+            out["quality"] = q["sentinels"]
+        if q["health"]:
+            out["health"] = q["health"]
+    except Exception:  # noqa: BLE001 - surface must render without quality
+        pass
+    if slo_report is not None:
+        out["slo"] = slo_report
     if batcher is not None:
         out["ladder"] = _ladder_view(batcher, reg_snap)
     # scrub the WHOLE snapshot, not just the metrics sub-dict: an armed
@@ -116,11 +148,12 @@ def _fmt_hist(name: str, h: dict) -> str:
 
 
 def render_text(batcher=None, registry=None, events_n: int = 20,
-                spans_n: int = 5) -> str:
+                spans_n: int = 5, slo=None) -> str:
     """Human-readable rendering of :func:`snapshot` (the text half of the
     text/JSON ops surface; the Prometheus export stays
     ``metrics.render_text``)."""
-    s = snapshot(batcher, registry, events_n=events_n, spans_n=spans_n)
+    s = snapshot(batcher, registry, events_n=events_n, spans_n=spans_n,
+                 slo=slo)
     lines = [f"== raft_tpu debugz @ {time.strftime('%Y-%m-%dT%H:%M:%S')} =="]
     if "ladder" in s:
         lad = s["ladder"]
@@ -151,6 +184,44 @@ def render_text(batcher=None, registry=None, events_n: int = 20,
         lines.append(
             f"  ring demotions: {sh.get('ring_demotions', 0)}"
             + (" (site demoted)" if sh.get("ring_demoted") else ""))
+    if s.get("slo"):
+        sv = s["slo"]
+        lines += ["", f"-- slo ({sv['verdict']}) --"]
+        for key, rep in sorted(sv["targets"].items()):
+            vals = ", ".join(
+                f"{f}={rep[f]:.4g}" for f in ("value", "fast", "slow")
+                if isinstance(rep.get(f), (int, float)))
+            lines.append(f"  {key}: {rep['verdict']} "
+                         f"(target {rep['target']:g}"
+                         + (f", {vals}" if vals else "") + ")")
+    for q in s.get("quality") or []:
+        lines += ["", f"-- recall sentinel ({q['name']}) --",
+                  f"  sampled={q['sampled']} scored={q['scored']} "
+                  f"dropped={q['dropped']} pending={q['pending']}"
+                  + (f" floor={q['floor']:g}" if q.get("floor") is not None
+                     else "")]
+        for fam, ent in sorted(q["families"].items()):
+            est = ent["estimate"]
+            lines.append(
+                f"  {fam}: recall={est if est is not None else '-'} "
+                f"(n={ent['samples']})"
+                + (" BELOW FLOOR" if ent.get("below_floor") else ""))
+    if s.get("health"):
+        lines += ["", "-- index health --"]
+        for name, rep in sorted(s["health"].items()):
+            if "error" in rep:
+                lines.append(f"  {name}: error {rep['error']}")
+                continue
+            bits = [rep.get("family", "?"), f"n={rep.get('n', rep.get('n_total', '?'))}"]
+            if "unreachable_nodes" in rep:
+                bits.append(f"unreachable={rep['unreachable_nodes']}")
+            if "lists" in rep:
+                bits.append(f"list_cv={rep['lists'].get('cv', '-')}")
+            if "healthy_shards" in rep:
+                bits.append(f"shards={rep['healthy_shards']}/{rep['n_shards']}")
+            if "quant" in rep:
+                bits.append(f"quant={','.join(sorted(rep['quant']))}")
+            lines.append(f"  {name}: " + " ".join(str(b) for b in bits))
     if s["demotions"]:
         lines += ["", "-- guarded demotions --"]
         lines += [f"  {site}: {why}" for site, why in s["demotions"].items()]
@@ -179,9 +250,9 @@ def render_text(batcher=None, registry=None, events_n: int = 20,
     return "\n".join(lines) + "\n"
 
 
-def write_snapshot(path: str, batcher=None, registry=None) -> dict:
+def write_snapshot(path: str, batcher=None, registry=None, slo=None) -> dict:
     """Write one JSON snapshot atomically (tmp + rename); returns it."""
-    s = snapshot(batcher, registry)
+    s = snapshot(batcher, registry, slo=slo)
     tmp = f"{path}.tmp{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(s, f, indent=1, sort_keys=True)
@@ -196,16 +267,18 @@ class SnapshotWriter:
     scopes it to a serving run."""
 
     def __init__(self, path: str, interval_s: float = 10.0, batcher=None,
-                 registry=None):
+                 registry=None, slo=None):
         self.path = path
         self.interval_s = float(interval_s)
         self._batcher = batcher
         self._registry = registry
+        self._slo = slo
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def write_once(self) -> dict:
-        return write_snapshot(self.path, self._batcher, self._registry)
+        return write_snapshot(self.path, self._batcher, self._registry,
+                              slo=self._slo)
 
     def start(self) -> "SnapshotWriter":
         if self._thread is None:

@@ -1,0 +1,422 @@
+"""Quality observability: the online recall sentinel and index-health
+introspection (docs/observability.md "Quality").
+
+PR 6 made *latency* legible; this module makes *recall* legible — the
+axis the paper competes on, and the one every graceful degradation
+(guarded demotion, degraded shard merge, quantized edge store, stale
+autotune verdict) silently moves. The DiskANN/ScaNN serving literature
+(PAPERS.md) is explicit that quantized/graph indexes under production
+traffic need continuous quality monitoring; the ROADMAP's online
+mutation tier is unshippable without it.
+
+Two halves:
+
+* :class:`RecallSentinel` — samples a fraction of served requests
+  (``RAFT_TPU_RECALL_SAMPLE``, same ceil-cadence pattern as
+  ``tracing.sample_rate``), re-executes them through an **exact
+  brute-force reference** on a bounded background worker, scores them
+  with :func:`raft_tpu.stats.metrics.neighborhood_recall`, and publishes
+  rolling per-family/per-engine ``<name>.recall.<family>`` gauges into
+  the metrics registry. A rolling estimate crossing the configured floor
+  emits a trace-stamped ``recall_regression`` flight-recorder event.
+  The contract mirrors the stage-telemetry probes: **disabled cost is
+  one flag check**, the sentinel never blocks or re-orders the hot path
+  (a saturated queue drops samples — counted — instead of applying
+  backpressure), and the reference work is budgeted by the bounded
+  queue.
+* :func:`health` — a per-family index health report (CAGRA in-degree
+  distribution + unreachable nodes + sampled quantization
+  reconstruction error, IVF list-size skew, PQ codeword utilization,
+  sharded per-shard row counts + ``shards_ok``), surfaced in the debugz
+  snapshot for every index registered with :func:`watch_index`.
+"""
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import weakref
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..core import events, tracing
+
+__all__ = ["RecallSentinel", "make_reference", "health", "watch_index",
+           "unwatch_index", "health_snapshot", "export_health_jsonl",
+           "ops_snapshot"]
+
+# live sentinels (weak, like sharded_ann._LIVE): debugz reports every
+# sentinel the process is running without explicit plumbing
+_SENTINELS: "weakref.WeakSet[RecallSentinel]" = weakref.WeakSet()
+
+# name -> weakref to a watched index (the operator's opt-in health set)
+_WATCHED: Dict[str, "weakref.ref"] = {}
+
+
+class RecallSentinel:
+    """Online recall estimation against an exact reference.
+
+    ``reference_fn(queries, k) -> (distances, indices)`` must be the
+    exact answer for the served corpus (build one with
+    :func:`make_reference`, or pass any callable — the acceptance tests
+    use plain numpy). ``sample``: sampling rate in [0, 1] (None reads
+    ``RAFT_TPU_RECALL_SAMPLE``; 0 disables — no worker thread is ever
+    started and :meth:`offer` is one flag check). ``floor``: rolling
+    recall below this emits a ``recall_regression`` event (None reads
+    ``RAFT_TPU_RECALL_FLOOR``; unset = never). ``window``: rolling
+    sample count per family; ``min_samples`` gates the floor check (and
+    the published estimate's trustworthiness). ``max_pending`` bounds
+    the background queue — offers beyond it are DROPPED (counted under
+    ``<name>.recall.dropped``), never queued unboundedly and never
+    blocking dispatch.
+    """
+
+    def __init__(self, reference_fn: Callable, *,
+                 sample: Optional[float] = None,
+                 floor: Optional[float] = None,
+                 window: int = 32, min_samples: int = 4,
+                 max_pending: int = 8,
+                 registry=None, name: str = "serve",
+                 family: str = "default", engine: str = "-",
+                 eps: float = 1e-4, autostart: bool = True):
+        import os
+
+        from . import metrics as _metrics
+
+        self._ref = reference_fn
+        rate = tracing.sample_rate(sample, env="RAFT_TPU_RECALL_SAMPLE",
+                                   name="recall sample")
+        # ceil-cadence (the tracing.sample_rate contract): every
+        # ceil(1/rate)-th offer is sampled, so the configured rate is an
+        # upper bound on reference work, never exceeded
+        self._every = math.ceil(1.0 / rate) if rate > 0 else 0
+        self._tick = 0
+        if floor is None:
+            raw = os.environ.get("RAFT_TPU_RECALL_FLOOR", "")
+            floor = float(raw) if raw else None
+        if floor is not None and not 0.0 <= float(floor) <= 1.0:
+            raise ValueError(
+                f"recall floor must be in [0, 1], got {floor!r}")
+        self.floor = None if floor is None else float(floor)
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self.max_pending = int(max_pending)
+        self._eps = float(eps)
+        self._name = name
+        self._family = family
+        self._engine = engine
+        self._reg = registry or _metrics.default_registry
+        self._sampled = self._reg.counter(f"{name}.recall.sampled")
+        self._dropped = self._reg.counter(f"{name}.recall.dropped")
+        self._scored = self._reg.counter(f"{name}.recall.scored")
+        self._errors = self._reg.counter(f"{name}.recall.errors")
+        self._regressions = self._reg.counter(f"{name}.recall.regressions")
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: collections.deque = collections.deque()
+        self._inflight = 0
+        # per-family rolling windows + per-(family, engine) splits
+        self._windows: Dict[str, collections.deque] = {}
+        self._engine_windows: Dict[tuple, collections.deque] = {}
+        # floor-crossing state per family: one event per crossing, not
+        # one per sample below the floor; re-arms on recovery
+        self._below: Dict[str, bool] = {}
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        _SENTINELS.add(self)
+        if autostart and self._every:
+            self.start()
+
+    # -- lifecycle --------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return bool(self._every)
+
+    def start(self) -> "RecallSentinel":
+        if self._thread is None and self._every:
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._run, name=f"{self._name}-recall-sentinel",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self, timeout: float = 10.0) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def __enter__(self) -> "RecallSentinel":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- hot-path probe ---------------------------------------------------
+    def offer(self, queries, k: int, distances, indices, *,
+              family: Optional[str] = None, engine: Optional[str] = None,
+              trace_id: Optional[str] = None) -> bool:
+        """Offer one served request for recall sampling. Returns True
+        when the request was enqueued for scoring.
+
+        Never blocks and never raises into serving: disabled = one flag
+        check; unsampled tick = one increment; a full queue drops the
+        sample (counted). The tick race under concurrent callers is
+        benign — cadence skews, the rate bound holds."""
+        if not self._every:
+            return False
+        self._tick += 1
+        if (self._tick - 1) % self._every:
+            return False
+        if self._stop:
+            return False
+        if len(self._pending) >= self.max_pending:
+            # pre-copy check: when the queue is already saturated, the
+            # dispatch thread must not pay the host copies just to drop
+            # them (the locked re-check below stays authoritative — this
+            # unlocked read only saves work, never admits past the bound)
+            self._dropped.inc()
+            return False
+        try:
+            item = {
+                # host copies: the sample must not pin device buffers
+                # nor see later in-place mutation
+                "queries": np.array(queries, np.float32, copy=True),
+                "k": int(k),
+                "distances": None if distances is None
+                else np.asarray(distances).copy(),
+                "indices": np.asarray(indices).copy(),
+                "family": family or self._family,
+                "engine": engine or self._engine,
+                "trace_id": trace_id,
+            }
+        except Exception:  # noqa: BLE001 - a hostile payload must not
+            self._errors.inc()   # break serving
+            return False
+        with self._cond:
+            if self._stop:
+                # stopped is not pressure: counting these as drops would
+                # read as a saturated worker on the dashboard forever
+                return False
+            if len(self._pending) >= self.max_pending:
+                self._dropped.inc()
+                return False
+            self._pending.append(item)
+            self._cond.notify()
+        self._sampled.inc()
+        return True
+
+    # -- background worker ------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._stop:
+                    self._cond.wait(0.1)
+                if self._stop and not self._pending:
+                    return
+                item = self._pending.popleft()
+                self._inflight += 1
+            try:
+                self._score(item)
+            except Exception:  # noqa: BLE001 - a reference failure must
+                self._errors.inc()  # not kill the sentinel
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    self._cond.notify_all()
+
+    def _score(self, item: dict) -> None:
+        from ..stats import metrics as stats_metrics
+
+        rd, ri = self._ref(item["queries"], item["k"])
+        rec = float(stats_metrics.neighborhood_recall(
+            item["indices"], np.asarray(ri),
+            item["distances"],
+            None if item["distances"] is None else np.asarray(rd),
+            eps=self._eps))
+        self._scored.inc()
+        fam, eng = item["family"], item["engine"]
+        with self._lock:
+            win = self._windows.setdefault(
+                fam, collections.deque(maxlen=self.window))
+            win.append(rec)
+            ewin = self._engine_windows.setdefault(
+                (fam, eng), collections.deque(maxlen=self.window))
+            ewin.append(rec)
+            est = sum(win) / len(win)
+            n_samples = len(win)
+            eest = sum(ewin) / len(ewin)
+        self._reg.gauge(f"{self._name}.recall.{fam}").set(est)
+        self._reg.gauge(f"{self._name}.recall.{fam}.samples").set(n_samples)
+        self._reg.gauge(f"{self._name}.recall.{fam}.{eng}").set(eest)
+        self._check_floor(fam, est, n_samples, item["trace_id"])
+
+    def _check_floor(self, fam: str, est: float, n_samples: int,
+                     trace_id) -> None:
+        if self.floor is None or n_samples < self.min_samples:
+            return
+        below = est < self.floor
+        if below and not self._below.get(fam):
+            self._regressions.inc()
+            try:
+                # stamped with the sample that crossed the floor — the
+                # post-mortem starts from a concrete degraded request
+                events.record(
+                    "recall_regression", f"{self._name}.recall.{fam}",
+                    trace_id=trace_id, estimate=round(est, 4),
+                    floor=self.floor, samples=n_samples)
+            except Exception:  # noqa: BLE001 - telemetry must not kill
+                pass           # the worker
+        self._below[fam] = below
+
+    # -- introspection ----------------------------------------------------
+    def estimate(self, family: Optional[str] = None) -> Optional[float]:
+        """Rolling recall estimate for ``family`` (ctor default when
+        None); None until a sample has been scored."""
+        with self._lock:
+            win = self._windows.get(family or self._family)
+            return sum(win) / len(win) if win else None
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until every enqueued sample has been scored (tests,
+        bench lanes). Returns False on timeout or when disabled with
+        work pending."""
+        import time as _time
+
+        end = _time.monotonic() + timeout
+        with self._cond:
+            while self._pending or self._inflight:
+                left = end - _time.monotonic()
+                if left <= 0 or (self._thread is None and not self._stop):
+                    return not (self._pending or self._inflight)
+                self._cond.wait(min(left, 0.1))
+        return True
+
+    def snapshot(self) -> dict:
+        """JSON-safe view for the debugz ``quality`` section."""
+        with self._lock:
+            fams = {
+                fam: {
+                    "estimate": round(sum(w) / len(w), 4) if w else None,
+                    "samples": len(w),
+                    "below_floor": bool(self._below.get(fam, False)),
+                    "engines": {
+                        e: round(sum(ew) / len(ew), 4)
+                        for (f, e), ew in self._engine_windows.items()
+                        if f == fam and ew},
+                } for fam, w in self._windows.items()}
+            pending = len(self._pending)
+        return {
+            "name": self._name,
+            "enabled": self.enabled,
+            "sample_every": self._every,
+            "floor": self.floor,
+            "window": self.window,
+            "families": fams,
+            "pending": pending,
+            "sampled": int(self._sampled.value),
+            "scored": int(self._scored.value),
+            "dropped": int(self._dropped.value),
+            "errors": int(self._errors.value),
+        }
+
+
+def make_reference(dataset, metric="sqeuclidean") -> Callable:
+    """Exact brute-force reference closure over ``dataset`` (f32) for
+    :class:`RecallSentinel`: ``ref(queries, k) -> (distances, indices)``
+    host arrays. The sentinel's sampled re-executions all dispatch the
+    same shapes as serving, so steady state hits cached executables."""
+    import jax.numpy as jnp
+
+    from ..neighbors import brute_force
+
+    idx = brute_force.build(jnp.asarray(dataset, jnp.float32),
+                            metric=metric)
+
+    def ref(queries, k):
+        d, i = brute_force.search(idx, jnp.asarray(queries, jnp.float32), k)
+        return np.asarray(d), np.asarray(i)
+
+    return ref
+
+
+# -- index health introspection --------------------------------------------
+def health(index, sample: int = 256) -> dict:
+    """Per-family index health report (dispatches on index type):
+    structural quality signals an operator can read without re-running
+    any search. ``sample`` bounds the sampled passes (quantization
+    reconstruction error, PQ codeword utilization)."""
+    # sharded families first: they carry shards_ok + a family tag
+    if hasattr(index, "shards_ok") and hasattr(index, "family"):
+        from ..parallel import sharded_ann
+
+        return sharded_ann.health(index)
+    from ..neighbors import brute_force, cagra, ivf_flat, ivf_pq
+
+    for mod in (cagra, ivf_flat, ivf_pq, brute_force):
+        if isinstance(index, mod.Index):
+            return mod.health(index, sample=sample)
+    raise TypeError(
+        f"no health report for index type {type(index).__name__}")
+
+
+def watch_index(name: str, index) -> None:
+    """Register ``index`` under ``name`` for the debugz ``health``
+    section (weakly: dropping the index drops the watch)."""
+    _WATCHED[name] = weakref.ref(index)
+
+
+def unwatch_index(name: str) -> None:
+    _WATCHED.pop(name, None)
+
+
+def health_snapshot(sample: int = 256) -> dict:
+    """Health reports for every live watched index (debugz ``health``
+    section). A failing report becomes an ``{"error": ...}`` entry —
+    one bad index must not take down the ops surface."""
+    out: dict = {}
+    for name, ref in list(_WATCHED.items()):
+        idx = ref()
+        if idx is None:
+            _WATCHED.pop(name, None)
+            continue
+        try:
+            out[name] = health(idx, sample=sample)
+        except Exception as e:  # noqa: BLE001
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def export_health_jsonl(path: str, sample: int = 256) -> int:
+    """Write one JSON line per watched index's health report; returns
+    the report count (the JSONL half of the health surface, next to
+    ``events.export_jsonl``)."""
+    import json
+    import time as _time
+
+    snap = health_snapshot(sample=sample)
+    with open(path, "w") as f:
+        for name, report in sorted(snap.items()):
+            f.write(json.dumps({"ts": _time.time(), "index": name,
+                                **report}, sort_keys=True, default=repr)
+                    + "\n")
+    return len(snap)
+
+
+def ops_snapshot() -> dict:
+    """The quality ops surface read by serve/debugz.py: every live
+    sentinel's rolling estimates plus the watched-index health set."""
+    sentinels = []
+    # WeakSet iteration can race a concurrent construction (the sharded
+    # _LIVE precedent); retry rather than lose the section
+    for _ in range(4):
+        try:
+            sentinels = [s.snapshot() for s in _SENTINELS]
+            break
+        except RuntimeError:
+            continue
+    return {"sentinels": sentinels, "health": health_snapshot()}

@@ -1,0 +1,591 @@
+"""Quality-observability tests (ISSUE 9): the online recall sentinel,
+index-health introspection, the SLO engine, the guarded-site drift
+guard, hostile-payload event export — and the end-to-end acceptance
+drill: a fault-injected demotion on a quantized CAGRA searcher must
+produce a measurable ``serve.recall`` drop, a trace-stamped
+``recall_regression`` event, and an SLO breach verdict in the debugz
+snapshot.
+
+Everything except the acceptance drill runs on numpy stubs or handmade
+indexes (no XLA compiles); the drill builds ONE tiny CAGRA index and
+compiles two small search shapes.
+"""
+import json
+import pathlib
+import re
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ann_utils import naive_knn
+from raft_tpu.core import events, faults, tracing
+from raft_tpu.serve import debugz, metrics, quality, slo
+from raft_tpu.serve.batcher import BucketLadder, MicroBatcher
+from raft_tpu.serve.quality import RecallSentinel
+
+pytestmark = pytest.mark.serve
+
+DIM = 16
+
+
+@pytest.fixture
+def reg():
+    return metrics.Registry()
+
+
+@pytest.fixture(autouse=True)
+def _clean_rings():
+    events.clear()
+    tracing.clear_span_log()
+    yield
+
+
+def np_reference(data):
+    """Exact numpy reference closure for the sentinel (zero compiles)."""
+    return lambda q, k: naive_knn(np.asarray(data), np.asarray(q), k)
+
+
+def _serve_result(data, q, k):
+    d, i = naive_knn(np.asarray(data), np.asarray(q), k)
+    return d.astype(np.float32), i.astype(np.int32)
+
+
+class TestRecallSentinel:
+    def test_disabled_is_one_flag_check(self, reg, monkeypatch):
+        monkeypatch.delenv("RAFT_TPU_RECALL_SAMPLE", raising=False)
+
+        def ref(q, k):  # pragma: no cover - must never run
+            raise AssertionError("reference executed while disabled")
+
+        s = RecallSentinel(ref, registry=reg)
+        assert not s.enabled and s._thread is None   # no worker thread
+        assert not s.offer(np.zeros((2, 4), np.float32), 2,
+                           None, np.zeros((2, 2), np.int32))
+        assert s.estimate() is None
+        # env knob resolves through the shared validated parser
+        monkeypatch.setenv("RAFT_TPU_RECALL_SAMPLE", "0.5")
+        assert RecallSentinel(ref, registry=reg, autostart=False)._every == 2
+        monkeypatch.setenv("RAFT_TPU_RECALL_SAMPLE", "1.5")
+        with pytest.raises(ValueError):
+            RecallSentinel(ref, registry=reg)
+
+    def test_ceil_cadence_never_exceeds_rate(self, reg):
+        # 0.7 must sample every 2nd offer, never 100% (the knob bounds
+        # the reference-work budget from above)
+        s = RecallSentinel(np_reference(np.zeros((8, 4), np.float32)),
+                           sample=0.7, registry=reg, autostart=False)
+        assert s._every == 2
+        q = np.zeros((2, 4), np.float32)
+        taken = [s.offer(q, 2, None, np.zeros((2, 2), np.int32))
+                 for _ in range(6)]
+        assert taken == [True, False, True, False, True, False]
+
+    def test_rolling_estimates_per_family_and_engine(self, reg):
+        rng = np.random.default_rng(3)
+        data = rng.standard_normal((64, 8)).astype(np.float32)
+        q = data[:6]
+        d, i = _serve_result(data, q, 4)
+        bad = np.full_like(i, -1)
+        with RecallSentinel(np_reference(data), sample=1.0, window=8,
+                            registry=reg, family="famA",
+                            engine="e1") as s:
+            assert s.offer(q, 4, d, i, trace_id="t0")
+            s.offer(q, 4, None, bad, family="famB", engine="e2")
+            assert s.drain(30)
+        assert s.estimate("famA") == pytest.approx(1.0)
+        assert s.estimate("famB") == pytest.approx(0.0)
+        g = reg.snapshot()["gauges"]
+        assert g["serve.recall.famA"] == pytest.approx(1.0)
+        assert g["serve.recall.famA.e1"] == pytest.approx(1.0)
+        assert g["serve.recall.famB.e2"] == pytest.approx(0.0)
+        assert g["serve.recall.famA.samples"] == 1
+        snap = s.snapshot()
+        assert snap["families"]["famA"]["engines"]["e1"] == 1.0
+
+    def test_regression_event_once_per_crossing_and_rearm(self, reg):
+        data = np.random.default_rng(4).standard_normal(
+            (32, 8)).astype(np.float32)
+        q = data[:4]
+        d, i = _serve_result(data, q, 4)
+        bad = np.full_like(i, -1)
+        with RecallSentinel(np_reference(data), sample=1.0, floor=0.8,
+                            window=2, min_samples=1, registry=reg,
+                            family="f") as s:
+            s.offer(q, 4, d, i, trace_id="good")
+            assert s.drain(30)
+            assert not events.recent(kind="recall_regression")
+            s.offer(q, 4, None, bad, trace_id="bad1")
+            s.offer(q, 4, None, bad, trace_id="bad2")   # still below: no 2nd
+            assert s.drain(30)
+            evs = events.recent(kind="recall_regression")
+            assert len(evs) == 1
+            assert evs[0]["site"] == "serve.recall.f"
+            assert evs[0]["trace_id"] == "bad1"
+            assert evs[0]["floor"] == 0.8
+            # recovery re-arms the crossing detector
+            s.offer(q, 4, d, i)
+            s.offer(q, 4, d, i)
+            assert s.drain(30)
+            assert s.estimate("f") == pytest.approx(1.0)
+            s.offer(q, 4, None, bad, trace_id="bad3")
+            s.offer(q, 4, None, bad)
+            assert s.drain(30)
+        assert len(events.recent(kind="recall_regression")) == 2
+        assert reg.snapshot()["counters"]["serve.recall.regressions"] == 2
+
+    def test_saturated_queue_drops_never_blocks(self, reg):
+        """Micro-benchmark satellite: a stalled worker must cost drops,
+        not latency — and the disabled/enabled hot-path stays cheap."""
+        data = np.zeros((8, 4), np.float32)
+        s = RecallSentinel(np_reference(data), sample=1.0, max_pending=4,
+                           registry=reg, autostart=False)   # stalled worker
+        q = np.zeros((2, 4), np.float32)
+        i = np.zeros((2, 2), np.int32)
+        t0 = time.perf_counter()
+        n = 500
+        for _ in range(n):
+            s.offer(q, 2, None, i)
+        enabled_per_call = (time.perf_counter() - t0) / n
+        snap = s.snapshot()
+        assert snap["pending"] == 4
+        assert snap["dropped"] == n - 4
+        assert reg.snapshot()["counters"]["serve.recall.dropped"] == n - 4
+        # saturated offers must stay far below any blocking timescale
+        # (generous absolute bound: the 1-core CI box is noisy)
+        assert enabled_per_call < 2e-3, (
+            f"saturated offer cost {enabled_per_call:.2e}s/call — "
+            "the sentinel is blocking dispatch")
+        off = RecallSentinel(np_reference(data), sample=0.0, registry=reg)
+
+        def bench(fn, n=20000):
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    fn()
+                best = min(best, (time.perf_counter() - t0) / n)
+            return best
+
+        base = bench(lambda: None)
+        cost = bench(lambda: off.offer(q, 2, None, i))
+        assert cost - base < 20e-6, (
+            f"disabled sentinel offer overhead {cost - base:.2e}s/call — "
+            "the disabled path must be one flag check")
+        # stopped is not pressure: offers after close() return False but
+        # must NOT climb the dropped counter (a dashboard would read a
+        # stopped sentinel as a saturated one forever)
+        s.close()
+        dropped = reg.snapshot()["counters"]["serve.recall.dropped"]
+        assert not s.offer(q, 2, None, i)
+        assert reg.snapshot()["counters"]["serve.recall.dropped"] == dropped
+
+
+class TestHealth:
+    def test_cagra_health_connectivity_and_quant(self):
+        from raft_tpu.neighbors import cagra
+
+        n, deg = 64, 4
+        rng = np.random.default_rng(0)
+        data = rng.standard_normal((n, 8)).astype(np.float32)
+        # every node's edges stay in [0, 62]: node 63 has in-degree 0
+        g = (np.arange(n)[:, None] + np.arange(1, deg + 1)[None, :]) % (n - 1)
+        idx = cagra.Index(jax.numpy.asarray(data),
+                          jax.numpy.asarray(g.astype(np.int32)),
+                          cagra.DistanceType.L2Expanded)
+        h = cagra.health(idx)
+        assert h["family"] == "cagra" and h["n"] == n
+        assert h["graph_degree"] == deg
+        assert h["unreachable_nodes"] == 1
+        assert h["unseeded_unreachable"] == 1
+        assert h["in_degree"]["min"] == 0 and h["in_degree"]["mean"] > 0
+        # the connectivity summary caches on the index (a watched 1M
+        # index must not re-pull its whole graph every snapshot) ...
+        assert getattr(idx, "_health_conn_cache", None) is not None
+        # ... and invalidates when the seed set changes: a covering seed
+        # set claims the unreachable node
+        idx.seed_nodes = jax.numpy.asarray([63], jax.numpy.int32)
+        h2 = cagra.health(idx)
+        assert h2["unreachable_nodes"] == 1
+        assert h2["unseeded_unreachable"] == 0
+        # quantized traversal caches report MEASURED reconstruction error
+        cagra.prepare_search(idx, "int8")
+        cagra.prepare_search(idx, "bfloat16")
+        h3 = cagra.health(idx)
+        assert 0 < h3["quant"]["int8"]["rel_rmse"] < 0.02
+        assert 0 < h3["quant"]["bfloat16"]["rel_rmse"] < 0.02
+
+    def test_ivf_flat_health_skew_and_scales(self):
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.neighbors._list_layout import plan_offsets
+
+        sizes = np.array([10, 20, 30, 0], np.int64)
+        offsets = plan_offsets(sizes)
+        cap = int(offsets[-1])
+        sid = np.full(cap, -1, np.int32)
+        for l, (o, s) in enumerate(zip(offsets[:-1], sizes)):
+            sid[o:o + s] = np.arange(s)
+        idx = ivf_flat.Index(
+            data=np.zeros((cap, 8), np.int8),
+            data_norms=np.zeros(cap, np.float32),
+            source_ids=sid,
+            centers=np.zeros((4, 8), np.float32),
+            center_norms=np.zeros(4, np.float32),
+            list_offsets=offsets,
+            metric=ivf_flat.DistanceType.L2Expanded,
+            list_sizes_arr=sizes,
+            scales=np.full(cap, 0.25, np.float32))
+        h = ivf_flat.health(idx)
+        assert h["n"] == 60 and h["store_dtype"] == "int8"
+        lk = h["lists"]
+        assert lk["n_lists"] == 4 and lk["empty_lists"] == 1
+        assert lk["max"] == 30 and lk["max_over_mean"] == 2.0
+        assert h["quant"]["int8"]["max_abs_err_bound"] == 0.125
+
+    def test_ivf_pq_health_utilization(self):
+        from raft_tpu.neighbors import ivf_pq
+
+        cap, pq_dim, bits = 64, 4, 4
+        rng = np.random.default_rng(1)
+        codes = rng.integers(0, 16, size=(cap, pq_dim)).astype(np.uint8)
+        codes[:, 3] = 5          # one collapsed subspace
+        idx = ivf_pq.Index(
+            codes=jax.numpy.asarray(codes),
+            source_ids=jax.numpy.arange(cap, dtype=jax.numpy.int32),
+            centers_rot=jax.numpy.zeros((4, 8)),
+            codebooks=jax.numpy.zeros((pq_dim, 1 << bits, 2)),
+            rotation=jax.numpy.zeros((8, 8)),
+            list_offsets=np.array([0, 16, 32, 48, 64], np.int64),
+            metric=ivf_pq.DistanceType.L2Expanded,
+            pq_bits=bits,
+            codebook_kind=ivf_pq.CodebookGen.PER_SUBSPACE)
+        h = ivf_pq.health(idx)
+        assert h["pq"]["pq_dim"] == pq_dim and h["pq"]["book_size"] == 16
+        util = h["pq"]["codeword_utilization"]
+        assert util["min"] == pytest.approx(1 / 16)     # collapsed subspace
+        assert util["mean"] > 0.5
+        assert h["lists"]["rows"] == cap
+
+    def test_sharded_health_counts_and_flags(self):
+        from raft_tpu.parallel import sharded_ann
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("shard",))
+        idx = sharded_ann.ShardedCagra(
+            mesh, data=np.zeros((2, 8, 4), np.float32),
+            graphs=np.zeros((2, 8, 2), np.int32),
+            bases=np.array([0, 5], np.int32),
+            counts=np.array([5, 3], np.int32), n_total=8,
+            metric=sharded_ann.DistanceType.L2Expanded)
+        idx.mark_shard_failed(1)
+        h = quality.health(idx)      # the dispatcher route
+        assert h["family"] == "sharded_cagra"
+        assert h["shard_rows"] == [5, 3]
+        assert h["shards_ok"] == [True, False]
+        assert h["served_rows"] == 5
+        assert h["served_frac"] == pytest.approx(5 / 8)
+
+    def test_watch_index_weak_and_jsonl_export(self, tmp_path):
+        from raft_tpu.neighbors import brute_force
+
+        data = np.random.default_rng(2).standard_normal(
+            (32, 8)).astype(np.float32)
+        idx = brute_force.build(jax.numpy.asarray(data),
+                                dtype=jax.numpy.int8)
+        quality.watch_index("unit_bf", idx)
+        try:
+            snap = quality.health_snapshot()
+            assert snap["unit_bf"]["family"] == "brute_force"
+            assert "int8" in snap["unit_bf"]["quant"]
+            path = tmp_path / "health.jsonl"
+            assert quality.export_health_jsonl(str(path)) >= 1
+            line = json.loads(path.read_text().splitlines()[0])
+            assert line["index"] == "unit_bf" and line["family"] == "brute_force"
+            # debugz surfaces the same report
+            d = debugz.snapshot(registry=metrics.Registry())
+            assert d["health"]["unit_bf"]["n"] == 32
+            text = debugz.render_text(registry=metrics.Registry())
+            assert "index health" in text and "unit_bf" in text
+        finally:
+            quality.unwatch_index("unit_bf")
+        # weak: dropping the index drops the watch
+        quality.watch_index("gone", idx)
+        del idx
+        import gc
+
+        gc.collect()
+        assert "gone" not in quality.health_snapshot()
+        quality.unwatch_index("gone")
+
+
+class TestSLOEngine:
+    def test_burn_rate_windows_and_breach_transitions(self, reg):
+        now = {"t": 0.0}
+        eng = slo.SLOEngine(
+            slo.Targets(max_shed_rate=0.1), registry=reg, name="u",
+            fast_window_s=10.0, slow_window_s=60.0,
+            clock=lambda: now["t"])
+        req = reg.counter("u.requests")
+        shed = reg.counter("u.shed")
+        req.inc(100)
+        eng.tick()
+        now["t"] = 5.0
+        req.inc(100)
+        assert eng.evaluate()["verdict"] == "ok"
+        # a shed burst violates BOTH windows -> breach + ONE event
+        now["t"] = 12.0
+        req.inc(100)
+        shed.inc(50)
+        rep = eng.evaluate()
+        assert rep["targets"]["shed_rate"]["verdict"] == "breach"
+        assert rep["verdict"] == "breach"
+        assert len(events.recent(kind="slo_breach")) == 1
+        assert events.recent(kind="slo_breach")[0]["site"] == "u.slo.shed_rate"
+        # still breached: no duplicate event
+        now["t"] = 13.0
+        eng.evaluate()
+        assert len(events.recent(kind="slo_breach")) == 1
+        # fast window recovers first: warn (burning off), then ok
+        now["t"] = 30.0
+        req.inc(200)
+        rep = eng.evaluate()
+        assert rep["targets"]["shed_rate"]["verdict"] == "warn"
+        now["t"] = 100.0
+        req.inc(100)
+        rep = eng.evaluate()
+        assert rep["targets"]["shed_rate"]["verdict"] == "ok"
+        assert reg.snapshot()["counters"]["u.slo.breaches"] == 1
+
+    def test_windowed_latency_p99(self, reg):
+        now = {"t": 0.0}
+        eng = slo.SLOEngine(
+            slo.Targets(p99_latency_s=0.5), registry=reg, name="u",
+            fast_window_s=10.0, slow_window_s=10.0,
+            clock=lambda: now["t"])
+        h = reg.histogram("u.latency_s")
+        for _ in range(100):
+            h.observe(0.001)
+        eng.tick()
+        now["t"] = 20.0
+        assert eng.evaluate()["targets"]["p99_latency_s"]["verdict"] == "ok"
+        # the RECENT window is slow even though the lifetime p99 is fine
+        for _ in range(50):
+            h.observe(2.0)
+        now["t"] = 40.0
+        rep = eng.evaluate()["targets"]["p99_latency_s"]
+        assert rep["fast"] > 0.5 and rep["verdict"] == "breach"
+
+    def test_recall_target_gates_on_samples(self, reg):
+        eng = slo.SLOEngine(
+            slo.Targets(recall_floor=0.9, recall_family="f",
+                        recall_min_samples=2), registry=reg, name="u")
+        rep = eng.evaluate()["targets"]["recall"]
+        assert rep["verdict"] == "ok" and rep["note"] == "insufficient_samples"
+        reg.gauge("u.recall.f").set(0.95)
+        reg.gauge("u.recall.f.samples").set(8)
+        assert eng.evaluate()["targets"]["recall"]["verdict"] == "ok"
+        reg.gauge("u.recall.f").set(0.91)
+        assert eng.evaluate()["targets"]["recall"]["verdict"] == "warn"
+        reg.gauge("u.recall.f").set(0.5)
+        rep = eng.evaluate()
+        assert rep["targets"]["recall"]["verdict"] == "breach"
+        assert events.recent(kind="slo_breach")[-1]["site"] == "u.slo.recall"
+        # installed engine rides into the debugz snapshot
+        eng.install()
+        try:
+            snap = debugz.snapshot(registry=reg)
+            assert snap["slo"]["targets"]["recall"]["verdict"] == "breach"
+            assert "-- slo (breach) --" in debugz.render_text(registry=reg)
+        finally:
+            slo.uninstall()
+
+
+class TestEventsScrub:
+    def test_to_jsonl_hostile_payloads_never_raise(self, tmp_path):
+        events.record(
+            "hostile", "unit.site",
+            nanv=float("nan"), infv=float("inf"), neg=-float("inf"),
+            arr=np.arange(5, dtype=np.int32),
+            big=np.zeros((100, 100), np.float32),
+            npf=np.float32(1.5), npi=np.int64(7),
+            exc=ValueError("boom"),
+            nested={"x": [float("nan"), 1.0], 3: (np.float64("inf"),)},
+            obj=object())
+        line = events.to_jsonl(kind="hostile")
+        assert "NaN" not in line and "Infinity" not in line
+        rec = json.loads(line)
+        assert rec["nanv"] is None and rec["infv"] is None
+        assert rec["arr"] == [0, 1, 2, 3, 4]
+        assert rec["big"].startswith("array(shape=(100, 100)")
+        assert rec["npf"] == 1.5 and rec["npi"] == 7
+        assert rec["exc"] == "ValueError: boom"
+        assert rec["nested"]["x"] == [None, 1.0]
+        assert rec["nested"]["3"] == [None]
+        path = tmp_path / "ev.jsonl"
+        assert events.export_jsonl(str(path)) >= 1
+        for ln in path.read_text().splitlines():
+            json.loads(ln)
+        # the debugz snapshot stays strict-JSON-safe with these in the ring
+        json.dumps(debugz.snapshot(registry=metrics.Registry()),
+                   allow_nan=False)
+
+
+class TestGuardedDriftGuard:
+    # the sites the current tree must keep gated; the sweep below also
+    # catches NEW guarded_call sites automatically
+    KNOWN = {"select_k.kpass", "ivf_flat.scan", "ivf_pq.scan",
+             "brute_force.fused", "cagra.graph_expand", "cagra.nn_descent",
+             "sharded.ring_topk"}
+
+    def _discover_sites(self):
+        import raft_tpu
+
+        root = pathlib.Path(raft_tpu.__file__).parent
+        sites = set()
+        for p in root.rglob("*.py"):
+            src = p.read_text()
+            sites |= set(re.findall(r'guarded_call\(\s*\n?\s*"([^"]+)"', src))
+            # constants passed as the site argument (the sharded merge)
+            sites |= set(re.findall(r'^MERGE_SITE\s*=\s*"([^"]+)"', src,
+                                    re.MULTILINE))
+        return sites
+
+    def test_every_site_emits_event_and_counters_on_demotion(self):
+        """Every guarded_call site in the tree, demoted, must land in the
+        flight recorder AND the (total + per-site) demotion counters —
+        the quality alarm's precondition: a silent demotion is exactly
+        the failure mode the recall sentinel exists to catch."""
+        from raft_tpu.ops import guarded
+
+        if any(f.kind == "kernel_compile" for f in faults.active()):
+            pytest.skip("ambient kernel faults are served as injected "
+                        "(non-demoting) failures")
+        sites = self._discover_sites()
+        assert self.KNOWN <= sites, (
+            f"guarded sites missing from source sweep: {self.KNOWN - sites}")
+        pre_demoted = set(guarded.demoted_sites())
+        try:
+            for site in sorted(sites - pre_demoted):
+                total0 = metrics.counter("guarded.demotions").value
+                site0 = metrics.counter(f"guarded.demotions.{site}").value
+
+                def boom():
+                    raise RuntimeError("drift-guard drill")
+
+                assert guarded.guarded_call(site, boom, lambda: "fb") == "fb"
+                assert site in guarded.demoted_sites()
+                evs = [e for e in events.recent(kind="guarded_demotion")
+                       if e["site"] == site]
+                assert evs, f"site {site} demoted without a ring event"
+                assert metrics.counter("guarded.demotions").value \
+                    == total0 + 1, f"site {site}: total counter"
+                assert metrics.counter(
+                    f"guarded.demotions.{site}").value == site0 + 1, \
+                    f"site {site}: per-site counter"
+        finally:
+            guarded.reset()
+
+
+class TestAcceptanceDrill:
+    """ISSUE 9 acceptance: fault-injected demotion drill on a quantized
+    CAGRA searcher -> measurable serve.recall drop + trace-stamped
+    recall_regression + SLO breach in the debugz snapshot."""
+
+    def test_end_to_end_quality_alarm(self, reg):
+        from raft_tpu.neighbors import brute_force, cagra
+        from raft_tpu.ops import guarded
+
+        if any(f.kind == "kernel_compile" for f in faults.active()):
+            pytest.skip("ambient kernel faults would degrade the healthy "
+                        "phase too")
+        rng = np.random.default_rng(7)
+        centers = rng.standard_normal((8, DIM)).astype(np.float32) * 4.0
+        labels = rng.integers(0, 8, size=400)
+        data = (centers[labels]
+                + rng.standard_normal((400, DIM))).astype(np.float32)
+        q = (centers[rng.integers(0, 8, size=96)]
+             + rng.standard_normal((96, DIM))).astype(np.float32)
+
+        # the QUANTIZED cagra searcher (int8 traversal scoring)
+        index = cagra.build(data, cagra.IndexParams(
+            graph_degree=8, intermediate_graph_degree=16, seed=0,
+            seed_nodes=0))
+        sp = cagra.SearchParams(itopk_size=32, candidate_dtype="int8")
+        good = cagra.make_searcher(index, sp)
+        # the degraded mode a demotion serves: a stale quarter-corpus
+        # replica (the partial-replica analog of a dead shard)
+        stale = brute_force.build(jax.numpy.asarray(data[:100]))
+
+        def serving(queries, k, res=None):
+            return guarded.guarded_call(
+                "drill.cagra.search",
+                lambda: good(queries, k, res),
+                lambda: brute_force.search(stale, queries, k))
+
+        sentinel = RecallSentinel(
+            np_reference(data), sample=1.0, floor=0.7, window=6,
+            min_samples=3, max_pending=32, registry=reg,
+            family="cagra", engine="int8")
+        eng = slo.SLOEngine(
+            slo.Targets(recall_floor=0.7, recall_family="cagra",
+                        recall_min_samples=3),
+            registry=reg, name="serve")
+        quality.watch_index("drill_cagra", index)
+        b = MicroBatcher(serving, DIM, ladder=BucketLadder((8,), (8,)),
+                         registry=reg, max_wait_s=0.001, sentinel=sentinel)
+        try:
+            # phase A: healthy quantized serving
+            for j in range(6):
+                b.search(q[8 * j: 8 * (j + 1)], 8, timeout=120)
+            assert sentinel.drain(60)
+            est_good = sentinel.estimate("cagra")
+            assert est_good is not None and est_good >= 0.75, est_good
+            rep = eng.evaluate()
+            assert rep["targets"]["recall"]["verdict"] == "ok"
+            assert not events.recent(kind="recall_regression")
+
+            # phase B: the demotion drill — every call served through
+            # the degraded fallback
+            drill_reqs = []
+            with faults.inject("kernel_compile", "drill.cagra.search"):
+                for j in range(6, 12):
+                    r = b.submit(q[8 * j: 8 * (j + 1)], 8)
+                    r.result(120)
+                    drill_reqs.append(r)
+            assert sentinel.drain(60)
+            est_bad = sentinel.estimate("cagra")
+            # a MEASURABLE serve.recall drop, visible in the gauge too
+            assert est_bad < 0.6 and est_good - est_bad >= 0.2, \
+                (est_good, est_bad)
+            assert reg.snapshot()["gauges"]["serve.recall.cagra"] \
+                == pytest.approx(est_bad)
+
+            # trace-stamped recall_regression: the crossing sample's
+            # trace ID belongs to one of the drill requests
+            evs = events.recent(kind="recall_regression")
+            assert len(evs) == 1
+            assert evs[0]["site"] == "serve.recall.cagra"
+            assert evs[0]["trace_id"] in {r.trace_id for r in drill_reqs}
+            assert evs[0]["estimate"] < 0.7
+            # the injected fault is on the record (and did NOT demote)
+            assert any(e["site"] == "drill.cagra.search"
+                       for e in events.recent(kind="fault_injected"))
+            assert "drill.cagra.search" not in guarded.demoted_sites()
+
+            # SLO breach verdict in the debugz snapshot, end to end
+            snap = debugz.snapshot(batcher=b, registry=reg, slo=eng)
+            assert snap["slo"]["verdict"] == "breach"
+            assert snap["slo"]["targets"]["recall"]["verdict"] == "breach"
+            assert snap["health"]["drill_cagra"]["family"] == "cagra"
+            assert "int8" in snap["health"]["drill_cagra"]["quant"]
+            qsec = {s2["name"]: s2 for s2 in snap["quality"]}
+            assert qsec["serve"]["families"]["cagra"]["below_floor"]
+            assert any(e["kind"] == "slo_breach" for e in snap["events"])
+            json.dumps(snap, allow_nan=False)
+            text = debugz.render_text(batcher=b, registry=reg, slo=eng)
+            assert "BELOW FLOOR" in text and "recall: breach" in text
+        finally:
+            b.close()
+            sentinel.close()
+            quality.unwatch_index("drill_cagra")
