@@ -753,11 +753,29 @@ def main():
                                   registry=reg_serve, family="ivf_flat",
                                   engine=f"nprobe{best_probes}",
                                   window=64, max_pending=16)
+        # robustness half of the lane (docs/robustness.md): an SLO
+        # engine + brownout controller ride along so a run that browned
+        # out (stepped the degradation ladder) is distinguishable from a
+        # clean one — the artifact records every level transition and
+        # the final circuit-breaker states next to the stage
+        # decomposition. Targets are generous (2x the ivf_flat lane's
+        # typical p99) so a healthy run records zero transitions.
+        from raft_tpu.ops import guarded as serve_guarded
+        from raft_tpu.serve.degrade import BrownoutController
+        from raft_tpu.serve.slo import SLOEngine, Targets
+        slo_serve = SLOEngine(
+            Targets(p99_latency_s=0.5, recall_floor=0.9,
+                    recall_family="ivf_flat", recall_min_samples=4),
+            registry=reg_serve, name="serve",
+            fast_window_s=2.0, slow_window_s=6.0)
+        brownout = BrownoutController(
+            [{"max_wait_scale": 2.0}], slo=slo_serve,
+            registry=reg_serve, min_dwell_s=2.0)
         b = MicroBatcher(serve_search, d,
                          ladder=BucketLadder((16, 64), (kb_serve,)),
                          registry=reg_serve, name="serve",
                          trace_sample=1.0, max_wait_s=0.002,
-                         sentinel=sentinel)
+                         sentinel=sentinel, degrade=brownout)
         try:
             warm_compiles = b.warmup()
             rng_s = np.random.default_rng(11)
@@ -767,14 +785,17 @@ def main():
                 p=[.3, .2, .2, .15, .1, .05])
             t0 = time.perf_counter()
             inflight = []
-            for m in req_sizes:
+            for i_req, m in enumerate(req_sizes):
                 s0 = int(rng_s.integers(0, len(qhost) - int(m)))
                 inflight.append(b.submit(qhost[s0:s0 + int(m)], k))
                 if len(inflight) >= inflight_cap:
                     inflight.pop(0).result(300)
+                if (i_req + 1) % 50 == 0:
+                    brownout.poll()     # the serving maintenance tick
             for r in inflight:
                 r.result(300)
             serve_wall = time.perf_counter() - t0
+            brownout.poll()
         finally:
             b.close()
             sentinel.drain(120.0)
@@ -811,6 +832,14 @@ def main():
                  "scored": sent_snap["scored"],
                  "dropped": sent_snap["dropped"],
                  "sample_rate": 0.25},
+             # a silently-browned-out run must be distinguishable from
+             # a clean one: final ladder level + every transition, and
+             # the final breaker state of every site that opened
+             "brownout": {
+                 "level": brownout.level,
+                 "transitions": brownout.snapshot()["transitions"]},
+             "breakers": {site: ent["state"] for site, ent in
+                          serve_guarded.breaker_snapshot().items()},
              "recall_source": flat_name, "trace_sample": 1.0},
             batch=n_req, baseline_key=None)
 

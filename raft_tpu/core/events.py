@@ -28,7 +28,11 @@ Well-known kinds (open set — emitters define meaning):
 ``guarded_demotion``, ``fault_injected``, ``deadline_shed``,
 ``deadline_exceeded``, ``dispatch_error``, ``shard_marked``,
 ``autotune_verdict``, ``xla_compile``, ``corrupt_index``,
-``recall_regression``, ``slo_breach``.
+``recall_regression``, ``slo_breach`` — and the self-healing set
+(docs/robustness.md): ``breaker_open`` / ``breaker_probe`` /
+``breaker_close`` (ops/guarded circuit breakers), ``shard_restored``
+(sharded_ann.probe_shards), ``brownout`` (serve/degrade ladder moves),
+``fault_scenario`` (timed chaos-drill stage transitions).
 
 Details are scrubbed JSON-safe at record time: non-finite floats become
 None, numpy scalars/arrays become python values/lists (large arrays a

@@ -14,9 +14,13 @@ Spec grammar (``RAFT_TPU_FAULTS``, comma-separated)::
 
     kind@pattern[:count][=value]
 
-* ``kind`` — fault kind a probe asks about: ``kernel_compile``,
-  ``shard_dead``, ``shard_timeout``, ``corrupt_bytes``, ``io_error``,
-  ``slow_dispatch`` (kinds are open strings; probes define meaning).
+* ``kind`` — fault kind a probe asks about: ``kernel_compile`` (a
+  per-call simulated kernel failure — never moves a circuit breaker),
+  ``kernel_fault`` (a simulated *persistent* kernel failure: drives the
+  ``ops/guarded`` breaker open and keeps its probes failing while
+  armed), ``shard_dead``, ``shard_timeout``, ``corrupt_bytes``,
+  ``io_error``, ``slow_dispatch`` (kinds are open strings; probes
+  define meaning).
 * ``pattern`` — fnmatch pattern over the site name (default ``*``).
 * ``count`` — fire at most this many times (default unlimited).
 * ``value`` — kind-specific argument (sleep seconds for
@@ -33,6 +37,13 @@ In-process, prefer the :func:`inject` context manager — it is scoped,
 composable and needs no env round trip. Probes are cheap when nothing is
 armed (one lock-free list check), so library sites stay probed in
 production builds.
+
+For multi-phase chaos drills, :class:`Scenario` sequences timed stages
+(arm → hold → clear) against an injectable clock, so one deterministic
+script can drive a whole failure-and-recovery arc — inject a kernel
+fault and a dead shard, hold them while breakers open and the brownout
+ladder engages, clear them and watch the probes restore baseline
+(docs/robustness.md "Chaos drills").
 """
 from __future__ import annotations
 
@@ -47,7 +58,8 @@ from typing import List, Optional
 from .errors import RaftError
 
 __all__ = ["InjectedFault", "Fault", "inject", "fired", "check", "sleep_if",
-           "corrupt", "active", "seen_sites", "reload_env", "reset_stats"]
+           "corrupt", "active", "seen_sites", "reload_env", "reset_stats",
+           "Scenario"]
 
 
 class InjectedFault(RaftError):
@@ -225,3 +237,126 @@ def reset_stats() -> None:
         _seen_sites.clear()
         for f in _injected + _env_faults:
             f.fires = 0
+
+
+@dataclasses.dataclass
+class _Stage:
+    """One timed stage of a :class:`Scenario`: a fault armed at
+    ``at_s`` (relative to scenario start) and cleared at ``until_s``
+    (None = held until :meth:`Scenario.stop`)."""
+
+    fault: Fault
+    at_s: float
+    until_s: Optional[float]
+    armed: bool = False
+    done: bool = False
+
+
+class Scenario:
+    """A timed fault scenario: stages arm → hold → clear on a shared
+    clock, applied by explicit :meth:`step` calls — deterministic under
+    an injectable clock (no timer threads; tests step a fake clock, a
+    serving loop calls ``step`` from its maintenance tick).
+
+    ::
+
+        sc = (faults.Scenario()
+              .add("kernel_fault", "cagra.*", at_s=0.0, until_s=5.0)
+              .add("shard_dead", "*.shard1", at_s=1.0, until_s=5.0)
+              .start())
+        ...
+        sc.step()    # applies any due arms/clears; returns transitions
+
+    Stages use the same :class:`Fault` machinery as :func:`inject`
+    (thread-shared, composable with env-armed faults). Each transition
+    is flight-recorded as a ``fault_scenario`` event, so the drill's
+    timeline is readable next to the breaker/brownout events it
+    provokes. Context-manager form clears everything on exit."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._stages: List[_Stage] = []
+        self._t0: Optional[float] = None
+
+    def add(self, kind: str, pattern: str = "*", *, at_s: float = 0.0,
+            until_s: Optional[float] = None, count: Optional[int] = None,
+            value=None) -> "Scenario":
+        if until_s is not None and until_s < at_s:
+            raise ValueError(
+                f"stage {kind}@{pattern}: until_s {until_s} < at_s {at_s}")
+        self._stages.append(_Stage(
+            Fault(kind, pattern, count, None if value is None else
+                  str(value)), float(at_s), until_s))
+        return self
+
+    def start(self) -> "Scenario":
+        if self._t0 is not None:
+            raise RuntimeError("scenario already started")
+        self._t0 = self._clock()
+        self.step()
+        return self
+
+    def elapsed_s(self) -> float:
+        if self._t0 is None:
+            return 0.0
+        return self._clock() - self._t0
+
+    def step(self) -> List[str]:
+        """Apply every stage transition whose time has come; returns
+        human-readable transition descriptions (empty when nothing was
+        due)."""
+        if self._t0 is None:
+            raise RuntimeError("scenario not started")
+        now_s = self._clock() - self._t0
+        out: List[str] = []
+        for st in self._stages:
+            if not st.armed and not st.done and now_s >= st.at_s:
+                with _lock:
+                    _injected.append(st.fault)
+                st.armed = True
+                out.append(f"armed {st.fault.kind}@{st.fault.pattern}")
+                self._emit("armed", st, now_s)
+            if st.armed and st.until_s is not None and now_s >= st.until_s:
+                self._clear(st, now_s, out)
+        return out
+
+    def _clear(self, st: _Stage, now_s: float, out: List[str]) -> None:
+        with _lock:
+            if st.fault in _injected:
+                _injected.remove(st.fault)
+        st.armed = False
+        st.done = True
+        out.append(f"cleared {st.fault.kind}@{st.fault.pattern}")
+        self._emit("cleared", st, now_s)
+
+    def _emit(self, action: str, st: _Stage, now_s: float) -> None:
+        try:
+            from . import events as _events
+
+            _events.record("fault_scenario",
+                           f"{st.fault.kind}@{st.fault.pattern}",
+                           action=action, at_s=round(now_s, 3),
+                           fires=st.fault.fires)
+        except Exception:  # noqa: BLE001 - telemetry must not change
+            pass           # fault semantics
+
+    def finished(self) -> bool:
+        """True once every stage has been armed and cleared."""
+        return all(st.done for st in self._stages)
+
+    def stop(self) -> None:
+        """Clear every still-armed stage (and mark pending ones done)."""
+        if self._t0 is None:
+            return
+        now_s = self._clock() - self._t0
+        out: List[str] = []
+        for st in self._stages:
+            if st.armed:
+                self._clear(st, now_s, out)
+            st.done = True
+
+    def __enter__(self) -> "Scenario":
+        return self.start() if self._t0 is None else self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1431,7 +1431,8 @@ def health(index: Index, sample: int = 256) -> dict:
     return report
 
 
-def make_searcher(index: Index, params: SearchParams | None = None, **opts):
+def make_searcher(index: Index, params: SearchParams | None = None, *,
+                  degrade=None, **opts):
     """Stable batchable signature for the serving runtime
     (:mod:`raft_tpu.serve`): returns ``fn(queries, k, res=None) ->
     (distances, indices)`` with the traversal policy frozen at closure
@@ -1440,13 +1441,18 @@ def make_searcher(index: Index, params: SearchParams | None = None, **opts):
     ``query_chunk``, ``engine``, ...). Pinning ``engine="edge"`` (via
     opts or ``params.engine``) builds the edge-resident candidate store
     at closure-build time, not on the first request — serve warmup then
-    only pays the per-shape compiles."""
+    only pays the per-shape compiles. ``degrade``: a
+    :class:`~raft_tpu.serve.degrade.BrownoutController` — under brownout
+    its current level overrides ``itopk_size``/``search_width`` per call
+    (docs/robustness.md)."""
     eng = opts.get("engine") or (params.engine if params is not None
                                  else None)
     if eng == "edge":
         prepare_traversal(index)
+    base = params or SearchParams()
 
     def _fn(queries, k, res=None):
-        return search(index, queries, k, params, res=res, **opts)
+        p = base if degrade is None else degrade.params(base)
+        return search(index, queries, k, p, res=res, **opts)
 
     return _fn

@@ -870,15 +870,21 @@ def health(index: Index, sample: int = 256) -> dict:
     return report
 
 
-def make_searcher(index: Index, params: SearchParams | None = None, **opts):
+def make_searcher(index: Index, params: SearchParams | None = None, *,
+                  degrade=None, **opts):
     """Stable batchable signature for the serving runtime
     (:mod:`raft_tpu.serve`): returns ``fn(queries, k, res=None) ->
     (distances, indices)`` with the probe/LUT policy frozen at closure
     build time, so repeated bucketed-shape calls hit the same cached
     executables. ``opts`` forwards to :func:`search` (``algo``,
-    ``filter``, ``precision``, ``query_chunk``, ...)."""
+    ``filter``, ``precision``, ``query_chunk``, ...). ``degrade``: a
+    :class:`~raft_tpu.serve.degrade.BrownoutController` — under brownout
+    its current level overrides ``n_probes`` per call
+    (docs/robustness.md)."""
+    base = params or SearchParams()
 
     def _fn(queries, k, res=None):
-        return search(index, queries, k, params, res=res, **opts)
+        p = base if degrade is None else degrade.params(base)
+        return search(index, queries, k, p, res=res, **opts)
 
     return _fn

@@ -1,31 +1,64 @@
 """Guarded kernel dispatch: Pallas engines fall back to their XLA-path
-equivalents on compile/execution failure.
+equivalents on compile/execution failure — and probe their way back.
 
 Every custom-kernel engine in this library has an exact composed-XLA
 equivalent (that is what the parity tests assert; gated sites today:
 ``select_k`` KPASS, the ivf_flat/ivf_pq scans, ``brute_force.fused``,
-``cagra.graph_expand`` → the XLA gather hop, and the sharded merge's
+``cagra.graph_expand`` → the XLA gather hop, ``cagra.nn_descent`` → the
+exact/ivf_pq graph builders, and the sharded merge's
 ``sharded.ring_topk`` → the allgather + ``knn_merge_parts`` program),
-so a Pallas failure —
-a Mosaic lowering bug on a new chip generation, a scoped-VMEM
-compile-OOM on an unrehearsed shape, a driver hiccup — should cost one
-log line and a slower call, never the request or the process. The
-reference hard-fails on kernel errors (RAFT_CUDA_TRY); a serving stack
-cannot.
+so a Pallas failure — a Mosaic lowering bug on a new chip generation, a
+scoped-VMEM compile-OOM on an unrehearsed shape, a driver hiccup —
+should cost one log line and a slower call, never the request or the
+process. The reference hard-fails on kernel errors (RAFT_CUDA_TRY); a
+serving stack cannot.
 
-``guarded_call(site, primary, fallback)`` is the single chokepoint:
+``guarded_call(site, primary, fallback)`` is the single chokepoint.
+Since ISSUE 10 each site is a **circuit breaker**, not a sticky
+demotion — a transient driver fault must not cost the kernel path for
+the life of the process (docs/robustness.md):
 
-* a **demoted** site (prior failure this process, or a ``guard:…`` entry
-  in the autotune cache) skips the kernel path entirely;
-* fault-injection probes (:mod:`raft_tpu.core.faults`) fire first, so
-  every fallback path is deterministically testable
-  (``RAFT_TPU_FAULTS='kernel_compile@*'``);
-* a real failure logs ONCE per site, records the demotion in the
-  autotune cache (in-process always; persisted to the cross-process
-  cache only when ``RAFT_TPU_GUARD_PERSIST=1``, so a transient failure
-  cannot poison future processes by default), and serves the fallback;
-* injected faults never demote — they simulate per-call failure, and a
-  simulation must not change later dispatch decisions.
+* **closed** (healthy): fault probes fire first, then the kernel path
+  runs; a real failure transitions to *open*.
+* **open** (contained): every call serves the fallback. After the
+  probation window (``RAFT_TPU_GUARD_PROBE_AFTER_S``, default 30 s;
+  ``<= 0`` restores the pre-ISSUE-10 sticky demotion) the breaker
+  half-opens.
+* **half-open**: exactly ONE call is let through the kernel path as a
+  probe (concurrent callers keep the fallback). Probe success →
+  *closed* (the demotion verdict is forgotten, in-process and on disk);
+  probe failure → *open* again with the backoff doubled, capped at
+  ``RAFT_TPU_GUARD_MAX_BACKOFF_S`` (default 600 s).
+
+Fault-injection semantics (:mod:`raft_tpu.core.faults`):
+
+* ``kernel_compile`` keeps the PR 1 per-call contract: the fallback
+  serves THIS call only and the breaker does not move — a simulation
+  must not change later dispatch decisions.
+* ``kernel_fault`` simulates a *persistent* kernel failure: it drives
+  the breaker (open → probe → re-open while armed, re-close once
+  cleared) so the whole recovery arc is deterministically drillable.
+  Injected opens are never persisted to the cross-process autotune
+  cache, and the probe machinery guarantees they never outlive the
+  armed fault — an injected fault can never open a breaker permanently.
+* a probe call treats ANY injected fault as a probe failure (the probe
+  asks "does the kernel path work *now*", and an armed simulation says
+  no).
+
+A real failure logs once per site, records the demotion in the autotune
+cache (in-process always; persisted to the cross-process cache only
+when ``RAFT_TPU_GUARD_PERSIST=1``, so a transient failure cannot poison
+future processes by default — a persisted entry seeds the next
+process's breaker *open*, so it too probes and recovers), and serves
+the fallback. Transitions are flight-recorded (``breaker_open`` /
+``breaker_probe`` / ``breaker_close``; the site's first open this
+process also keeps the PR 6 ``guarded_demotion`` event) and gauged
+per site (``guarded.breaker.<site>``: 0 closed / 1 half-open / 2 open).
+
+All breaker state lives behind one lock: serving threads mutate it
+mid-dispatch while background ``SnapshotWriter`` threads read
+:func:`breaker_snapshot` — the bare-module-dict race the PR 8 SLOEngine
+fix already covered for SLO state.
 
 Trace caveat: when the guarded call happens inside an outer ``jit``
 trace, the kernel's own compilation may be deferred to the outer
@@ -35,19 +68,92 @@ dispatch (the serving pattern) is fully covered.
 """
 from __future__ import annotations
 
+import dataclasses
 import os
-from typing import Callable, Dict
+import threading
+import time
+from typing import Callable, Dict, Optional
 
 import jax
 
 from ..core import faults, logging as rlog
 from ..core.deadline import DeadlineExceeded
 from ..core.interruptible import InterruptedException
+from ..utils import env_float
 
-__all__ = ["guarded_call", "demoted_sites", "reset"]
+__all__ = ["guarded_call", "demoted_sites", "breaker_snapshot", "reset",
+           "BreakerPolicy", "POLICIES", "DEFAULT_POLICY"]
 
-# site -> reason string; demoted sites dispatch straight to the fallback
-_DEMOTED: Dict[str, str] = {}
+# breaker state -> reported gauge value (guarded.breaker.<site>)
+_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class BreakerPolicy:
+    """Per-site recovery policy. ``None`` fields defer to the env knobs
+    (``RAFT_TPU_GUARD_PROBE_AFTER_S`` / ``RAFT_TPU_GUARD_MAX_BACKOFF_S``)
+    so one operator knob retunes the whole fleet while a site that needs
+    a different cadence can pin its own."""
+
+    probe_after_s: Optional[float] = None
+    max_backoff_s: Optional[float] = None
+
+
+DEFAULT_POLICY = BreakerPolicy()
+
+# every guarded_call site ships a breaker policy; the drift-guard test
+# (tests/test_quality.py) fails the suite when a new site is added
+# without one — a gated kernel without a rehearsed demote→probe→recover
+# arc is exactly the untested failure path this module exists to close
+POLICIES: Dict[str, BreakerPolicy] = {
+    "select_k.kpass": DEFAULT_POLICY,
+    "ivf_flat.scan": DEFAULT_POLICY,
+    "ivf_pq.scan": DEFAULT_POLICY,
+    "brute_force.fused": DEFAULT_POLICY,
+    "cagra.graph_expand": DEFAULT_POLICY,
+    "cagra.nn_descent": DEFAULT_POLICY,
+    # the ring merge compiles per mesh shape; probing it re-runs a whole
+    # shard_map program, so keep the default (not a tighter) cadence
+    "sharded.ring_topk": DEFAULT_POLICY,
+}
+
+
+@dataclasses.dataclass
+class _Breaker:
+    """One site's circuit-breaker state (mutated only under _lock)."""
+
+    state: str = "closed"           # closed | open | half_open
+    reason: str = ""
+    opened_at: float = 0.0
+    backoff_s: float = 0.0
+    next_probe_at: float = 0.0
+    opens: int = 0                  # open transitions this process
+    probes: int = 0                 # probe attempts this process
+    closes: int = 0                 # probe successes this process
+    injected: bool = False          # last open caused by an injected fault
+    probing: bool = False           # a probe call is in flight
+
+
+_lock = threading.Lock()
+_BREAKERS: Dict[str, _Breaker] = {}
+_LOGGED: set = set()                # sites whose first open was logged
+
+# injectable for deterministic recovery drills (tests monkeypatch)
+_clock = time.monotonic
+
+
+def _probe_after_s(site: str) -> float:
+    p = POLICIES.get(site, DEFAULT_POLICY)
+    if p.probe_after_s is not None:
+        return float(p.probe_after_s)
+    return env_float("RAFT_TPU_GUARD_PROBE_AFTER_S", 30.0)
+
+
+def _max_backoff_s(site: str) -> float:
+    p = POLICIES.get(site, DEFAULT_POLICY)
+    if p.max_backoff_s is not None:
+        return float(p.max_backoff_s)
+    return env_float("RAFT_TPU_GUARD_MAX_BACKOFF_S", 600.0)
 
 
 def _guard_key(site: str) -> str:
@@ -56,72 +162,266 @@ def _guard_key(site: str) -> str:
     return f"{dev.platform}:{kind}:guard:{site}"
 
 
-def _demote(site: str, err: Exception, persist: bool) -> None:
+def _set_state_gauge(site: str, state: str) -> None:
+    try:
+        from ..serve import metrics as serve_metrics
+
+        serve_metrics.gauge(f"guarded.breaker.{site}").set(
+            _STATE_VALUE[state])
+    except Exception:  # noqa: BLE001 - telemetry must not break containment
+        pass
+
+
+def _emit(kind: str, site: str, **details) -> None:
+    try:
+        from ..core import events as core_events
+
+        core_events.record(kind, site, **details)
+    except Exception:  # noqa: BLE001 - telemetry must not break containment
+        pass
+
+
+def _admit(site: str):
+    """Dispatch decision for one call: ``"kernel"`` (closed — run the
+    kernel path), ``"fallback"`` (open/another probe in flight), or
+    ``"probe"`` (this call IS the half-open probe). Seeds a breaker in
+    the *open* state from a persisted ``guard:`` autotune verdict, so a
+    prior process's demotion still probes and recovers here."""
     from . import autotune
 
-    first = site not in _DEMOTED
-    _DEMOTED[site] = f"{type(err).__name__}: {err}"
+    probe_info = None
+    with _lock:
+        b = _BREAKERS.get(site)
+    if b is None:
+        # the persisted-verdict lookup can hit the disk cache on first
+        # use — keep it OUTSIDE the lock so one cold lookup cannot stall
+        # every concurrent guarded dispatch on every site
+        if autotune.lookup(_guard_key(site)) != "fallback":
+            return "kernel"
+        backoff = _probe_after_s(site)
+    with _lock:
+        if b is None:
+            b = _BREAKERS.get(site)   # re-check: another thread may have
+            if b is None:             # seeded or opened it meanwhile
+                now = _clock()
+                b = _Breaker(state="open",
+                             reason="persisted demotion (autotune cache)",
+                             opened_at=now, backoff_s=backoff,
+                             next_probe_at=now + backoff, opens=1)
+                _BREAKERS[site] = b
+        if b.state == "closed":
+            return "kernel"
+        probe_after = _probe_after_s(site)
+        now = _clock()
+        if (b.state == "open" and probe_after > 0 and not b.probing
+                and now >= b.next_probe_at):
+            b.state = "half_open"
+            b.probing = True
+            b.probes += 1
+            probe_info = {"attempt": b.probes,
+                          "open_for_s": round(now - b.opened_at, 3)}
+        elif b.state == "half_open" and not b.probing:
+            # defensive: a half-open breaker with no probe in flight
+            # re-arms as open rather than stranding half-open
+            b.state = "open"
+    if probe_info is None:
+        return "fallback"
+    _set_state_gauge(site, "half_open")
+    _emit("breaker_probe", site, **probe_info)
+    try:
+        from ..serve import metrics as serve_metrics
+
+        serve_metrics.counter(f"guarded.breaker.probes.{site}").inc()
+    except Exception:  # noqa: BLE001
+        pass
+    return "probe"
+
+
+def _on_failure(site: str, err: Exception, injected: bool) -> None:
+    """closed → open, or half_open → open with the backoff doubled."""
+    from . import autotune
+
+    with _lock:
+        b = _BREAKERS.setdefault(site, _Breaker())
+        now = _clock()
+        was_closed = b.state == "closed"
+        if b.state == "half_open":
+            b.backoff_s = min(b.backoff_s * 2.0, _max_backoff_s(site))
+        else:
+            b.backoff_s = _probe_after_s(site)
+        b.state = "open"
+        b.probing = False
+        b.reason = f"{type(err).__name__}: {err}"
+        b.opened_at = now
+        b.next_probe_at = now + b.backoff_s
+        b.opens += 1
+        # the injected label tracks the breaker's ORIGINAL open cause: a
+        # probe of a real-failure-opened breaker failing on an armed
+        # simulation must neither relabel the outage as injected nor
+        # (below) drop the real demotion's persisted verdict — while a
+        # REAL failure always claims the label (and persistence)
+        b.injected = injected if (was_closed or not injected) else b.injected
+        injected = b.injected
+        reason, backoff, opens = b.reason, b.backoff_s, b.opens
+        first = site not in _LOGGED
+        _LOGGED.add(site)
     if first:
         rlog.log_warn(
-            "guarded %s: kernel path failed (%s: %s); demoted to the XLA "
-            "fallback for the rest of this process", site,
-            type(err).__name__, err)
-        try:
-            # serving telemetry: demotions are operational events the
-            # metrics snapshot must surface (docs/serving.md)
-            from ..serve import metrics as serve_metrics
+            "guarded %s: kernel path failed (%s); breaker OPEN — serving "
+            "the XLA fallback, probing the kernel path again in %.0fs",
+            site, reason, backoff)
+    _set_state_gauge(site, "open")
+    try:
+        from ..serve import metrics as serve_metrics
 
-            serve_metrics.counter("guarded.demotions").inc()
-            # per-site magnitude: the SLO engine's demotion-rate target
-            # and the drift-guard test read site-labeled counts
-            serve_metrics.counter(f"guarded.demotions.{site}").inc()
-            # flight recorder: stamped with the trace IDs of whatever
-            # requests were in flight when the kernel path died
-            from ..core import events as core_events
-
-            core_events.record("guarded_demotion", site,
-                               error=f"{type(err).__name__}: {err}")
-        except Exception:  # noqa: BLE001 - telemetry must not break containment
-            pass
+        # demotion counters keep their PR 2/8 names: the SLO engine's
+        # demotion-rate target and the drift guard read them
+        serve_metrics.counter("guarded.demotions").inc()
+        serve_metrics.counter(f"guarded.demotions.{site}").inc()
+    except Exception:  # noqa: BLE001 - telemetry must not break containment
+        pass
+    _emit("breaker_open", site, error=reason, backoff_s=round(backoff, 3),
+          opens=opens, injected=injected)
+    if first:
+        # PR 6 contract: the site's first demotion this process is a
+        # guarded_demotion event (dashboards and the drift guard key on it)
+        _emit("guarded_demotion", site, error=reason)
+    # in-process record always (trace-time lookups see the demotion);
+    # cross-process persistence only for REAL failures under the opt-in —
+    # an injected fault must never poison another process's dispatch
     autotune.record(
         _guard_key(site), "fallback",
-        persist=persist and os.environ.get("RAFT_TPU_GUARD_PERSIST") == "1")
+        persist=(not injected)
+        and os.environ.get("RAFT_TPU_GUARD_PERSIST") == "1")
+
+
+def _on_probe_success(site: str) -> None:
+    """half_open → closed: the kernel path is healthy again."""
+    from . import autotune
+
+    with _lock:
+        b = _BREAKERS.get(site)
+        if b is None:
+            return
+        down_s = round(_clock() - b.opened_at, 3)
+        probes = b.probes
+        b.state = "closed"
+        b.probing = False
+        b.reason = ""
+        b.injected = False
+        b.backoff_s = 0.0
+        b.closes += 1
+    autotune.forget(_guard_key(site))
+    _set_state_gauge(site, "closed")
+    try:
+        from ..serve import metrics as serve_metrics
+
+        serve_metrics.counter(f"guarded.breaker.closes.{site}").inc()
+    except Exception:  # noqa: BLE001
+        pass
+    _emit("breaker_close", site, down_s=down_s, probes=probes)
+    rlog.log_warn(
+        "guarded %s: probe succeeded after %.1fs; breaker CLOSED — kernel "
+        "path restored", site, down_s)
+
+
+def _abort_probe(site: str) -> None:
+    """A probe interrupted by control flow (cancellation, deadline) is
+    neither success nor failure: back to open, eligible to re-probe
+    immediately."""
+    with _lock:
+        b = _BREAKERS.get(site)
+        if b is not None and b.probing:
+            b.state = "open"
+            b.probing = False
+    _set_state_gauge(site, "open")
 
 
 def guarded_call(site: str, primary: Callable[[], object],
                  fallback: Callable[[], object]):
     """Run ``primary`` (the kernel engine) with ``fallback`` (its exact
-    XLA equivalent) as the containment path. See module docstring for the
-    demotion/injection contract. Cancellation and deadline exceptions
-    pass through — they are control flow, not engine failures."""
-    from . import autotune
-
-    if site in _DEMOTED or autotune.lookup(_guard_key(site)) == "fallback":
+    XLA equivalent) as the containment path, through the site's circuit
+    breaker. See module docstring for the state machine and injection
+    contract. Cancellation and deadline exceptions pass through — they
+    are control flow, not engine failures."""
+    action = _admit(site)
+    if action == "fallback":
         return fallback()
+    probing = action == "probe"
     try:
         faults.check("kernel_compile", site)
+        faults.check("kernel_fault", site)
         faults.sleep_if(site)
-        return primary()
-    except faults.InjectedFault:
-        # simulated failure: serve the fallback for THIS call only
+        out = primary()
+    except faults.InjectedFault as e:
+        if probing or e.kind == "kernel_fault":
+            # kernel_fault simulates a PERSISTENT failure (drives the
+            # breaker); any injected fault fails a probe — but injected
+            # opens are never persisted cross-process
+            _on_failure(site, e, injected=True)
+        # kernel_compile outside a probe: PR 1 per-call simulation —
+        # serve the fallback for THIS call only, breaker untouched
         return fallback()
     except (KeyboardInterrupt, SystemExit, InterruptedException,
             DeadlineExceeded):
+        if probing:
+            _abort_probe(site)
         raise
     except Exception as e:  # noqa: BLE001 - any engine failure = contain
-        _demote(site, e, persist=True)
+        _on_failure(site, e, injected=False)
         return fallback()
+    except BaseException:   # noqa: BLE001 - e.g. CancelledError: control
+        # flow, not an engine failure — but a probe must never exit with
+        # the probing flag stranded (that would disable every future
+        # probe: the one-way demotion this module exists to close)
+        if probing:
+            _abort_probe(site)
+        raise
+    if probing:
+        _on_probe_success(site)
+    return out
 
 
 def demoted_sites() -> Dict[str, str]:
-    """Sites demoted this process and why (diagnostics)."""
-    return dict(_DEMOTED)
+    """Sites currently serving the fallback (breaker open or half-open)
+    and why (diagnostics). A recovered breaker no longer reports."""
+    with _lock:
+        return {site: b.reason or "open"
+                for site, b in _BREAKERS.items() if b.state != "closed"}
+
+
+def breaker_snapshot() -> Dict[str, dict]:
+    """JSON-safe per-site breaker state for the ops surface
+    (serve/debugz ``breakers`` section): state, open-since, probe count,
+    next-probe ETA."""
+    now = _clock()
+    out: Dict[str, dict] = {}
+    with _lock:
+        for site, b in _BREAKERS.items():
+            ent = {"state": b.state, "opens": b.opens, "probes": b.probes,
+                   "closes": b.closes}
+            if b.state != "closed":
+                ent.update({
+                    "reason": b.reason,
+                    "injected": b.injected,
+                    "open_for_s": round(max(0.0, now - b.opened_at), 3),
+                    "backoff_s": round(b.backoff_s, 3),
+                    "next_probe_in_s": (
+                        None if _probe_after_s(site) <= 0
+                        else round(max(0.0, b.next_probe_at - now), 3)),
+                })
+            out[site] = ent
+    return out
 
 
 def reset() -> None:
-    """Clear in-process demotions (tests / operator re-arm after a fix)."""
+    """Clear all breaker state (tests / operator re-arm after a fix)."""
     from . import autotune
 
-    for site in list(_DEMOTED):
+    with _lock:
+        sites = list(_BREAKERS)
+        _BREAKERS.clear()
+        _LOGGED.clear()
+    for site in sites:
         autotune.forget(_guard_key(site))
-    _DEMOTED.clear()
+        _set_state_gauge(site, "closed")

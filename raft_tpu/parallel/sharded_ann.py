@@ -16,6 +16,12 @@ vectors never move between chips. Shard row counts are padded to a
 common size; source ids carry GLOBAL row numbers so the merge is
 trivial.
 
+Shard health closes its own loop: ``mark_shard_failed`` masks a shard
+out of every merge, and :func:`probe_shards` (periodic via
+``SnapshotWriter(hooks=[probe_all])``) canary-probes dead shards and
+flips ``shards_ok`` back once the fault clears — ``served_frac``
+recovers without an operator (docs/robustness.md "Shard re-probe").
+
 The cross-shard merge dispatches through :mod:`raft_tpu.ops.ring_topk`:
 either the reference allgather + ``knn_merge_parts`` path or a ring
 merge (``ppermute`` hops in XLA, or the Pallas ``make_async_remote_copy``
@@ -28,6 +34,7 @@ degraded-merge contract survives unchanged.
 """
 from __future__ import annotations
 
+import time
 import weakref
 
 import jax
@@ -46,7 +53,8 @@ from ..utils import cdiv, shard_map_compat
 __all__ = ["ShardedIvfFlat", "build_ivf_flat", "search_ivf_flat",
            "ShardedCagra", "build_cagra", "search_cagra",
            "ShardedIvfPq", "build_ivf_pq", "search_ivf_pq",
-           "make_searcher", "ops_snapshot", "health"]
+           "make_searcher", "ops_snapshot", "health",
+           "probe_shards", "probe_all"]
 
 AXIS = "shard"
 
@@ -115,6 +123,22 @@ def ops_snapshot() -> dict:
         ent["indexes"] += 1
         ent["shards_ok"].append(
             [bool(b) for b in np.asarray(idx.shards_ok, bool)])
+        # per-shard re-probe results (probe_shards), one entry per index
+        # aligned with the shards_ok list: the operator's answer to "is
+        # the dead shard coming back, and if not why". Copied under
+        # retry: a background probe loop inserts here concurrently, and
+        # losing the whole sharded section during an incident is exactly
+        # when the operator is reading it
+        for _ in range(4):
+            try:
+                probes = {str(i): dict(r)
+                          for i, r in list(idx.last_probe.items())}
+                break
+            except RuntimeError:
+                continue
+        else:
+            probes = {}
+        ent.setdefault("last_probe", []).append(probes)
     for fam, eng in dict(_ACTIVE_ENGINE).items():
         fams.setdefault(fam, {"indexes": 0, "shards_ok": []})
         fams[fam]["merge_engine"] = eng
@@ -215,6 +239,107 @@ def _mark_shard(shards_ok: np.ndarray, family: str, i: int, ok: bool) -> None:
         pass
 
 
+def _canary_search(index, i: int, rows: int = 8) -> None:
+    """Cheap per-shard canary: slice a few rows of the shard's float
+    source arrays off the mesh, run an exact micro-search (rows vs
+    themselves) on device, and require finite results. This exercises
+    the shard's device round-trip and arithmetic without a ``shard_map``
+    dispatch (whose whole-program recompile is exactly the cost a
+    periodic probe loop must not pay). Raises on any failure."""
+    site = f"sharded_ann.{index.family}.shard{i}"
+    # armed shard faults keep the shard dead, so the recovery arc is
+    # deterministically drillable: the probe fails while the fault
+    # holds and succeeds the tick after it clears. Checked WITHOUT
+    # consuming a firing (matches, not fired): a background probe tick
+    # must not drain a count-limited fault budget armed for the search
+    # path
+    if any(f.matches(k, site) for f in faults.active()
+           for k in ("shard_dead", "shard_timeout")):
+        raise RuntimeError(f"shard fault armed at {site}")
+    src = index._canary_source()
+    # never ask for more rows than the source has (a 1-list/1-row shard
+    # must still be probeable — a shape clamp that rounds UP would fail
+    # its canary forever)
+    rows = max(1, min(int(rows), int(src.shape[1])))
+    x = jnp.asarray(src[i, :rows], jnp.float32)
+    d = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    dd = np.asarray(d)
+    if dd.shape != (rows, rows) or not np.isfinite(dd).all():
+        raise RuntimeError(
+            f"canary produced non-finite distances on shard {i}")
+
+
+def probe_shards(index, *, rows: int = 8, probe_fn=None) -> dict:
+    """Re-probe every shard currently marked failed; flip ``shards_ok``
+    back on success (docs/robustness.md "Shard re-probe").
+
+    ``mark_shard_failed`` has always been a one-way street in practice:
+    nothing re-marked a shard after a transient ICI/driver fault, so
+    ``served_frac`` never recovered. This closes the loop: each dead
+    shard runs a cheap canary (:func:`_canary_search`, or ``probe_fn(
+    index, shard)`` when injected); success re-marks the shard healthy
+    (a ``shard_marked ok=True`` transition plus an explicit
+    ``shard_restored`` flight-recorder event), failure records why and
+    leaves the sticky flag alone. Healthy shards are never probed.
+
+    Returns ``{shard: ok}`` for the shards probed. Per-shard last-probe
+    results are kept on the index (``index.last_probe``) and surfaced in
+    the debugz ``sharded`` section. Call on an interval from serving —
+    e.g. ``SnapshotWriter(..., hooks=[sharded_ann.probe_all])``.
+    """
+    ok = np.asarray(index.shards_ok, bool)
+    results: dict = {}
+    for i in np.flatnonzero(~ok):
+        i = int(i)
+        site = f"sharded_ann.{index.family}.shard{i}"
+        rec = {"ok": False, "ts": time.time(), "error": None}
+        try:
+            if probe_fn is not None:
+                probe_fn(index, i)
+            else:
+                _canary_search(index, i, rows=rows)
+            rec["ok"] = True
+            index.mark_shard_failed(i, ok=True)
+            try:
+                from ..core import events as _events
+
+                _events.record("shard_restored", site,
+                               served_frac=health(index)["served_frac"])
+            except Exception:  # noqa: BLE001 - telemetry must not undo
+                pass           # the restore
+        except Exception as e:  # noqa: BLE001 - a failed probe is a result
+            rec["error"] = f"{type(e).__name__}: {e}"
+            try:
+                from ..serve import metrics as _metrics
+
+                _metrics.counter(
+                    f"sharded.probe_failures.{index.family}").inc()
+            except Exception:  # noqa: BLE001
+                pass
+        index.last_probe[i] = rec
+        results[i] = rec["ok"]
+    return results
+
+
+def probe_all(**kw) -> dict:
+    """Probe every live sharded index with dead shards (the
+    SnapshotWriter-hook form of :func:`probe_shards`); returns
+    ``{family: {shard: ok}}`` merged across live indexes."""
+    out: dict = {}
+    for _ in range(4):
+        try:
+            live = list(_LIVE)
+            break
+        except RuntimeError:     # registration race (see ops_snapshot)
+            continue
+    else:
+        live = []
+    for idx in live:
+        if not np.asarray(idx.shards_ok, bool).all():
+            out.setdefault(idx.family, {}).update(probe_shards(idx, **kw))
+    return out
+
+
 def _shard_mask(mesh, ok: np.ndarray) -> jax.Array:
     """(p, 1) bool validity mask sharded over the mesh axis (rides into
     shard_map so each shard masks its own contribution pre-merge)."""
@@ -273,12 +398,19 @@ class ShardedIvfFlat:
         self.scales = scales                # (p, R) f32, int8 mode only
         # sticky per-shard health flags (see mark_shard_failed)
         self.shards_ok = np.ones(mesh.shape[AXIS], bool)
+        # shard -> last probe_shards result (debugz sharded section)
+        self.last_probe: dict = {}
         _LIVE.add(self)
 
     def mark_shard_failed(self, i: int, ok: bool = False) -> None:
         """Flag shard ``i`` unhealthy: its results are masked out of every
-        merge until re-marked ok (search then needs allow_partial=True)."""
+        merge until re-marked ok (search then needs allow_partial=True)
+        or a :func:`probe_shards` canary succeeds."""
         _mark_shard(self.shards_ok, "ivf_flat", i, ok)
+
+    def _canary_source(self):
+        """Small float per-shard array for :func:`probe_shards`."""
+        return self.centers
 
     @property
     def n_shards(self) -> int:
@@ -413,11 +545,15 @@ class ShardedCagra:
         self.seeds = seeds      # (p, s) per-shard covering seed rows
                                 # (sorted unique; invalid-id padded)
         self.shards_ok = np.ones(mesh.shape[AXIS], bool)
+        self.last_probe: dict = {}
         _LIVE.add(self)
 
     def mark_shard_failed(self, i: int, ok: bool = False) -> None:
         """Flag shard ``i`` unhealthy (see ShardedIvfFlat.mark_shard_failed)."""
         _mark_shard(self.shards_ok, "cagra", i, ok)
+
+    def _canary_source(self):
+        return self.data
 
     @property
     def n_shards(self) -> int:
@@ -560,11 +696,15 @@ class ShardedIvfPq:
         self.codebook_kind = codebook_kind
         self._sizes_host = sizes_host   # list of per-shard np size arrays
         self.shards_ok = np.ones(mesh.shape[AXIS], bool)
+        self.last_probe: dict = {}
         _LIVE.add(self)
 
     def mark_shard_failed(self, i: int, ok: bool = False) -> None:
         """Flag shard ``i`` unhealthy (see ShardedIvfFlat.mark_shard_failed)."""
         _mark_shard(self.shards_ok, "ivf_pq", i, ok)
+
+    def _canary_source(self):
+        return self.centers_rot
 
     @property
     def n_shards(self) -> int:

@@ -22,6 +22,8 @@ operational metrics.
                 (docs/observability.md "Quality")
 - ``slo``       declarative SLO engine over the metrics registry
                 (burn-rate windows, slo_breach events)
+- ``degrade``   SLO-driven brownout controller: adaptive degradation
+                ladder with hysteresis (docs/robustness.md)
 
 Submodules import lazily, so telemetry-only consumers (ops/guarded
 demotion events, core/tracing span timing) pull in none of the
@@ -32,8 +34,8 @@ from __future__ import annotations
 import importlib
 from typing import Any
 
-_SUBMODULES = ("admission", "batcher", "debugz", "metrics", "quality",
-               "slo", "warmup")
+_SUBMODULES = ("admission", "batcher", "debugz", "degrade", "metrics",
+               "quality", "slo", "warmup")
 _EXPORTS = {
     "MicroBatcher": "batcher",
     "BucketLadder": "batcher",
@@ -46,6 +48,7 @@ _EXPORTS = {
     "RecallSentinel": "quality",
     "SLOEngine": "slo",
     "Targets": "slo",
+    "BrownoutController": "degrade",
 }
 
 __all__ = list(_SUBMODULES) + list(_EXPORTS)

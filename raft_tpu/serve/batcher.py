@@ -135,7 +135,11 @@ class MicroBatcher:
     docstring). ``sentinel``: an optional
     :class:`~raft_tpu.serve.quality.RecallSentinel` — served requests
     are offered to it after delivery for online recall estimation
-    (docs/observability.md "Quality").
+    (docs/observability.md "Quality"). ``degrade``: an optional
+    :class:`~raft_tpu.serve.degrade.BrownoutController` — its current
+    level scales the coalescing max-wait (pair it with
+    ``make_searcher(..., degrade=...)`` so search params degrade too;
+    docs/robustness.md).
     """
 
     def __init__(self, search_fn: Callable, dim: int, *,
@@ -148,6 +152,7 @@ class MicroBatcher:
                  autostart: bool = True,
                  trace_sample: Optional[float] = None,
                  sentinel=None,
+                 degrade=None,
                  clock: Callable[[], float] = time.monotonic):
         from . import metrics as _metrics
 
@@ -163,6 +168,10 @@ class MicroBatcher:
         # requests are offered AFTER delivery; its disabled cost is one
         # None check here plus one flag check inside offer()
         self._sentinel = sentinel
+        # optional brownout controller (serve/degrade.py): under a
+        # latency brownout the batcher widens its max-wait by the
+        # level's scale — bigger batches, fewer dispatches
+        self._degrade = degrade
         rate = tracing.sample_rate(trace_sample)
         # stage telemetry: None = off (the hot path checks exactly this);
         # every ceil(1/rate)-th batch gets the full five-stage story
@@ -255,7 +264,13 @@ class MicroBatcher:
     # -- worker -----------------------------------------------------------
     def _run(self) -> None:
         while True:
-            batch = self.queue.pop_batch(self._max_batch, self._max_wait_s,
+            wait = self._max_wait_s
+            if self._degrade is not None:
+                try:
+                    wait *= self._degrade.max_wait_scale()
+                except Exception:  # noqa: BLE001 - a broken controller
+                    pass           # must not stall the worker
+            batch = self.queue.pop_batch(self._max_batch, wait,
                                          max_rows=self.ladder.max_queries)
             if not batch:
                 if self.queue.closed:

@@ -109,6 +109,27 @@ def snapshot(batcher=None, registry=None, events_n: int = 50,
             {"kind": f.kind, "pattern": f.pattern, "count": f.count,
              "value": f.value, "fires": f.fires} for f in faults.active()],
     }
+    # circuit breakers (ops/guarded.py): per-site state, open-since,
+    # probe count, next-probe ETA — the recovery half of the demotion
+    # table above (docs/robustness.md)
+    try:
+        bs = guarded.breaker_snapshot()
+        if bs:
+            out["breakers"] = bs
+    except Exception:  # noqa: BLE001 - surface must render regardless
+        pass
+    # brownout controller (serve/degrade.py): current ladder level +
+    # recent transitions
+    try:
+        from . import degrade as _degrade
+
+        ctl = _degrade.installed()
+        if ctl is None and batcher is not None:
+            ctl = getattr(batcher, "_degrade", None)
+        if ctl is not None:
+            out["brownout"] = ctl.snapshot()
+    except Exception:  # noqa: BLE001 - surface must render without degrade
+        pass
     # sharded-serving health: per-family shards_ok of every live sharded
     # index, the merge engine actually serving each family, and the ring
     # demotion count (previously visible only as bare counters)
@@ -171,6 +192,25 @@ def render_text(batcher=None, registry=None, events_n: int = 20,
     if hists:
         lines += ["", "-- histograms --"]
         lines += [_fmt_hist(k, h) for k, h in hists.items() if h["count"]]
+    if s.get("breakers"):
+        lines += ["", "-- circuit breakers --"]
+        for site, b in sorted(s["breakers"].items()):
+            extra = ""
+            if b["state"] != "closed":
+                eta = b.get("next_probe_in_s")
+                extra = (f" open_for={b.get('open_for_s', 0):g}s "
+                         f"next_probe_in="
+                         f"{'-' if eta is None else f'{eta:g}s'}"
+                         f" ({b.get('reason', '')})")
+            lines.append(
+                f"  {site}: {b['state'].upper()} opens={b['opens']} "
+                f"probes={b['probes']} closes={b['closes']}" + extra)
+    if s.get("brownout"):
+        bw = s["brownout"]
+        lines += ["", f"-- brownout (level {bw['level']}/{bw['max_level']})"
+                  " --"]
+        for tr in bw.get("transitions", [])[-5:]:
+            lines.append(f"  {tr['from']} -> {tr['to']} ({tr['reason']})")
     sh = s.get("sharded") or {}
     if sh.get("families"):
         lines += ["", "-- sharded search --"]
@@ -181,6 +221,12 @@ def render_text(batcher=None, registry=None, events_n: int = 20,
             lines.append(
                 f"  {fam}: engine={ent.get('merge_engine') or '-'} "
                 f"indexes={ent.get('indexes', 0)} shards[{health}]")
+            for n_idx, probes in enumerate(ent.get("last_probe", [])):
+                for shard, pr in sorted(probes.items()):
+                    lines.append(
+                        f"    idx{n_idx} shard{shard} probe: "
+                        f"{'ok' if pr.get('ok') else 'FAILED'}"
+                        + (f" ({pr['error']})" if pr.get("error") else ""))
         lines.append(
             f"  ring demotions: {sh.get('ring_demotions', 0)}"
             + (" (site demoted)" if sh.get("ring_demoted") else ""))
@@ -264,17 +310,34 @@ class SnapshotWriter:
     """Background ops-snapshot persistence: a daemon thread writing
     :func:`write_snapshot` to ``path`` every ``interval_s`` (and once on
     ``stop``, so the final state always lands). Context-manager form
-    scopes it to a serving run."""
+    scopes it to a serving run.
+
+    ``hooks``: callables invoked (guarded) each tick BEFORE the write —
+    the serving loop's maintenance slot. The self-healing layer hangs
+    its periodic work here: ``sharded_ann.probe_all`` re-probes dead
+    shards, ``BrownoutController.poll`` consumes SLO verdicts
+    (docs/robustness.md) — so the snapshot that lands each tick already
+    reflects that tick's probes and ladder moves."""
 
     def __init__(self, path: str, interval_s: float = 10.0, batcher=None,
-                 registry=None, slo=None):
+                 registry=None, slo=None, hooks=()):
         self.path = path
         self.interval_s = float(interval_s)
         self._batcher = batcher
         self._registry = registry
         self._slo = slo
+        self._hooks = tuple(hooks)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def tick(self) -> None:
+        """Run the maintenance hooks once (each guarded — one failing
+        hook must not starve the rest or the write)."""
+        for h in self._hooks:
+            try:
+                h()
+            except Exception:  # noqa: BLE001 - a broken hook must not
+                pass           # kill the maintenance loop
 
     def write_once(self) -> dict:
         return write_snapshot(self.path, self._batcher, self._registry,
@@ -290,6 +353,7 @@ class SnapshotWriter:
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
+            self.tick()
             try:
                 self.write_once()
             except Exception:  # noqa: BLE001 - a failed write must not

@@ -10,6 +10,7 @@ import math
 
 __all__ = [
     "cdiv",
+    "env_float",
     "hdot",
     "round_up_to",
     "round_down_to",
@@ -33,6 +34,19 @@ SUBLANES_BF16 = 16
 def cdiv(a: int, b: int) -> int:
     """Ceiling division."""
     return -(-a // b)
+
+
+def env_float(name: str, default: float) -> float:
+    """Float env knob with a silent fall-back to ``default`` on unset or
+    unparseable values (operator knobs must never crash a serving
+    process over a typo)."""
+    import os
+
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
 
 
 def round_up_to(x: int, m: int) -> int:

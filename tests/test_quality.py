@@ -451,19 +451,38 @@ class TestGuardedDriftGuard:
                                     re.MULTILINE))
         return sites
 
-    def test_every_site_emits_event_and_counters_on_demotion(self):
-        """Every guarded_call site in the tree, demoted, must land in the
-        flight recorder AND the (total + per-site) demotion counters —
-        the quality alarm's precondition: a silent demotion is exactly
-        the failure mode the recall sentinel exists to catch."""
+    def test_every_site_has_breaker_policy(self):
+        """ISSUE 10 drift guard: every guarded_call site must ship a
+        breaker policy (ops/guarded.POLICIES) — a gated kernel without a
+        declared recovery cadence is a one-way demotion by accident."""
         from raft_tpu.ops import guarded
 
-        if any(f.kind == "kernel_compile" for f in faults.active()):
-            pytest.skip("ambient kernel faults are served as injected "
-                        "(non-demoting) failures")
         sites = self._discover_sites()
         assert self.KNOWN <= sites, (
             f"guarded sites missing from source sweep: {self.KNOWN - sites}")
+        missing = sites - set(guarded.POLICIES)
+        assert not missing, (
+            f"guarded sites without a breaker policy: {sorted(missing)} — "
+            "add them to ops/guarded.POLICIES (DEFAULT_POLICY is fine) so "
+            "the recovery drill below exercises them")
+
+    def test_every_site_demotes_probes_and_recovers(self, monkeypatch):
+        """Every guarded_call site in the tree is drilled through the
+        FULL breaker arc — demote (flight-recorder event + total and
+        per-site counters), clock-stepped probation, failed probe
+        (backoff doubles), successful probe (breaker re-closes, kernel
+        path restored). A silent demotion is exactly the failure mode
+        the recall sentinel exists to catch; a demotion that can never
+        recover is the failure mode ISSUE 10 exists to close."""
+        from raft_tpu.ops import guarded
+
+        if any(f.kind in ("kernel_compile", "kernel_fault")
+               for f in faults.active()):
+            pytest.skip("ambient kernel faults are served as injected "
+                        "(non-demoting) failures")
+        sites = self._discover_sites()
+        now = {"t": 0.0}
+        monkeypatch.setattr(guarded, "_clock", lambda: now["t"])
         pre_demoted = set(guarded.demoted_sites())
         try:
             for site in sorted(sites - pre_demoted):
@@ -473,6 +492,7 @@ class TestGuardedDriftGuard:
                 def boom():
                     raise RuntimeError("drift-guard drill")
 
+                # demote
                 assert guarded.guarded_call(site, boom, lambda: "fb") == "fb"
                 assert site in guarded.demoted_sites()
                 evs = [e for e in events.recent(kind="guarded_demotion")
@@ -483,6 +503,31 @@ class TestGuardedDriftGuard:
                 assert metrics.counter(
                     f"guarded.demotions.{site}").value == site0 + 1, \
                     f"site {site}: per-site counter"
+                # inside probation: fallback without touching the kernel
+                assert guarded.guarded_call(
+                    site, boom, lambda: "fb") == "fb"
+                b = guarded.breaker_snapshot()[site]
+                assert b["state"] == "open" and b["probes"] == 0
+                # probation expires -> one probe; failure doubles backoff
+                now["t"] += b["next_probe_in_s"] + 0.1
+                assert guarded.guarded_call(
+                    site, boom, lambda: "fb") == "fb"
+                b2 = guarded.breaker_snapshot()[site]
+                assert b2["probes"] == 1 and \
+                    b2["backoff_s"] == pytest.approx(2 * b["backoff_s"]), \
+                    f"site {site}: failed probe must double the backoff"
+                # next probe succeeds -> breaker closes, kernel restored
+                now["t"] += b2["next_probe_in_s"] + 0.1
+                assert guarded.guarded_call(
+                    site, lambda: "kern", lambda: "fb") == "kern"
+                assert site not in guarded.demoted_sites(), \
+                    f"site {site}: breaker did not re-close"
+                assert any(e["site"] == site for e in
+                           events.recent(kind="breaker_close")), \
+                    f"site {site}: recovery without a breaker_close event"
+                assert guarded.guarded_call(
+                    site, lambda: "kern", lambda: "fb") == "kern", \
+                    f"site {site}: kernel path not restored after close"
         finally:
             guarded.reset()
 

@@ -1,0 +1,669 @@
+"""Self-healing serving tests (ISSUE 10): circuit breakers on guarded
+kernels, shard re-probe/recovery, the SLO-driven brownout controller,
+and timed fault scenarios.
+
+Tier-1 coverage is lean by design (the 870 s wall has no margin): every
+recovery drill runs on injectable clocks and numpy stubs — the only
+device work is the probe_shards canary (a few 8-row slices). The full
+chaos drill (overload + shard death + kernel fault → complete recovery
+arc, ISSUE 10 acceptance) builds a real index and serves real traffic,
+so it rides the ``slow``/``faults`` lane.
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.core import events, faults
+from raft_tpu.ops import autotune, guarded
+from raft_tpu.serve import debugz, degrade, metrics, quality, slo
+from raft_tpu.serve.degrade import BrownoutController
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    # guard demotions ride the autotune cache; tests must not touch the
+    # user-level JSON
+    monkeypatch.setenv("RAFT_TPU_AUTOTUNE_CACHE", "")
+    events.clear()
+    yield
+    guarded.reset()
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    """Injectable breaker clock: advance with clock['t'] += s."""
+    now = {"t": 0.0}
+    monkeypatch.setattr(guarded, "_clock", lambda: now["t"])
+    return now
+
+
+def _boom():
+    raise RuntimeError("kernel died")
+
+
+class TestCircuitBreaker:
+    @pytest.fixture(autouse=True)
+    def _no_ambient_kernel_faults(self):
+        # the faults lane (RAFT_TPU_FAULTS='kernel_compile@*') serves
+        # every guarded call as an injected per-call failure — the
+        # breaker arcs drilled here are unreachable by design
+        if any(f.kind in ("kernel_compile", "kernel_fault")
+               for f in faults.active()):
+            pytest.skip("ambient kernel faults pre-empt the kernel path")
+
+    def test_open_probe_backoff_reclose(self, clock):
+        """The full arc on one site: real failure -> open; probation ->
+        half-open probe; failed probe doubles the backoff (capped);
+        successful probe re-closes and restores the kernel path."""
+        calls = []
+
+        def kern():
+            calls.append(1)
+            return "kern"
+
+        assert guarded.guarded_call("sh.a", _boom, lambda: "fb") == "fb"
+        b = guarded.breaker_snapshot()["sh.a"]
+        assert b["state"] == "open" and b["backoff_s"] == 30.0
+        assert autotune.lookup(guarded._guard_key("sh.a")) == "fallback"
+        # inside probation: fallback, kernel untouched
+        assert guarded.guarded_call("sh.a", kern, lambda: "fb") == "fb"
+        assert not calls
+        # probation over: ONE probe; it fails -> backoff doubles
+        clock["t"] = 31.0
+        assert guarded.guarded_call("sh.a", _boom, lambda: "fb") == "fb"
+        b = guarded.breaker_snapshot()["sh.a"]
+        assert b["backoff_s"] == 60.0 and b["probes"] == 1
+        # healthy probe closes; verdict forgotten; kernel path restored
+        clock["t"] = 95.0
+        assert guarded.guarded_call("sh.a", kern, lambda: "fb") == "kern"
+        assert "sh.a" not in guarded.demoted_sites()
+        assert autotune.lookup(guarded._guard_key("sh.a")) is None
+        assert guarded.guarded_call("sh.a", kern, lambda: "fb") == "kern"
+        assert len(calls) == 2      # the probe + the restored call
+        kinds = [e["kind"] for e in events.recent() if e["site"] == "sh.a"]
+        assert kinds == ["breaker_open", "guarded_demotion",
+                         "breaker_probe", "breaker_open",
+                         "breaker_probe", "breaker_close"]
+        # per-site gauge followed the transitions back to closed
+        assert metrics.gauge("guarded.breaker.sh.a").value == 0
+
+    def test_backoff_caps_and_env_knobs(self, clock, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_GUARD_PROBE_AFTER_S", "2")
+        monkeypatch.setenv("RAFT_TPU_GUARD_MAX_BACKOFF_S", "5")
+        assert guarded.guarded_call("sh.cap", _boom, lambda: "fb") == "fb"
+        for expect in (4.0, 5.0, 5.0):   # 2 -> 4 -> capped at 5
+            clock["t"] += 6.0
+            assert guarded.guarded_call(
+                "sh.cap", _boom, lambda: "fb") == "fb"
+            assert guarded.breaker_snapshot()["sh.cap"]["backoff_s"] \
+                == expect
+
+    def test_sticky_mode_probe_after_zero(self, clock, monkeypatch):
+        """PROBE_AFTER_S <= 0 restores the pre-ISSUE-10 sticky demotion
+        (an operator can pin a site down while debugging)."""
+        monkeypatch.setenv("RAFT_TPU_GUARD_PROBE_AFTER_S", "0")
+        assert guarded.guarded_call("sh.st", _boom, lambda: "fb") == "fb"
+        clock["t"] = 1e9
+        assert guarded.guarded_call(
+            "sh.st", lambda: "kern", lambda: "fb") == "fb"
+        assert guarded.breaker_snapshot()["sh.st"]["probes"] == 0
+
+    def test_kernel_compile_injection_stays_per_call(self):
+        """PR 1 invariant byte-for-byte: a kernel_compile injection is a
+        per-call simulation — the breaker does not move."""
+        with faults.inject("kernel_compile", "sh.i", count=1):
+            assert guarded.guarded_call(
+                "sh.i", lambda: "kern", lambda: "fb") == "fb"
+        assert guarded.guarded_call(
+            "sh.i", lambda: "kern", lambda: "fb") == "kern"
+        assert "sh.i" not in guarded.breaker_snapshot()
+
+    def test_kernel_fault_opens_recovers_never_persists(
+            self, clock, monkeypatch, tmp_path):
+        """kernel_fault drives the breaker (the drillable persistent
+        failure) but can never poison another process: even under
+        GUARD_PERSIST=1 an injected open stays out of the disk cache,
+        and the probe re-closes the breaker once the fault clears."""
+        cache = tmp_path / "autotune.json"
+        monkeypatch.setenv("RAFT_TPU_AUTOTUNE_CACHE", str(cache))
+        monkeypatch.setenv("RAFT_TPU_GUARD_PERSIST", "1")
+        with faults.inject("kernel_fault", "sh.kf"):
+            assert guarded.guarded_call(
+                "sh.kf", lambda: "kern", lambda: "fb") == "fb"
+            b = guarded.breaker_snapshot()["sh.kf"]
+            assert b["state"] == "open" and b["injected"]
+            # probe under the armed fault re-opens
+            clock["t"] += 31.0
+            assert guarded.guarded_call(
+                "sh.kf", lambda: "kern", lambda: "fb") == "fb"
+        # in-process verdict exists but never reached the disk cache
+        assert autotune.lookup(guarded._guard_key("sh.kf")) == "fallback"
+        autotune.record("unrelated_key", "x")      # triggers a disk dump
+        disk = json.loads(cache.read_text())
+        assert guarded._guard_key("sh.kf") not in disk
+        autotune.forget("unrelated_key")
+        # fault cleared: the probe restores steady-state dispatch
+        clock["t"] += 120.0
+        assert guarded.guarded_call(
+            "sh.kf", lambda: "kern", lambda: "fb") == "kern"
+        assert "sh.kf" not in guarded.demoted_sites()
+
+    def test_injected_probe_failure_keeps_real_demotion_label(
+            self, clock, monkeypatch, tmp_path):
+        """A probe of a REAL-failure-opened breaker failing on an armed
+        simulation must neither relabel the outage as injected nor drop
+        the persisted verdict from the disk cache."""
+        cache = tmp_path / "autotune.json"
+        monkeypatch.setenv("RAFT_TPU_AUTOTUNE_CACHE", str(cache))
+        monkeypatch.setenv("RAFT_TPU_GUARD_PERSIST", "1")
+        assert guarded.guarded_call("sh.rl", _boom, lambda: "fb") == "fb"
+        key = guarded._guard_key("sh.rl")
+        assert key in json.loads(cache.read_text())
+        clock["t"] += 31.0
+        with faults.inject("kernel_compile", "sh.rl"):
+            assert guarded.guarded_call(
+                "sh.rl", lambda: "kern", lambda: "fb") == "fb"
+        b = guarded.breaker_snapshot()["sh.rl"]
+        assert b["state"] == "open" and b["injected"] is False
+        autotune.record("unrelated_key2", "x")     # re-dumps the cache
+        assert key in json.loads(cache.read_text()), \
+            "injected probe failure dropped the persisted real demotion"
+        autotune.forget("unrelated_key2")
+
+    def test_persisted_demotion_seeds_open_and_recovers(
+            self, clock, monkeypatch, tmp_path):
+        """A prior process's persisted guard verdict seeds this
+        process's breaker OPEN — it too probes and recovers instead of
+        being demoted forever."""
+        monkeypatch.setenv("RAFT_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "c.json"))
+        autotune.record(guarded._guard_key("sh.pers"), "fallback")
+        assert guarded.guarded_call(
+            "sh.pers", lambda: "kern", lambda: "fb") == "fb"
+        assert guarded.breaker_snapshot()["sh.pers"]["state"] == "open"
+        clock["t"] = 31.0
+        assert guarded.guarded_call(
+            "sh.pers", lambda: "kern", lambda: "fb") == "kern"
+        assert autotune.lookup(guarded._guard_key("sh.pers")) is None
+
+    def test_probe_never_strands_on_base_exception(self, clock):
+        """A probe exiting with a BaseException outside the handled set
+        (e.g. a cancelled-future error) must re-arm the breaker open —
+        a stranded probing flag would disable every future probe."""
+        class Boom(BaseException):
+            pass
+
+        def base_boom():
+            raise Boom()
+
+        assert guarded.guarded_call("sh.be", _boom, lambda: "fb") == "fb"
+        clock["t"] += 31.0
+        with pytest.raises(Boom):
+            guarded.guarded_call("sh.be", base_boom, lambda: "fb")
+        b = guarded.breaker_snapshot()["sh.be"]
+        assert b["state"] == "open"
+        # the next call can probe again immediately (abort, not failure:
+        # no backoff doubling, no stranded half-open)
+        assert guarded.guarded_call(
+            "sh.be", lambda: "kern", lambda: "fb") == "kern"
+        assert "sh.be" not in guarded.demoted_sites()
+
+    def test_snapshot_reads_race_free_and_json_safe(self, clock):
+        """Satellite: breaker state is read by background SnapshotWriter
+        threads while serving threads mutate it — the snapshot must be a
+        consistent, strict-JSON-safe copy."""
+        import threading
+
+        stop = threading.Event()
+        errs = []
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    json.dumps(guarded.breaker_snapshot(),
+                               allow_nan=False)
+                    json.dumps(guarded.demoted_sites())
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+        th = threading.Thread(target=reader, daemon=True)
+        th.start()
+        for i in range(50):
+            site = f"sh.race{i % 4}"
+            guarded.guarded_call(site, _boom, lambda: "fb")
+            clock["t"] += 31.0
+            guarded.guarded_call(site, lambda: "kern", lambda: "fb")
+        stop.set()
+        th.join(5)
+        assert not errs
+
+
+class TestProbeShards:
+    @pytest.fixture
+    def sharded_idx(self):
+        import jax
+        from jax.sharding import Mesh
+
+        from raft_tpu.parallel import sharded_ann
+
+        devs = jax.devices()
+        mesh = Mesh(np.array((devs * 2)[:2]), ("shard",))
+        rng = np.random.default_rng(5)
+        return sharded_ann.ShardedCagra(
+            mesh, data=rng.standard_normal((2, 8, 4)).astype(np.float32),
+            graphs=np.zeros((2, 8, 2), np.int32),
+            bases=np.array([0, 5], np.int32),
+            counts=np.array([5, 3], np.int32), n_total=8,
+            metric=sharded_ann.DistanceType.L2Expanded)
+
+    def test_probe_restores_marked_dead_shard(self, sharded_idx):
+        from raft_tpu.parallel import sharded_ann
+
+        idx = sharded_idx
+        idx.mark_shard_failed(1)
+        # the armed fault keeps the shard dead (the drillable hold)
+        with faults.inject("shard_dead",
+                           "sharded_ann.cagra.shard1") as f:
+            assert sharded_ann.probe_shards(idx) == {1: False}
+            assert not idx.shards_ok[1]
+            assert idx.last_probe[1]["ok"] is False
+            assert "shard fault armed" in idx.last_probe[1]["error"]
+            # the canary checks the fault WITHOUT consuming a firing: a
+            # background probe tick must not drain a count-limited
+            # budget armed for the search path
+            assert f.fires == 0
+        # fault cleared: the canary succeeds and flips shards_ok back
+        assert sharded_ann.probe_shards(idx) == {1: True}
+        assert idx.shards_ok[1] and idx.last_probe[1]["ok"] is True
+        restored = events.recent(kind="shard_restored")
+        assert restored and restored[-1]["site"] \
+            == "sharded_ann.cagra.shard1"
+        assert restored[-1]["served_frac"] == 1.0
+        # healthy shards are never re-probed
+        assert sharded_ann.probe_shards(idx) == {}
+        # the ops surface carries the per-shard probe verdicts (one
+        # entry per live index, aligned with the shards_ok lists)
+        snap = sharded_ann.ops_snapshot()
+        assert any(p.get("1", {}).get("ok") is True
+                   for p in snap["families"]["cagra"]["last_probe"])
+        text = debugz.render_text(registry=metrics.Registry())
+        assert "shard1 probe: ok" in text
+
+    def test_probe_all_and_snapshot_writer_hook(self, sharded_idx,
+                                                tmp_path):
+        from raft_tpu.parallel import sharded_ann
+
+        idx = sharded_idx
+        idx.mark_shard_failed(0)
+        w = debugz.SnapshotWriter(str(tmp_path / "z.json"),
+                                  hooks=[sharded_ann.probe_all])
+        w.tick()          # one maintenance tick, no thread needed
+        assert idx.shards_ok.all()
+        # a raising hook must not break the tick
+        debugz.SnapshotWriter(str(tmp_path / "z2.json"),
+                              hooks=[_boom, lambda: None]).tick()
+
+    def test_single_row_shard_is_probeable(self):
+        """A shard whose canary source has one row must still pass its
+        probe (the row clamp rounds DOWN, never up past the source)."""
+        import jax
+        from jax.sharding import Mesh
+
+        from raft_tpu.parallel import sharded_ann
+
+        devs = jax.devices()
+        mesh = Mesh(np.array((devs * 2)[:2]), ("shard",))
+        idx = sharded_ann.ShardedCagra(
+            mesh, data=np.ones((2, 1, 4), np.float32),
+            graphs=np.zeros((2, 1, 2), np.int32),
+            bases=np.array([0, 1], np.int32),
+            counts=np.array([1, 1], np.int32), n_total=2,
+            metric=sharded_ann.DistanceType.L2Expanded)
+        idx.mark_shard_failed(0)
+        assert sharded_ann.probe_shards(idx) == {0: True}
+        assert idx.shards_ok.all()
+
+    def test_failed_canary_counts_and_keeps_flag(self, sharded_idx):
+        from raft_tpu.parallel import sharded_ann
+
+        idx = sharded_idx
+        idx.mark_shard_failed(1)
+        before = metrics.counter("sharded.probe_failures.cagra").value
+
+        def bad_probe(index, i):
+            raise RuntimeError("device gone")
+
+        assert sharded_ann.probe_shards(idx, probe_fn=bad_probe) \
+            == {1: False}
+        assert not idx.shards_ok[1]
+        assert metrics.counter("sharded.probe_failures.cagra").value \
+            == before + 1
+        assert "device gone" in idx.last_probe[1]["error"]
+        idx.mark_shard_failed(1, ok=True)
+
+
+class TestBrownout:
+    def _rep(self, lat="ok", recall="ok", samples=0, note=None):
+        r = {"targets": {
+            "p99_latency_s": {"verdict": lat},
+            "recall": {"verdict": recall, "samples": samples}}}
+        if note:
+            r["targets"]["recall"]["note"] = note
+        return r
+
+    def test_ladder_steps_hysteresis_and_floor(self):
+        reg = metrics.Registry()
+        now = {"t": 0.0}
+        ctl = BrownoutController(
+            [{"max_wait_scale": 2.0, "n_probes": 12},
+             {"max_wait_scale": 4.0, "n_probes": 6}],
+            registry=reg, min_dwell_s=5.0, up_after_s=15.0,
+            clock=lambda: now["t"])
+        assert ctl.on_report(self._rep()) == 0
+        now["t"] = 10.0
+        assert ctl.on_report(self._rep(lat="breach")) == 1
+        assert ctl.max_wait_scale() == 2.0
+        # hysteresis: a second breach inside min_dwell does not step
+        now["t"] = 12.0
+        assert ctl.on_report(self._rep(lat="breach")) == 1
+        now["t"] = 20.0
+        assert ctl.on_report(self._rep(lat="breach")) == 2
+        # floor guard: latency still burning but the sentinel sees
+        # recall AT the floor -> refuse further degradation...
+        now["t"] = 30.0
+        assert ctl.on_report(self._rep(lat="breach", recall="warn",
+                                       samples=8)) == 2
+        # ...and a recall BREACH steps back up even mid-overload — and
+        # even INSIDE the dwell window (t=32 is 2s after a refused step
+        # attempt window): quality never waits out the hysteresis
+        now["t"] = 32.0
+        ctl._last_step_at = 31.0     # pin a fresh step for the dwell test
+        assert ctl.on_report(self._rep(lat="breach", recall="breach",
+                                       samples=8)) == 1
+        now["t"] = 40.0
+        # a sustained latency WARN is not green: the recovery timer
+        # must not accrue while one window still violates (stepping up
+        # mid-warn flaps straight back into the breach)
+        for t in (50.0, 60.0, 70.0):
+            now["t"] = t
+            assert ctl.on_report(self._rep(lat="warn")) == 1
+        # sustained green steps up toward baseline
+        for t in (80.0, 90.0):
+            now["t"] = t
+            assert ctl.on_report(self._rep()) == 1
+        now["t"] = 96.0
+        assert ctl.on_report(self._rep()) == 0
+        # every transition is an event + a gauge move + in the snapshot
+        evs = events.recent(kind="brownout")
+        arcs = [(e["level_from"], e["level_to"], e["reason"]) for e in evs]
+        assert arcs == [(0, 1, "latency"), (1, 2, "latency"),
+                        (2, 1, "recall_floor"), (1, 0, "recovered")]
+        assert reg.snapshot()["gauges"]["serve.brownout.level"] == 0
+        snap = ctl.snapshot()
+        assert len(snap["transitions"]) == 4
+        json.dumps(snap, allow_nan=False)
+
+    def test_insufficient_samples_does_not_block_stepdown(self):
+        """No sentinel samples = the floor is unwatched; the latency
+        ladder still works (the guard only bites when recall is
+        MEASURED at the floor)."""
+        ctl = BrownoutController(registry=metrics.Registry(),
+                                 min_dwell_s=0.0)
+        assert ctl.on_report(self._rep(
+            lat="breach", recall="ok", samples=0,
+            note="insufficient_samples")) == 1
+
+    def test_params_and_searcher_application(self):
+        from raft_tpu.neighbors import cagra, ivf_flat
+
+        ctl = BrownoutController(
+            [{"n_probes": 8, "itopk_size": 32, "max_wait_scale": 2.0}],
+            registry=metrics.Registry(), min_dwell_s=0.0)
+        base_f = ivf_flat.SearchParams(n_probes=40)
+        base_c = cagra.SearchParams(itopk_size=64)
+        assert ctl.params(base_f) is base_f          # level 0: untouched
+        ctl.on_report(self._rep(lat="breach"))
+        assert ctl.params(base_f).n_probes == 8
+        # unknown keys are ignored per family (one ladder, many families)
+        assert ctl.params(base_c).itopk_size == 32
+        assert ctl.params(base_c).search_width \
+            == base_c.search_width
+
+    def test_poll_evaluates_installed_slo(self):
+        reg = metrics.Registry()
+        eng = slo.SLOEngine(slo.Targets(max_shed_rate=0.1), registry=reg,
+                            name="u", fast_window_s=1.0, slow_window_s=1.0)
+        ctl = BrownoutController(slo=eng, registry=reg, min_dwell_s=0.0)
+        rep = ctl.poll()
+        assert rep["brownout_level"] == 0 and "targets" in rep
+
+    def test_debugz_brownout_section(self):
+        reg = metrics.Registry()
+        ctl = BrownoutController(registry=reg, min_dwell_s=0.0).install()
+        try:
+            ctl.on_report(self._rep(lat="breach"))
+            s = debugz.snapshot(registry=reg)
+            assert s["brownout"]["level"] == 1
+            json.dumps(s, allow_nan=False)
+            assert "brownout (level 1" in debugz.render_text(registry=reg)
+        finally:
+            degrade.uninstall()
+
+
+class TestScenario:
+    def test_timed_arm_hold_clear(self):
+        now = {"t": 0.0}
+        sc = (faults.Scenario(clock=lambda: now["t"])
+              .add("kernel_fault", "sc.*", at_s=0.0, until_s=5.0)
+              .add("shard_dead", "*.shard1", at_s=1.0, until_s=5.0)
+              .start())
+        assert faults.fired("kernel_fault", "sc.a") is not None
+        assert faults.fired("shard_dead", "x.shard1") is None
+        now["t"] = 1.5
+        assert sc.step() == ["armed shard_dead@*.shard1"]
+        assert faults.fired("shard_dead", "x.shard1") is not None
+        now["t"] = 5.0
+        assert len(sc.step()) == 2 and sc.finished()
+        assert faults.fired("kernel_fault", "sc.a") is None
+        # the scenario's own stages are fully disarmed (env-armed faults
+        # from the ambient lane may still be active — not ours)
+        assert not any(f.kind in ("kernel_fault", "shard_dead")
+                       for f in faults.active())
+        acts = [(e["site"], e["action"])
+                for e in events.recent(kind="fault_scenario")]
+        assert acts == [("kernel_fault@sc.*", "armed"),
+                        ("shard_dead@*.shard1", "armed"),
+                        ("kernel_fault@sc.*", "cleared"),
+                        ("shard_dead@*.shard1", "cleared")]
+
+    def test_stop_clears_held_stages(self):
+        now = {"t": 0.0}
+        with faults.Scenario(clock=lambda: now["t"]).add("io_error") as sc:
+            assert faults.fired("io_error", "x") is not None
+            assert not sc.finished()     # held until stop
+        assert faults.fired("io_error", "x") is None
+        with pytest.raises(ValueError):
+            faults.Scenario().add("x", at_s=5.0, until_s=1.0)
+
+
+@pytest.mark.slow
+class TestChaosDrill:
+    """ISSUE 10 acceptance: one end-to-end chaos drill — injected kernel
+    fault + dead shard + latency overload produce open breakers, partial
+    serve, and a brownout step-down; clearing the faults produces
+    breaker re-close, shards_ok restoration, and a ladder step back to
+    baseline — every transition trace-stamped, the recall sentinel back
+    above the floor, the whole arc readable from one debugz snapshot."""
+
+    DIM = 16
+
+    def test_full_recovery_arc(self, monkeypatch, tmp_path):
+        import jax
+
+        from ann_utils import naive_knn
+        from raft_tpu.neighbors import brute_force, cagra
+        from raft_tpu.parallel import sharded_ann
+        from raft_tpu.serve.batcher import BucketLadder, MicroBatcher
+        from raft_tpu.serve.quality import RecallSentinel
+
+        if any(f.kind in ("kernel_compile", "kernel_fault")
+               for f in faults.active()):
+            pytest.skip("ambient kernel faults would re-open the drill "
+                        "breaker")
+        monkeypatch.setenv("RAFT_TPU_AUTOTUNE_CACHE", "")
+        # breaker clock is virtual so probation is instant when stepped
+        gnow = {"t": 0.0}
+        monkeypatch.setattr(guarded, "_clock", lambda: gnow["t"])
+
+        rng = np.random.default_rng(13)
+        centers = rng.standard_normal((8, self.DIM)).astype(np.float32) * 4
+        labels = rng.integers(0, 8, size=400)
+        data = (centers[labels]
+                + rng.standard_normal((400, self.DIM))).astype(np.float32)
+        q = (centers[rng.integers(0, 8, size=200)]
+             + rng.standard_normal((200, self.DIM))).astype(np.float32)
+
+        index = cagra.build(data, cagra.IndexParams(
+            graph_degree=8, intermediate_graph_degree=16, seed=0,
+            seed_nodes=0))
+        stale = brute_force.build(jax.numpy.asarray(data[:100]))
+
+        reg = metrics.Registry()
+        ctl = BrownoutController(
+            [{"max_wait_scale": 2.0}],
+            registry=reg, min_dwell_s=0.0, up_after_s=0.05).install()
+        good = cagra.make_searcher(
+            index, cagra.SearchParams(itopk_size=32), degrade=ctl)
+
+        def serving(queries, k, res=None):
+            return guarded.guarded_call(
+                "drill.selfheal.search",
+                lambda: good(queries, k, res),
+                lambda: brute_force.search(stale, queries, k))
+
+        sentinel = RecallSentinel(
+            lambda qq, kk: naive_knn(np.asarray(data), np.asarray(qq), kk),
+            sample=1.0, floor=0.7, window=6, min_samples=3,
+            max_pending=64, registry=reg, family="cagra")
+        eng = slo.SLOEngine(
+            slo.Targets(p99_latency_s=0.05, recall_floor=0.7,
+                        recall_family="cagra", recall_min_samples=3),
+            registry=reg, name="serve", fast_window_s=0.2,
+            slow_window_s=0.4)
+        ctl._slo = eng
+        # the dead shard half of the blast radius (handmade: the drill
+        # exercises mark -> probe-held-down -> restore, not shard_map)
+        mesh = jax.sharding.Mesh(np.array((jax.devices() * 2)[:2]),
+                                 ("shard",))
+        sidx = sharded_ann.ShardedCagra(
+            mesh, data=rng.standard_normal((2, 8, 4)).astype(np.float32),
+            graphs=np.zeros((2, 8, 2), np.int32),
+            bases=np.array([0, 5], np.int32),
+            counts=np.array([5, 3], np.int32), n_total=8,
+            metric=sharded_ann.DistanceType.L2Expanded)
+
+        b = MicroBatcher(serving, self.DIM,
+                         ladder=BucketLadder((8,), (8,)), registry=reg,
+                         max_wait_s=0.001, sentinel=sentinel, degrade=ctl)
+        snaps = []
+        try:
+            # ---- phase A: healthy baseline ----
+            for j in range(6):
+                b.search(q[8 * j: 8 * (j + 1)], 8, timeout=120)
+            assert sentinel.drain(60)
+            assert sentinel.estimate("cagra") >= 0.75
+            eng.evaluate()
+            assert ctl.level == 0
+
+            # ---- phase B: chaos — kernel fault + dead shard +
+            # overload, held by one timed scenario ----
+            sc = (faults.Scenario()
+                  .add("kernel_fault", "drill.selfheal.search")
+                  .add("shard_dead", "sharded_ann.cagra.shard1")
+                  .add("slow_dispatch", "serve.batch", value=0.08)
+                  .start())
+            sidx.mark_shard_failed(1)
+            assert sharded_ann.probe_shards(sidx) == {1: False}
+            for j in range(6, 12):
+                b.search(q[8 * j: 8 * (j + 1)], 8, timeout=120)
+            assert sentinel.drain(60)
+            # breaker open on the injected kernel fault; partial serve
+            assert "drill.selfheal.search" in guarded.demoted_sites()
+            assert guarded.breaker_snapshot()[
+                "drill.selfheal.search"]["injected"]
+            assert not sidx.shards_ok[1]
+            assert sharded_ann.health(sidx)["served_frac"] < 1.0
+            # recall collapsed through the stale fallback; SLO breaches;
+            # the brownout ladder steps down on the latency breach
+            assert sentinel.estimate("cagra") < 0.6
+            rep = eng.evaluate()
+            assert rep["targets"]["recall"]["verdict"] == "breach"
+            assert rep["targets"]["p99_latency_s"]["verdict"] == "breach"
+            ctl.on_report({"targets": {
+                "p99_latency_s": rep["targets"]["p99_latency_s"]}})
+            assert ctl.level == 1 and ctl.max_wait_scale() == 2.0
+            snaps.append(debugz.snapshot(batcher=b, registry=reg, slo=eng))
+
+            # ---- phase C: faults clear; probes close the loop ----
+            sc.stop()
+            assert sharded_ann.probe_all() == {"cagra": {1: True}}
+            assert sidx.shards_ok[1]
+            gnow["t"] += 3600.0          # probation long over
+            for j in range(12, 20):
+                b.search(q[8 * j: 8 * (j + 1)], 8, timeout=120)
+            assert sentinel.drain(60)
+            # the first post-clear dispatch probed and re-closed
+            assert "drill.selfheal.search" not in guarded.demoted_sites()
+            assert guarded.breaker_snapshot()[
+                "drill.selfheal.search"]["state"] == "closed"
+            # quality restored above the floor
+            assert sentinel.estimate("cagra") >= 0.75
+            rep = eng.evaluate()
+            assert rep["targets"]["recall"]["verdict"] == "ok"
+            # sustained green steps the ladder back to baseline
+            time.sleep(0.1)
+            ctl.on_report(self._ok_report())
+            time.sleep(0.1)
+            ctl.on_report(self._ok_report())
+            assert ctl.level == 0
+            snaps.append(debugz.snapshot(batcher=b, registry=reg, slo=eng))
+        finally:
+            b.close()
+            sentinel.close()
+            degrade.uninstall()
+            slo.uninstall()
+
+        # ---- the whole arc is on the record, strict-JSON end to end ----
+        kinds = [e["kind"] for e in events.recent()]
+        for kind in ("fault_scenario", "breaker_open", "shard_marked",
+                     "recall_regression", "slo_breach", "brownout",
+                     "shard_restored", "breaker_probe", "breaker_close"):
+            assert kind in kinds, f"missing {kind} in the flight recorder"
+        # ordering: open before probe before close; restore after mark
+        assert kinds.index("breaker_open") < kinds.index("breaker_probe") \
+            < kinds.index("breaker_close")
+        degraded, healthy = snaps
+        assert degraded["breakers"]["drill.selfheal.search"]["state"] \
+            == "open"
+        assert degraded["brownout"]["level"] == 1
+        assert degraded["slo"]["verdict"] == "breach"
+        assert degraded["sharded"]["families"]["cagra"]["shards_ok"][-1] \
+            == [True, False]
+        assert healthy["breakers"]["drill.selfheal.search"]["state"] \
+            == "closed"
+        assert healthy["brownout"]["level"] == 0
+        assert any(p.get("1", {}).get("ok") is True for p in
+                   healthy["sharded"]["families"]["cagra"]["last_probe"])
+        for s in snaps:
+            json.dumps(s, allow_nan=False)
+        path = tmp_path / "drill.jsonl"
+        assert events.export_jsonl(str(path)) > 0
+
+    @staticmethod
+    def _ok_report():
+        return {"targets": {"p99_latency_s": {"verdict": "ok"},
+                            "recall": {"verdict": "ok", "samples": 8}}}
