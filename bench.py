@@ -843,6 +843,110 @@ def main():
              "recall_source": flat_name, "trace_sample": 1.0},
             batch=n_req, baseline_key=None)
 
+    # --- mutation: the mutable-tier write path (docs/mutation.md) -------
+    # Records what mutability COSTS: WAL'd acked-upsert throughput, the
+    # delta-tier search penalty (p50 with a populated delta fan-out vs
+    # after the background merge folds it), and recall before/after the
+    # merge scored by the RecallSentinel against an exact reference over
+    # the live logical corpus. RAFT_TPU_BENCH_MUTATION=0 skips /
+    # =1 forces past the budget gate.
+    mut_env = os.environ.get("RAFT_TPU_BENCH_MUTATION")
+    mut_left = budget_s - (time.perf_counter() - t_start)
+    if mut_env != "0" and (mut_env == "1" or mut_left > 180):
+        with algo_section('mutation'):
+            import shutil
+            import tempfile
+
+            from raft_tpu.neighbors import mutable as mutable_mod
+            from raft_tpu.serve.metrics import Registry as _MutReg
+            from raft_tpu.serve.quality import RecallSentinel as _MutSent
+
+            mut_dir = tempfile.mkdtemp(prefix="raft_tpu_mut_")
+            try:
+                base_n = min(100_000, int(parts[0].shape[0]))
+                base = np.asarray(jax.device_get(parts[0][:base_n]),
+                                  np.float32)
+                qh = np.asarray(jax.device_get(queries[:256]), np.float32)
+                t0 = time.perf_counter()
+                midx = mutable_mod.create(os.path.join(mut_dir, "idx"),
+                                          base, family="brute_force")
+                mut_build = time.perf_counter() - t0
+
+                def _mut_search(qs=qh, kk=k):
+                    dd, ii = midx.search(qs, kk)
+                    return float(jnp.sum(dd).block_until_ready())
+
+                sealed_p50 = median_time(_mut_search, reps=7)
+                # WAL'd upsert throughput: every batch is acked
+                # (framed + CRC'd + fsynced) before the next starts
+                up_rows, up_batch = 8192, 1024
+                rng_m = np.random.default_rng(17)
+                up = base[rng_m.integers(0, base_n, up_rows)] + \
+                    rng_m.normal(scale=0.05,
+                                 size=(up_rows, d)).astype(np.float32)
+                t0 = time.perf_counter()
+                for b0 in range(0, up_rows, up_batch):
+                    midx.upsert(None, up[b0:b0 + up_batch])
+                upsert_wall = time.perf_counter() - t0
+                # measured BEFORE the merge rotates the log: WAL bytes
+                # actually paid per acked row (frames + npy framing)
+                wal_row_bytes = midx.wal_bytes() / up_rows
+                delta_p50 = median_time(_mut_search, reps=7)
+
+                # exact reference over the live logical corpus (ids in
+                # the mutable tier == row positions in this concat)
+                from raft_tpu.neighbors import brute_force as _bf
+                _ref_idx = _bf.build(np.concatenate([base, up]))
+
+                def _mut_ref(qs, kk):
+                    rd, ri = _bf.search(_ref_idx, jnp.asarray(qs), kk)
+                    return np.asarray(rd), np.asarray(ri)
+
+                def _mut_recall(tag):
+                    sent = _MutSent(_mut_ref, sample=1.0,
+                                    registry=_MutReg(), family="mutable",
+                                    engine=tag, window=64, max_pending=8)
+                    dd, ii = midx.search(qh[:64], k)
+                    sent.offer(qh[:64], k, np.asarray(dd), np.asarray(ii))
+                    sent.drain(120.0)
+                    est = sent.estimate("mutable")
+                    sent.close()
+                    return None if est is None else round(est, 4)
+
+                recall_before = _mut_recall("pre_merge")
+                t0 = time.perf_counter()
+                verdict = midx.merge()
+                merge_s = time.perf_counter() - t0
+                merged_p50 = median_time(_mut_search, reps=7)
+                recall_after = _mut_recall("post_merge")
+                add_entry(
+                    "mutation", f"mutation.brute{base_n // 1000}k",
+                    upsert_wall, delta_p50,
+                    recall_after if recall_after is not None else -1.0,
+                    mut_build,
+                    {"upsert_rows_per_s": round(up_rows / upsert_wall, 1),
+                     "acked_batches": up_rows // up_batch,
+                     "wal_bytes_per_row": round(wal_row_bytes, 1),
+                     "sealed_p50_ms": None if sealed_p50 is None
+                     else round(sealed_p50 * 1e3, 3),
+                     "delta_p50_ms": None if delta_p50 is None
+                     else round(delta_p50 * 1e3, 3),
+                     "delta_p50_delta_ms": None
+                     if None in (sealed_p50, delta_p50)
+                     else round((delta_p50 - sealed_p50) * 1e3, 3),
+                     "merged_p50_ms": None if merged_p50 is None
+                     else round(merged_p50 * 1e3, 3),
+                     "merge_verdict": verdict,
+                     "merge_s": round(merge_s, 2),
+                     "recall_sentinel_before_merge": recall_before,
+                     "recall_sentinel_after_merge": recall_after},
+                    batch=up_rows, baseline_key=None)
+            finally:
+                shutil.rmtree(mut_dir, ignore_errors=True)
+    else:
+        log(f"# mutation lane skipped ({mut_left:.0f}s left; "
+            "set RAFT_TPU_BENCH_MUTATION=1 to force)")
+
     # --- ivf_pq (config 3) + refine -------------------------------------
     # kernel round 4: pq_bits=4 with pq_dim=d (same 512 code bits/row as
     # pq64x8 but an 8x narrower one-hot decode) + int8-quantized LUT (the

@@ -32,7 +32,12 @@ Well-known kinds (open set — emitters define meaning):
 (docs/robustness.md): ``breaker_open`` / ``breaker_probe`` /
 ``breaker_close`` (ops/guarded circuit breakers), ``shard_restored``
 (sharded_ann.probe_shards), ``brownout`` (serve/degrade ladder moves),
-``fault_scenario`` (timed chaos-drill stage transitions).
+``fault_scenario`` (timed chaos-drill stage transitions) — and the
+mutable-tier set (docs/mutation.md, neighbors/mutable.py): ``upsert`` /
+``delete`` (one per mutation call, trace-stamped like every serving
+event), ``merge_started`` / ``merge_committed`` / ``merge_abandoned``
+(the background-merge state machine), ``wal_recovered`` (a
+``recover()`` replay, with record/truncation counts).
 
 Details are scrubbed JSON-safe at record time: non-finite floats become
 None, numpy scalars/arrays become python values/lists (large arrays a

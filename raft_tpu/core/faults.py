@@ -19,8 +19,15 @@ Spec grammar (``RAFT_TPU_FAULTS``, comma-separated)::
   ``kernel_fault`` (a simulated *persistent* kernel failure: drives the
   ``ops/guarded`` breaker open and keeps its probes failing while
   armed), ``shard_dead``, ``shard_timeout``, ``corrupt_bytes``,
-  ``io_error``, ``slow_dispatch`` (kinds are open strings; probes
-  define meaning).
+  ``io_error``, ``slow_dispatch``, ``crash_point`` (simulated process
+  death at a named site: the probe raises :class:`InjectedCrash`, a
+  ``BaseException`` that no containment layer may swallow — the
+  crash-drill harness catches it, then exercises ``recover()`` on the
+  on-disk state exactly as a restarted process would), ``wal_torn_tail``
+  (a write cut mid-frame: :func:`cut` returns only a prefix of the
+  bytes and the probing writer raises :class:`InjectedCrash`, leaving a
+  torn frame on disk for recovery to truncate) — kinds are open
+  strings; probes define meaning.
 * ``pattern`` — fnmatch pattern over the site name (default ``*``).
 * ``count`` — fire at most this many times (default unlimited).
 * ``value`` — kind-specific argument (sleep seconds for
@@ -57,9 +64,9 @@ from typing import List, Optional
 
 from .errors import RaftError
 
-__all__ = ["InjectedFault", "Fault", "inject", "fired", "check", "sleep_if",
-           "corrupt", "active", "seen_sites", "reload_env", "reset_stats",
-           "Scenario"]
+__all__ = ["InjectedFault", "InjectedCrash", "Fault", "inject", "fired",
+           "check", "sleep_if", "corrupt", "crash", "cut", "active",
+           "seen_sites", "reload_env", "reset_stats", "Scenario"]
 
 
 class InjectedFault(RaftError):
@@ -69,6 +76,24 @@ class InjectedFault(RaftError):
         self.kind = kind
         self.site = site
         super().__init__(f"injected fault {kind!r} at site {site!r}")
+
+
+class InjectedCrash(BaseException):
+    """Simulated process death at a named crash point.
+
+    Deliberately NOT an :class:`Exception` (and not an
+    :class:`InjectedFault`): every containment layer in the tree —
+    ``guarded_call``'s broad ``except Exception``, telemetry guards,
+    merge abandon handlers — must treat it like a kill signal and let
+    it propagate, because the real event it simulates gives the process
+    no chance to run any handler at all. The crash-drill harness arms
+    ``crash_point@<site>:1``, catches this at the top, and then drives
+    ``recover()`` against whatever reached disk."""
+
+    def __init__(self, kind: str, site: str):
+        self.kind = kind
+        self.site = site
+        super().__init__(f"injected crash {kind!r} at site {site!r}")
 
 
 @dataclasses.dataclass
@@ -216,6 +241,35 @@ def corrupt(site: str, data):
     out = bytearray(data)
     out[off] ^= 0x01
     return bytes(out)
+
+
+def crash(site: str) -> None:
+    """``crash_point`` probe: simulate the process dying HERE.
+
+    Raises :class:`InjectedCrash` when armed — a ``BaseException``, so
+    no ``except Exception`` containment path can accidentally "survive"
+    a crash the drill meant to be fatal. Durable-state writers place
+    these probes at the instants whose on-disk state recovery must
+    handle (mid-WAL-append, pre/post-manifest-flip, mid-merge)."""
+    if fired("crash_point", site) is not None:
+        raise InjectedCrash("crash_point", site)
+
+
+def cut(site: str, data: bytes) -> bytes:
+    """``wal_torn_tail`` probe: simulate a write torn mid-frame.
+
+    When armed, returns only a prefix of ``data`` (the armed byte
+    offset, else half) and the caller is expected to write that prefix
+    and then die — :func:`WriteAheadLog.append
+    <raft_tpu.core.wal.WriteAheadLog.append>` raises
+    :class:`InjectedCrash` after flushing the torn prefix, so the file
+    recovery sees is exactly what a power cut mid-``write(2)`` leaves.
+    Unarmed: returns ``data`` unchanged (not copied)."""
+    f = fired("wal_torn_tail", site)
+    if f is None or not data:
+        return data
+    off = int(f.value) if f.value else len(data) // 2
+    return bytes(data[: max(1, min(off, len(data) - 1))])
 
 
 def active() -> List[Fault]:

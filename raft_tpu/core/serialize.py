@@ -19,7 +19,12 @@ Durability (the resilience layer):
 * **Atomic writes.** Path saves write to a same-directory temp file and
   ``os.replace`` into place, so an interrupted save never leaves a
   partial file at the target path (and never clobbers a previous good
-  file).
+  file). The parent directory is fsynced after the rename
+  (:func:`fsync_dir`) — without it the rename itself can be lost on
+  power failure even though the file's *data* was fsynced, and "the
+  save returned" must mean "the save survives a crash" (the WAL and
+  manifest writers in :mod:`raft_tpu.core.wal` /
+  :mod:`raft_tpu.neighbors.mutable` lean on the same helper).
 """
 from __future__ import annotations
 
@@ -45,7 +50,30 @@ __all__ = [
     "deserialize_header",
     "save_arrays",
     "load_arrays",
+    "fsync_dir",
 ]
+
+
+def fsync_dir(path) -> None:
+    """fsync the directory containing ``path`` (or ``path`` itself when
+    it IS a directory): durability for renames and creates. An
+    ``os.replace`` only becomes crash-durable once the parent
+    directory's entry table hits disk. Platforms whose directory
+    handles reject fsync (some network filesystems) degrade silently —
+    the rename still happened, we just can't strengthen it."""
+    d = os.fspath(path)
+    if not os.path.isdir(d):
+        d = os.path.dirname(d) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 _MAGIC = b"RAFT_TPU"      # legacy (pre-checksum) layout
 # the checksummed layout gets its OWN magic: the layout discriminator
@@ -325,6 +353,9 @@ def save_arrays(path_or_file, kind: str, version: int, meta: Dict[str, Any],
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, path)
+            # the rename is only crash-durable once the parent
+            # directory entry hits disk too
+            fsync_dir(path)
         except BaseException:
             try:
                 os.unlink(tmp)

@@ -1,0 +1,256 @@
+"""CRC32-framed write-ahead log for mutable indexes.
+
+The durability contract of :mod:`raft_tpu.neighbors.mutable`: every
+mutation (upsert/delete) is appended here — framed, checksummed,
+fsynced — BEFORE the caller is acked, so an acked write survives any
+crash. The reference has no analog (RAFT indexes are build-once); the
+design follows the standard WAL discipline (ARIES / FreshDiskANN's
+delta-log) mapped onto the PR 1 durable-I/O idioms: CRC32 per frame,
+``os.fsync`` before ack, parent-directory fsync on create
+(:func:`raft_tpu.core.serialize.fsync_dir`).
+
+Wire format::
+
+    RAFTWAL1 <u32 version>                      -- file header
+    [ <u32 payload_len> <payload> <u32 crc> ]*  -- frames, appended
+
+``crc`` is CRC32 over the length prefix + payload, so a frame whose
+length field itself was torn fails the check. Frame payloads are
+records: a one-byte kind (``U`` upsert / ``D`` delete) followed by
+``.npy``-framed arrays (ids; vectors for upserts) — the same numpy
+framing the index serializer uses, so nothing here depends on pickle.
+
+Recovery semantics (:func:`replay`):
+
+* a frame that extends past EOF, or whose CRC fails **on the last
+  frame**, is a *torn tail* — the in-flight append the crash
+  interrupted. It was never acked, so recovery truncates it
+  (``repair=True``) and the log is consistent;
+* a CRC failure with more complete frames AFTER it is *mid-log
+  corruption* — acked data is damaged, silence would serve wrong
+  results — and raises :class:`~raft_tpu.core.errors.CorruptIndexError`
+  naming the frame.
+
+Crash drills: :meth:`WriteAheadLog.append` probes
+``crash_point@core.wal.append`` between the write and the fsync, and
+``wal_torn_tail@core.wal.append`` cuts the frame bytes mid-write (both
+then raise :class:`~raft_tpu.core.faults.InjectedCrash`), so
+tests/test_mutable.py can leave *exactly* the on-disk states a power
+cut leaves and assert ``recover()`` handles each.
+"""
+from __future__ import annotations
+
+import io
+import os
+import struct
+import zlib
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from .errors import CorruptIndexError
+from .serialize import fsync_dir
+from . import faults
+
+__all__ = ["WriteAheadLog", "replay", "APPEND_SITE"]
+
+_MAGIC = b"RAFTWAL1"
+_VERSION = 1
+_HEADER_LEN = len(_MAGIC) + 4
+
+# the named mid-append crash/torn-write site (docs/mutation.md)
+APPEND_SITE = "core.wal.append"
+
+_KINDS = (b"U", b"D")
+
+
+def _encode_record(kind: str, ids, vectors=None) -> bytes:
+    tag = {"upsert": b"U", "delete": b"D"}[kind]
+    buf = io.BytesIO()
+    buf.write(tag)
+    np.save(buf, np.ascontiguousarray(ids, dtype=np.int64),
+            allow_pickle=False)
+    if tag == b"U":
+        np.save(buf, np.ascontiguousarray(vectors, dtype=np.float32),
+                allow_pickle=False)
+    return buf.getvalue()
+
+
+def _decode_record(payload: bytes, frame: str):
+    tag = payload[:1]
+    if tag not in _KINDS:
+        raise CorruptIndexError(frame, f"unknown record kind {tag!r}")
+    buf = io.BytesIO(payload[1:])
+    try:
+        ids = np.load(buf, allow_pickle=False)
+        vectors = np.load(buf, allow_pickle=False) if tag == b"U" else None
+    except (ValueError, OSError, EOFError) as e:
+        raise CorruptIndexError(frame, f"bad record arrays: {e}") from e
+    return ("upsert" if tag == b"U" else "delete"), ids, vectors
+
+
+class WriteAheadLog:
+    """Append-only mutation log. Single-writer (the owning
+    :class:`~raft_tpu.neighbors.mutable.MutableIndex` serializes appends
+    under its lock); readers use the module-level :func:`replay`."""
+
+    def __init__(self, path: str, _f):
+        self.path = path
+        self._f = _f
+        # offset after the last SUCCESSFUL append: a failed/partial
+        # write leaves torn bytes past this point, and the next append
+        # truncates back to it first (see append)
+        self._good_end = _f.tell()
+
+    # -- lifecycle --------------------------------------------------------
+    @classmethod
+    def create(cls, path: str) -> "WriteAheadLog":
+        """Create a fresh log (header written, fsynced, parent dir
+        fsynced — the file's existence itself must survive a crash
+        before the manifest may reference it)."""
+        with open(path, "wb") as f:
+            f.write(_MAGIC + struct.pack("<I", _VERSION))
+            f.flush()
+            os.fsync(f.fileno())
+        fsync_dir(path)
+        return cls.open(path)
+
+    @classmethod
+    def open(cls, path: str) -> "WriteAheadLog":
+        """Open an existing log for appending (header verified)."""
+        f = open(path, "r+b")
+        try:
+            head = f.read(_HEADER_LEN)
+            if head[: len(_MAGIC)] != _MAGIC:
+                raise CorruptIndexError("wal header",
+                                        "not a raft_tpu WAL (bad magic)")
+            f.seek(0, os.SEEK_END)
+        except BaseException:
+            f.close()
+            raise
+        return cls(path, f)
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def seal(self) -> None:
+        """Drop any torn un-acked tail (a failed append's leftovers)
+        and fsync. Called before the log is rotated out of the active
+        slot: a rotated-out log is replayed with
+        ``allow_torn_tail=False``, so it must be whole-frames-only."""
+        f = self._f
+        if f.tell() != self._good_end:
+            f.truncate(self._good_end)
+            f.seek(self._good_end)
+            f.flush()
+            os.fsync(f.fileno())
+
+    # -- writes -----------------------------------------------------------
+    def size_bytes(self) -> int:
+        return self._f.tell()
+
+    def append(self, kind: str, ids, vectors=None) -> None:
+        """Durably append one mutation record; returns only after the
+        frame is on disk (write + flush + fsync). The caller acks its
+        client AFTER this returns — that ordering IS the durability
+        contract. A failed append (ENOSPC mid-write, a raised fault)
+        leaves the un-acked torn bytes on disk but the NEXT append
+        truncates back to the last good frame first — a retried write
+        must never land after garbage, where recovery would either
+        truncate the acked retry away or read mid-log corruption."""
+        payload = _encode_record(kind, ids, vectors)
+        hdr = struct.pack("<I", len(payload))
+        frame = hdr + payload + struct.pack(
+            "<I", zlib.crc32(payload, zlib.crc32(hdr)))
+        f = self._f
+        if f.tell() != self._good_end:
+            f.truncate(self._good_end)
+            f.seek(self._good_end)
+        torn = faults.cut(APPEND_SITE, frame)
+        if len(torn) != len(frame):
+            # simulated power cut mid-write(2): flush the prefix so the
+            # torn frame is really on disk, then die
+            f.write(torn)
+            f.flush()
+            os.fsync(f.fileno())
+            raise faults.InjectedCrash("wal_torn_tail", APPEND_SITE)
+        f.write(frame)
+        f.flush()
+        # simulated death between write and fsync: the frame may or may
+        # not survive — either is a legal recovery outcome for an
+        # UN-acked write, and the drill asserts recover() handles both
+        faults.crash(APPEND_SITE)
+        os.fsync(f.fileno())
+        self._good_end = f.tell()
+
+
+def replay(path: str, repair: bool = False,
+           allow_torn_tail: bool = True) -> Tuple[list, int]:
+    """Read every good frame of ``path`` → (records, truncated_bytes).
+
+    ``records`` is a list of ``(kind, ids, vectors)`` tuples in append
+    order. A torn tail (see module docstring) stops the replay; with
+    ``repair=True`` the file is physically truncated at the last good
+    frame (fsynced) so later appends extend a clean log.
+    ``truncated_bytes`` reports how much tail was dropped (0 on a clean
+    log). ``allow_torn_tail=False`` (non-last logs of a multi-log
+    manifest, which were rotated closed and can have no in-flight
+    append) turns ANY bad frame into mid-log corruption.
+
+    Raises :class:`CorruptIndexError` on mid-log corruption — damaged
+    *acked* data is never silently dropped.
+    """
+    records: list = []
+    with open(path, "rb") as f:
+        head = f.read(_HEADER_LEN)
+        if len(head) < _HEADER_LEN or head[: len(_MAGIC)] != _MAGIC:
+            raise CorruptIndexError("wal header",
+                                    f"{path}: not a raft_tpu WAL")
+        end = f.seek(0, os.SEEK_END)
+        pos = _HEADER_LEN
+        f.seek(pos)
+        good_end = pos
+        torn: Optional[str] = None
+        n_frame = 0
+        while pos < end:
+            n_frame += 1
+            frame_name = f"wal frame {n_frame}"
+            hdr = f.read(4)
+            if len(hdr) < 4:
+                torn = f"{frame_name}: truncated length prefix"
+                break
+            (plen,) = struct.unpack("<I", hdr)
+            if pos + 4 + plen + 4 > end:
+                torn = (f"{frame_name}: frame wants {plen} payload bytes, "
+                        f"{end - pos - 8} remain")
+                break
+            payload = f.read(plen)
+            (want,) = struct.unpack("<I", f.read(4))
+            got = zlib.crc32(payload, zlib.crc32(hdr))
+            pos = pos + 4 + plen + 4
+            if got != want:
+                if pos >= end:
+                    # bad CRC on the very last frame: torn mid-overwrite
+                    torn = (f"{frame_name}: CRC mismatch "
+                            f"({got:#010x} != {want:#010x}) at tail")
+                    break
+                raise CorruptIndexError(
+                    frame_name,
+                    f"{path}: CRC mismatch ({got:#010x} != {want:#010x}) "
+                    "mid-log — acked data is damaged")
+            records.append(_decode_record(payload, frame_name))
+            good_end = pos
+    truncated = 0
+    if torn is not None:
+        if not allow_torn_tail:
+            raise CorruptIndexError(
+                "wal tail", f"{path}: torn frame in a closed log ({torn})")
+        truncated = end - good_end
+        if repair:
+            with open(path, "r+b") as f:
+                f.truncate(good_end)
+                f.flush()
+                os.fsync(f.fileno())
+    return records, truncated

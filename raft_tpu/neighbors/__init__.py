@@ -3,10 +3,12 @@
 Families mirror the reference: ``brute_force`` (exact), ``ivf_flat``,
 ``ivf_pq``, ``cagra`` (+ ``nn_descent`` builder), ``refine``, ``hnsw``
 (CPU interop), ``ball_cover``, ``epsilon_neighborhood``; sample filters in
-``filters``.
+``filters``. ``mutable`` wraps any family in the crash-safe
+upsert/delete tier (WAL'd delta segment + tombstones + background
+merge; docs/mutation.md).
 """
-from . import (ann_types, brute_force, cagra, ivf_flat, ivf_pq, nn_descent,
-               refine)
+from . import (ann_types, brute_force, cagra, ivf_flat, ivf_pq, mutable,
+               nn_descent, refine)
 
 __all__ = ["ann_types", "brute_force", "cagra", "ivf_flat", "ivf_pq",
-           "nn_descent", "refine"]
+           "mutable", "nn_descent", "refine"]

@@ -743,6 +743,33 @@ def build(dataset, params: IndexParams | None = None) -> Index:
     t1 = _time.perf_counter()
     graph = optimize(knn, degree)
     t2 = _time.perf_counter()
+    seeds = build_covering_seeds(dataset, p, mt)
+    t3 = _time.perf_counter()
+    rlog.log_info(
+        "cagra.build n=%d: knn_graph %.1fs (%s), optimize %.1fs, "
+        "seeds %.1fs", n, t1 - t0, galgo, t2 - t1, t3 - t2)
+    index = Index(jnp.asarray(dataset), jnp.asarray(graph), mt, seeds)
+    # phase decomposition for harnesses (the bench records it on CAGRA
+    # entries): a plain host attribute, NOT part of the pytree — it is
+    # diagnostics, not index state
+    index.build_stats = {"n": n, "knn_algo": galgo,
+                         "knn_graph_s": round(t1 - t0, 1),
+                         "optimize_s": round(t2 - t1, 1),
+                         "seeds_s": round(t3 - t2, 1)}
+    return index
+
+
+def build_covering_seeds(dataset, p: "IndexParams", mt):
+    """The seed-set POLICY (sizing + <64-row clamp) applied to a
+    corpus → (s,) seed rows or None. One home for the policy so every
+    index constructor — ``build`` and the mutable tier's warm-started
+    merge rebuild (neighbors/mutable.py), which bypasses ``build`` to
+    feed ``build_knn_graph`` an init graph — sizes seeds identically;
+    a rebuild path that skipped this would silently regress to
+    random-only seeding after the first merge."""
+    from ..core import logging as rlog
+
+    n = len(dataset)
     if p.seed_nodes < 0:
         # auto: scale coverage with the corpus; skip tiny corpora where
         # random seeding already covers the space
@@ -759,21 +786,8 @@ def build(dataset, params: IndexParams | None = None) -> Index:
                 "cagra.build: seed_nodes=%d is below the 64-row search "
                 "threshold; skipping seed construction", n_seed)
             n_seed = 0
-    seeds = (_covering_seeds(dataset, n_seed, mt, p.seed)
-             if n_seed > 0 else None)
-    t3 = _time.perf_counter()
-    rlog.log_info(
-        "cagra.build n=%d: knn_graph %.1fs (%s), optimize %.1fs, "
-        "seeds %.1fs", n, t1 - t0, galgo, t2 - t1, t3 - t2)
-    index = Index(jnp.asarray(dataset), jnp.asarray(graph), mt, seeds)
-    # phase decomposition for harnesses (the bench records it on CAGRA
-    # entries): a plain host attribute, NOT part of the pytree — it is
-    # diagnostics, not index state
-    index.build_stats = {"n": n, "knn_algo": galgo,
-                         "knn_graph_s": round(t1 - t0, 1),
-                         "optimize_s": round(t2 - t1, 1),
-                         "seeds_s": round(t3 - t2, 1)}
-    return index
+    return _covering_seeds(dataset, n_seed, mt, p.seed) if n_seed > 0 \
+        else None
 
 
 def _covering_seeds(dataset, s: int, mt, seed: int) -> jax.Array:

@@ -216,6 +216,15 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
     O(batch) device scatter while lists have capacity slack; a list
     overflow triggers a device-side repack with ``list_growth`` slack
     (no host copies of the dataset either way).
+
+    .. note:: For *online* mutation prefer the crash-safe tier,
+       :class:`raft_tpu.neighbors.mutable.MutableIndex` — it adds
+       durability (WAL'd upserts), deletes (tombstones) and a
+       background merge, and its parity test pins
+       ``upsert + merge == build`` on the concatenated corpus
+       (docs/mutation.md). ``extend`` remains the right call inside
+       bulk streaming builds (``build_from_batches``), where the WAL
+       would only be overhead.
     """
     from ._list_layout import scatter_build, scatter_extend
     from .brute_force import dequantize_rows, quantize_rows

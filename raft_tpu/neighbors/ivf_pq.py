@@ -424,6 +424,12 @@ def extend(index: Index, new_vectors, new_ids=None,
     stays O(batch)), and the merge is an O(batch) in-place scatter while
     lists have capacity slack (``IndexParams.list_growth``), else a
     device-side repack.
+
+    .. note:: For *online* mutation prefer the crash-safe tier,
+       :class:`raft_tpu.neighbors.mutable.MutableIndex` — durability
+       (WAL'd upserts), deletes (tombstones), background merge
+       (docs/mutation.md). ``extend`` remains the right call inside
+       bulk streaming builds (``build_from_batches``).
     """
     from ._list_layout import scatter_build, scatter_extend
 

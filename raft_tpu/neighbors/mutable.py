@@ -1,0 +1,1056 @@
+"""Crash-safe mutable index: a WAL'd LSM tier over the static families.
+
+Every raft_tpu index family is build-once; the reference's IVF
+``extend`` (mirrored by :func:`ivf_flat.extend` / :func:`ivf_pq.extend`)
+adds rows but cannot delete and survives nothing. This module makes any
+family safely *mutable* with the FreshDiskANN-style decomposition:
+
+* a **sealed segment** — one immutable CAGRA / IVF-Flat / IVF-PQ /
+  brute-force index over the corpus as of the last merge;
+* a **delta segment** — a small brute-force tier absorbing
+  :meth:`~MutableIndex.upsert` (the PR 3 fused streaming kernel is
+  exact and fast at delta scale, ≤128k rows);
+* **tombstones** — :meth:`~MutableIndex.delete` clears a
+  :class:`~raft_tpu.core.bitset.Bitset` bit per sealed slot, masked
+  into the sealed search through each family's existing filter path
+  (the tombstone is checked INSIDE the sealed search, before the merge,
+  so delete-then-reinsert of an id is exact);
+* queries fan out sealed + delta and merge through
+  :func:`brute_force.knn_merge_parts` — the same select machinery the
+  sharded path trusts for bit-identical merges.
+
+Durability (docs/mutation.md): every mutation appends to a CRC32-framed
+write-ahead log (:mod:`raft_tpu.core.wal`) and is fsynced BEFORE the
+call returns — an acked write survives any crash. :func:`recover`
+replays the WAL over the last good snapshot, truncating a torn tail at
+the first bad frame (raising only on mid-log corruption) and rebuilding
+the sealed segment from the snapshot corpus if its file fails its CRC.
+The crash-injection harness (``faults`` kinds ``crash_point`` /
+``wal_torn_tail``) kills the process at every named :data:`CRASH_POINTS`
+site and drills exactly that contract.
+
+Background merge (:meth:`~MutableIndex.merge`, hung off the
+``SnapshotWriter`` maintenance tick via :meth:`~MutableIndex.maintenance`):
+rebuilds sealed+delta into a fresh segment (CAGRA rebuilds via
+``build_knn_graph`` warm-started from the surviving graph rows — the
+PR 5 nn_descent warm-start path), checks the candidate with the
+family's ``health()`` plus a sampled self-recall probe, pre-warms the
+serving shapes, writes segment + snapshot + manifest atomically, flips
+under the serve lock (zero downtime — searchers hold the
+:class:`MutableIndex`, not the segment), and retires the old
+generation. A merge that crashes, exceeds its deadline, or fails its
+post-merge check is ABANDONED with the live index untouched; the
+``mutable.merge`` circuit breaker (:mod:`raft_tpu.ops.guarded`) backs
+repeated failures off instead of hot-looping the maintenance tick.
+
+Mutations arriving DURING a merge are correct by construction: the WAL
+is rotated at merge start (the manifest references both logs until the
+flip), new writes land in the new log + the delta tail past the merge
+watermark, and ids they touched are re-tombstoned in the flipped
+segment — the same records a post-flip recovery would replay.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import events, faults, tracing, wal as wal_mod
+from ..core.bitset import Bitset
+from ..core.errors import CorruptIndexError, RaftError, expects
+from ..core.serialize import fsync_dir, load_arrays, save_arrays
+from ..distance.distance_types import DistanceType, canonical_metric, \
+    is_min_close
+from ..ops.guarded import guarded_call
+from ..utils import env_float, env_int
+
+__all__ = ["MutableIndex", "create", "recover", "health", "make_searcher",
+           "ops_snapshot", "CRASH_POINTS", "MERGE_SITE"]
+
+_MANIFEST = "MANIFEST"
+_SERIAL_VERSION = 1
+
+# the guarded background-merge breaker site (ops/guarded.POLICIES)
+MERGE_SITE = "mutable.merge"
+
+# every named process-death site the crash drill must cover
+# (tests/test_mutable.py sweeps the source for faults.crash(...) probes
+# and fails on a site missing from this tuple — an undrilled crash
+# point is an untested recovery path)
+CRASH_POINTS = (
+    wal_mod.APPEND_SITE,          # mid-WAL-append (core/wal.py)
+    "mutable.merge.build",        # mid-merge, nothing written yet
+    "mutable.merge.pre_flip",     # new generation written, manifest old
+    "mutable.merge.post_flip",    # manifest flipped, old gen not retired
+)
+
+_FAMILIES = ("brute_force", "ivf_flat", "ivf_pq", "cagra")
+
+# live mutable indexes for the debugz "mutable" section (weak: dropping
+# the index drops the entry; the sharded_ann._LIVE precedent)
+_LIVE: "weakref.WeakSet[MutableIndex]" = weakref.WeakSet()
+
+
+def _family_mod(family: str):
+    from . import brute_force, cagra, ivf_flat, ivf_pq
+
+    mods = {"brute_force": brute_force, "ivf_flat": ivf_flat,
+            "ivf_pq": ivf_pq, "cagra": cagra}
+    expects(family in mods, "unknown sealed family %r (one of %s)",
+            family, "/".join(_FAMILIES))
+    return mods[family]
+
+
+def _family_params(mod, family: str, fparams: dict, mt, n: int):
+    """A family IndexParams from the JSON-able ``family_params`` dict
+    (unknown keys rejected loudly — a typo'd knob must not silently
+    build a default segment). n_lists is clamped to the corpus."""
+    if family == "brute_force":
+        return None
+    fields = {f.name for f in dataclasses.fields(mod.IndexParams)}
+    bad = set(fparams) - fields
+    expects(not bad, "unknown %s family_params: %s", family, sorted(bad))
+    p = mod.IndexParams(**fparams)
+    p.metric = mt
+    if hasattr(p, "n_lists"):
+        p.n_lists = max(1, min(p.n_lists, n))
+    return p
+
+
+def _pad_k(vals, ids, k: int, bad):
+    """Pad a (m, k') top-k' block out to k columns (inf/-1 slots)."""
+    pad = k - vals.shape[1]
+    if pad <= 0:
+        return vals, ids
+    return (jnp.pad(vals, ((0, 0), (0, pad)), constant_values=bad),
+            jnp.pad(ids, ((0, 0), (0, pad)), constant_values=-1))
+
+
+class MutableIndex:
+    """One mutable index: sealed segment + delta tier + tombstones + WAL.
+
+    Construct via :meth:`create` (fresh directory) or :meth:`recover`
+    (existing directory, crash-safe). All public methods are
+    thread-safe: mutations and the merge flip serialize on one RLock,
+    searches read a consistent view under it and dispatch outside it
+    (the serve lock of the zero-downtime swap)."""
+
+    # -- construction -----------------------------------------------------
+    def __init__(self, path: str, family: str, metric: DistanceType,
+                 dim: int, family_params: Optional[dict] = None):
+        self.path = os.path.abspath(path)
+        self.name = os.path.basename(self.path)
+        self.family = family
+        self.metric = metric
+        self.dim = int(dim)
+        self.family_params = dict(family_params or {})
+        self._mod = _family_mod(family)
+        self._lock = threading.RLock()
+        # sealed state
+        self._sealed = None                       # family Index | None
+        self._sealed_ids = np.zeros(0, np.int64)  # slot -> external id
+        self._sealed_vecs = np.zeros((0, self.dim), np.float32)
+        self._slot_of: Dict[int, int] = {}
+        self._alive = np.zeros(0, bool)           # False = tombstoned
+        self._n_tomb = 0        # cleared _alive bits — kept as an O(1)
+        #                         counter so the per-search view check
+        #                         never scans the sealed mask under the
+        #                         serve lock
+        self._sealed_rev = 0
+        self._sealed_cache: Optional[tuple] = None
+        # delta state (capacity-padded so search shapes bucket)
+        self._d_vecs = np.zeros((0, self.dim), np.float32)
+        self._d_ids = np.zeros(0, np.int64)
+        self._d_alive = np.zeros(0, bool)
+        self._d_n = 0                             # used rows (incl. dead)
+        self._d_live = 0                          # alive rows (counter)
+        self._d_row_of: Dict[int, int] = {}
+        self._delta_rev = 0
+        self._delta_cache: Optional[tuple] = None
+        # durability
+        self._wal: Optional[wal_mod.WriteAheadLog] = None
+        self._wal_names: List[str] = []
+        self._gen = 0
+        self._epoch = 0
+        self._next_id = 0
+        # merge machinery
+        self._merging = False
+        self._during: List[Tuple[str, np.ndarray]] = []
+        self._last_merge: Optional[dict] = None
+        self._last_shape: Optional[Tuple[int, int]] = None
+        self._last_request: Tuple[object, dict] = (None, {})
+        self._clock = time.monotonic
+        self.merge_rows = env_int("RAFT_TPU_MUTABLE_MERGE_ROWS", 65536)
+        self.merge_tomb_frac = env_float(
+            "RAFT_TPU_MUTABLE_MERGE_TOMB_FRAC", 0.25)
+        self.merge_deadline_s = env_float(
+            "RAFT_TPU_MUTABLE_MERGE_DEADLINE_S", 0.0)
+        self.merge_recall_floor = env_float(
+            "RAFT_TPU_MUTABLE_MERGE_RECALL_FLOOR", 0.9)
+        _LIVE.add(self)
+
+    @classmethod
+    @tracing.annotate("raft_tpu::mutable::create")
+    def create(cls, path, dataset=None, ids=None, *,
+               family: str = "brute_force", metric="sqeuclidean",
+               family_params: Optional[dict] = None,
+               dim: Optional[int] = None) -> "MutableIndex":
+        """Create a fresh mutable index at directory ``path``.
+
+        ``dataset`` (optional) seeds the sealed segment; ``ids`` are its
+        external ids (default: row positions). An empty create
+        (``dataset=None`` + ``dim=``) starts all-delta and seals on the
+        first merge. ``family_params``: a plain JSON-able dict of the
+        sealed family's IndexParams fields (persisted in the manifest so
+        merges after :meth:`recover` rebuild the same segment shape).
+        """
+        path = os.fspath(path)
+        os.makedirs(path, exist_ok=True)
+        expects(not os.path.exists(os.path.join(path, _MANIFEST)),
+                "mutable index already exists at %s (use recover)", path)
+        if dataset is None:
+            expects(dim is not None and dim > 0,
+                    "empty create needs dim=")
+            vecs = np.zeros((0, int(dim)), np.float32)
+        else:
+            vecs = np.asarray(dataset, np.float32)
+            expects(vecs.ndim == 2, "dataset must be (n, d)")
+        mt = canonical_metric(metric)
+        self = cls(path, family, mt, vecs.shape[1], family_params)
+        n = vecs.shape[0]
+        if ids is None:
+            sids = np.arange(n, dtype=np.int64)
+        else:
+            sids = np.asarray(ids, np.int64)
+            expects(sids.shape == (n,), "ids must be (n,)")
+            expects(np.unique(sids).size == n, "ids must be unique")
+        expects(n == 0 or (sids.min() >= 0 and sids.max() < 2 ** 31),
+                "external ids must fit int32")
+        self._install_sealed(
+            self._build_segment(vecs) if n else None, sids, vecs)
+        self._next_id = int(sids.max()) + 1 if n else 0
+        self._gen = 1
+        self._epoch = 1
+        if self._sealed is not None:
+            self._save_segment(self._gen)
+        self._save_snapshot(self._gen)
+        w = wal_mod.WriteAheadLog.create(
+            os.path.join(self.path, self._wal_name(self._epoch)))
+        self._wal = w
+        self._wal_names = [self._wal_name(self._epoch)]
+        self._save_manifest()
+        return self
+
+    @classmethod
+    @tracing.annotate("raft_tpu::mutable::recover")
+    def recover(cls, path) -> "MutableIndex":
+        """Reopen ``path`` exactly as a restarted process would: load the
+        manifest's generation, replay its WAL chain over the snapshot
+        (torn tail truncated — see :mod:`raft_tpu.core.wal`), rebuild
+        the sealed segment from the snapshot corpus if its file is
+        corrupt, and remove orphaned files from an interrupted merge.
+        Every acked mutation is visible afterwards; raises
+        :class:`CorruptIndexError` only when *acked* state is damaged
+        (mid-log corruption, unreadable manifest/snapshot)."""
+        path = os.fspath(path)
+        _, _, meta, _ = load_arrays(
+            os.path.join(path, _MANIFEST), "mutable_manifest")
+        fparams = json.loads(meta.get("family_params", "{}"))
+        self = cls(path, meta["family"], DistanceType(meta["metric"]),
+                   meta["dim"], fparams)
+        self._gen = int(meta["generation"])
+        self._epoch = int(meta["epoch"])
+        self._next_id = int(meta["next_id"])
+        # snapshot: the merge-source corpus + external ids
+        _, _, smeta, arrs = load_arrays(
+            os.path.join(path, meta["snapshot"]), "mutable_snapshot")
+        vecs = np.asarray(arrs["corpus"], np.float32).reshape(-1, self.dim)
+        sids = np.asarray(arrs["ids"], np.int64)
+        sealed = None
+        rebuilt = False
+        if meta["segment"]:
+            try:
+                sealed = self._load_segment(meta["segment"])
+            except CorruptIndexError:
+                # the segment is derived state — the snapshot corpus is
+                # the durable source of truth, so rebuild instead of
+                # refusing to serve
+                sealed = self._build_segment(vecs) if len(vecs) else None
+                rebuilt = True
+        self._install_sealed(sealed, sids, vecs)
+        # WAL chain: every log the manifest references, oldest first;
+        # only the LAST may carry a torn in-flight append
+        self._wal_names = json.loads(meta["wals"])
+        replayed = 0
+        truncated = 0
+        for i, wname in enumerate(self._wal_names):
+            last = i == len(self._wal_names) - 1
+            records, cut = wal_mod.replay(
+                os.path.join(path, wname), repair=last,
+                allow_torn_tail=last)
+            truncated += cut
+            for kind, rids, rvecs in records:
+                if kind == "upsert":
+                    self._apply_upsert(rids, rvecs)
+                else:
+                    self._apply_delete(rids)
+                replayed += 1
+        self._wal = wal_mod.WriteAheadLog.open(
+            os.path.join(path, self._wal_names[-1]))
+        if rebuilt and self._sealed is not None:
+            self._save_segment(self._gen)
+        self._housekeep(meta)
+        self._event("wal_recovered", generation=self._gen,
+                    records=replayed, truncated_bytes=truncated,
+                    segment_rebuilt=rebuilt)
+        self._count("mutable.recoveries")
+        return self
+
+    # -- durable file helpers ---------------------------------------------
+    def _wal_name(self, epoch: int) -> str:
+        return f"wal-{epoch:06d}.log"
+
+    def _seg_name(self, gen: int) -> str:
+        return f"segment-{gen:06d}.idx"
+
+    def _snap_name(self, gen: int) -> str:
+        return f"snapshot-{gen:06d}.idx"
+
+    def _save_segment(self, gen: int) -> None:
+        self._mod.save(self._sealed, os.path.join(self.path,
+                                                  self._seg_name(gen)))
+
+    def _save_segment_of(self, index, gen: int) -> None:
+        self._mod.save(index, os.path.join(self.path, self._seg_name(gen)))
+
+    def _load_segment(self, name: str):
+        return self._mod.load(os.path.join(self.path, name))
+
+    def _save_snapshot(self, gen: int, vecs=None, sids=None) -> None:
+        save_arrays(
+            os.path.join(self.path, self._snap_name(gen)),
+            "mutable_snapshot", _SERIAL_VERSION, {"generation": gen},
+            {"corpus": (self._sealed_vecs if vecs is None else vecs),
+             "ids": (self._sealed_ids if sids is None else sids)})
+
+    def _save_manifest(self, gen: Optional[int] = None) -> None:
+        g = self._gen if gen is None else gen
+        save_arrays(
+            os.path.join(self.path, _MANIFEST), "mutable_manifest",
+            _SERIAL_VERSION,
+            {"generation": g, "family": self.family,
+             "metric": self.metric.value, "dim": self.dim,
+             "epoch": self._epoch, "next_id": self._next_id,
+             "segment": self._seg_name(g) if self._has_segment(g) else "",
+             "snapshot": self._snap_name(g),
+             "wals": json.dumps(self._wal_names),
+             "family_params": json.dumps(self.family_params)}, {})
+
+    def _has_segment(self, gen: int) -> bool:
+        return os.path.exists(os.path.join(self.path, self._seg_name(gen)))
+
+    def _housekeep(self, meta: dict) -> None:
+        """Remove generation files the manifest does not reference —
+        the orphans of a merge that crashed pre-flip (new gen written,
+        never flipped) or post-flip (old gen never retired)."""
+        keep = {_MANIFEST, meta["snapshot"], *json.loads(meta["wals"])}
+        if meta["segment"]:
+            keep.add(meta["segment"])
+        for fn in os.listdir(self.path):
+            if fn in keep:
+                continue
+            if fn.startswith(("segment-", "snapshot-", "wal-")):
+                try:
+                    os.unlink(os.path.join(self.path, fn))
+                except OSError:
+                    pass
+
+    # -- telemetry --------------------------------------------------------
+    def _event(self, kind: str, **details) -> None:
+        try:
+            events.record(kind, self.name, **details)
+        except Exception:  # noqa: BLE001 - telemetry must not fail writes
+            pass
+
+    def _count(self, name: str, n: int = 1) -> None:
+        try:
+            from ..serve import metrics as _metrics
+
+            _metrics.counter(name).inc(n)
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- segment build / install ------------------------------------------
+    def _build_segment(self, vecs: np.ndarray, warm=None):
+        """Build a sealed family index over ``vecs`` (slots = row
+        positions, so source ids ARE slots for every family)."""
+        mod = self._mod
+        n = len(vecs)
+        if self.family == "brute_force":
+            return mod.build(vecs, metric=self.metric,
+                             dtype=self.family_params.get(
+                                 "dtype", "float32"))
+        p = _family_params(mod, self.family, self.family_params,
+                           self.metric, n)
+        if self.family == "cagra" and warm is not None:
+            # merge rebuild: build_knn_graph warm-started from the
+            # surviving rows of the previous graph (the PR 5 nn_descent
+            # init_graph path; the exact route ignores the seed)
+            from . import cagra
+
+            d0 = min(p.intermediate_graph_degree, n - 1)
+            degree = min(p.graph_degree, d0)
+            knn = cagra.build_knn_graph(vecs, d0, self.metric, p.seed,
+                                        algo=p.knn_graph_algo,
+                                        nnd_rounds=p.nn_descent_niter,
+                                        init_graph=warm)
+            graph = cagra.optimize(knn, degree)
+            # same seed-set policy as cagra.build — a warm rebuild must
+            # not silently lose the covering seeds the first build had
+            seeds = cagra.build_covering_seeds(vecs, p, self.metric)
+            return cagra.Index(jnp.asarray(vecs), jnp.asarray(graph),
+                               self.metric, seeds)
+        return mod.build(vecs, p)
+
+    def _warm_graph(self, new_ids: np.ndarray) -> Optional[np.ndarray]:
+        """Old sealed CAGRA graph remapped into new-slot space for the
+        nn_descent warm start: surviving neighbors keep their edges,
+        dead/unknown targets fall back to uniform random new slots."""
+        if self.family != "cagra" or self._sealed is None:
+            return None
+        g = np.asarray(self._sealed.graph)
+        n_new = len(new_ids)
+        if n_new < 2:
+            return None
+        slot2 = {int(e): s for s, e in enumerate(new_ids)}
+        old2new = np.full(len(self._sealed_ids), -1, np.int32)
+        for old_slot, ext in enumerate(self._sealed_ids):
+            s = slot2.get(int(ext))
+            if s is not None:
+                old2new[old_slot] = s
+        warm = np.full((n_new, g.shape[1]), -1, np.int32)
+        mapped = old2new[np.clip(g, 0, len(old2new) - 1)]
+        keep = old2new >= 0                 # surviving old rows
+        warm[old2new[keep]] = mapped[keep]
+        rng = np.random.default_rng(0)
+        fill = rng.integers(0, n_new, warm.shape, dtype=np.int64)
+        warm = np.where(warm >= 0, warm, fill).astype(np.int32)
+        # self-edges are dropped by the builder; good enough as seeds
+        return warm
+
+    def _install_sealed(self, sealed, sids: np.ndarray,
+                        vecs: np.ndarray) -> None:
+        self._sealed = sealed
+        self._sealed_ids = np.asarray(sids, np.int64)
+        self._sealed_vecs = np.asarray(vecs, np.float32)
+        self._slot_of = {int(e): s for s, e in enumerate(self._sealed_ids)}
+        self._alive = np.ones(len(self._sealed_ids), bool)
+        self._n_tomb = 0
+        self._sealed_rev += 1
+        self._sealed_cache = None
+
+    # -- in-memory mutation application (shared by live path + replay) ----
+    def _ensure_delta_cap(self, need: int) -> None:
+        cap = len(self._d_ids)
+        if need <= cap:
+            return
+        new_cap = 64
+        while new_cap < need:
+            new_cap *= 2
+        v = np.zeros((new_cap, self.dim), np.float32)
+        i = np.full(new_cap, -1, np.int64)
+        a = np.zeros(new_cap, bool)
+        v[:self._d_n] = self._d_vecs[:self._d_n]
+        i[:self._d_n] = self._d_ids[:self._d_n]
+        a[:self._d_n] = self._d_alive[:self._d_n]
+        self._d_vecs, self._d_ids, self._d_alive = v, i, a
+
+    def _apply_upsert(self, ids: np.ndarray, vecs: np.ndarray) -> None:
+        ids = np.asarray(ids, np.int64)
+        vecs = np.asarray(vecs, np.float32)
+        self._ensure_delta_cap(self._d_n + len(ids))
+        for j, ext in enumerate(ids):
+            ext = int(ext)
+            slot = self._slot_of.get(ext)
+            if slot is not None and self._alive[slot]:
+                self._alive[slot] = False       # sealed copy superseded
+                self._n_tomb += 1
+            old = self._d_row_of.get(ext)
+            if old is not None:
+                self._d_alive[old] = False      # older delta copy dies
+                self._d_live -= 1
+            row = self._d_n
+            self._d_vecs[row] = vecs[j]
+            self._d_ids[row] = ext
+            self._d_alive[row] = True
+            self._d_row_of[ext] = row
+            self._d_n += 1
+            self._d_live += 1
+            self._next_id = max(self._next_id, ext + 1)
+        if self._merging:
+            self._during.append(("upsert", ids.copy()))
+        self._sealed_cache = None
+        self._delta_cache = None
+
+    def _apply_delete(self, ids: np.ndarray) -> int:
+        ids = np.asarray(ids, np.int64)
+        found = 0
+        for ext in ids:
+            ext = int(ext)
+            hit = False
+            slot = self._slot_of.get(ext)
+            if slot is not None and self._alive[slot]:
+                self._alive[slot] = False
+                self._n_tomb += 1
+                hit = True
+            row = self._d_row_of.pop(ext, None)
+            if row is not None and self._d_alive[row]:
+                self._d_alive[row] = False
+                self._d_live -= 1
+                hit = True
+            found += hit
+        if self._merging:
+            self._during.append(("delete", ids.copy()))
+        self._sealed_cache = None
+        self._delta_cache = None
+        return found
+
+    # -- public mutation API ----------------------------------------------
+    @tracing.annotate("raft_tpu::mutable::upsert")
+    def upsert(self, ids, vectors=None) -> np.ndarray:
+        """Insert-or-replace rows; returns the external ids used.
+
+        ``ids=None`` auto-assigns sequential ids. Durability: the
+        mutation is WAL-appended and fsynced BEFORE this returns — the
+        return IS the ack. An id present in the sealed segment is
+        tombstoned there (the delta copy serves); an id already in the
+        delta replaces its row. Trace-stamped ``upsert`` flight event +
+        ``mutable.upserts`` counter."""
+        if vectors is None:            # upsert(vectors) convenience form
+            ids, vectors = None, ids
+        vecs = np.asarray(vectors, np.float32)
+        expects(vecs.ndim == 2 and vecs.shape[1] == self.dim,
+                "vectors must be (m, %d), got %s", self.dim, vecs.shape)
+        with self._lock:
+            if ids is None:
+                ids = np.arange(self._next_id, self._next_id + len(vecs),
+                                dtype=np.int64)
+            else:
+                ids = np.asarray(ids, np.int64)
+                expects(ids.shape == (len(vecs),), "ids must be (m,)")
+                expects(len(ids) == 0
+                        or (ids.min() >= 0 and ids.max() < 2 ** 31),
+                        "external ids must fit int32")
+            self._wal.append("upsert", ids, vecs)   # durable before ack
+            self._apply_upsert(ids, vecs)
+        self._event("upsert", rows=int(len(ids)),
+                    delta_rows=self.delta_rows)
+        self._count("mutable.upserts", int(len(ids)))
+        return ids
+
+    @tracing.annotate("raft_tpu::mutable::delete")
+    def delete(self, ids) -> int:
+        """Delete rows by external id; returns how many ids were
+        present. Durable before return (see :meth:`upsert`); absent ids
+        are a no-op, not an error. Trace-stamped ``delete`` flight event
+        + ``mutable.deletes`` counter."""
+        ids = np.atleast_1d(np.asarray(ids, np.int64))
+        with self._lock:
+            self._wal.append("delete", ids)
+            found = self._apply_delete(ids)
+        self._event("delete", rows=int(len(ids)), found=found,
+                    tombstones=self.tombstones)
+        self._count("mutable.deletes", int(len(ids)))
+        return found
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def sealed_index(self):
+        """The live sealed family index (None before the first seal)."""
+        return self._sealed
+
+    @property
+    def sealed_rows(self) -> int:
+        return len(self._alive) - self._n_tomb
+
+    @property
+    def delta_rows(self) -> int:
+        return self._d_live
+
+    @property
+    def tombstones(self) -> int:
+        return self._n_tomb
+
+    @property
+    def size(self) -> int:
+        """Live row count across tiers."""
+        return self.sealed_rows + self.delta_rows
+
+    @property
+    def generation(self) -> int:
+        return self._gen
+
+    def wal_bytes(self) -> int:
+        with self._lock:
+            return self._wal.size_bytes() if self._wal else 0
+
+    # -- search -----------------------------------------------------------
+    def _sealed_view(self):
+        """(index, filter bitset|None, ids_dev) under the lock; cached
+        until a mutation or flip invalidates it."""
+        if self._sealed is None or self.sealed_rows == 0:
+            return None
+        if self._sealed_cache is None:
+            filt = (None if self._n_tomb == 0
+                    else Bitset.from_mask(jnp.asarray(self._alive)))
+            ids_dev = jnp.asarray(self._sealed_ids, jnp.int32)
+            self._sealed_cache = (self._sealed, filt, ids_dev)
+        return self._sealed_cache
+
+    def _delta_view(self):
+        """(brute index over the capacity-padded delta, alive bitset,
+        ids_dev, cap) — rebuilt only after a mutation, and shaped by the
+        power-of-two capacity so repeated searches hit the same
+        executables."""
+        from . import brute_force
+
+        if self._d_live == 0:
+            return None
+        if self._delta_cache is None:
+            cap = len(self._d_ids)
+            idx = brute_force.build(self._d_vecs, metric=self.metric)
+            filt = Bitset.from_mask(jnp.asarray(self._d_alive))
+            ids_dev = jnp.asarray(self._d_ids, jnp.int32)
+            self._delta_cache = (idx, filt, ids_dev, cap)
+        return self._delta_cache
+
+    def _search_sealed(self, sealed, q, k, params, filt, opts):
+        if self.family == "brute_force":
+            return self._mod.search(sealed, q, k, filter=filt, **opts)
+        return self._mod.search(sealed, q, k, params, filter=filt, **opts)
+
+    @tracing.annotate("raft_tpu::mutable::search")
+    def search(self, queries, k: int, params=None, **opts):
+        """k nearest live rows → (distances (m, k), indices (m, k)) with
+        EXTERNAL ids. Fans out sealed (tombstones masked in-search via
+        the family filter path) + delta (dead rows masked the same way)
+        and merges via :func:`brute_force.knn_merge_parts`. ``params``:
+        the sealed family's SearchParams (ignored for brute_force);
+        ``opts`` forwards to the sealed family search — except
+        ``filter``, which is rejected: the tombstone bitset owns the
+        sealed filter slot (and a user bitset would be indexed by
+        internal slots, not the external ids this API speaks).
+        ``delete`` is the supported exclusion path."""
+        from . import brute_force
+
+        expects("filter" not in opts,
+                "mutable search does not accept a filter — tombstones "
+                "own the sealed filter slot; use delete() to exclude "
+                "rows")
+        q = jnp.asarray(queries, jnp.float32)
+        expects(q.ndim == 2 and q.shape[1] == self.dim,
+                "queries must be (m, %d), got %s", self.dim, q.shape)
+        with self._lock:
+            sview = self._sealed_view()
+            dview = self._delta_view()
+            # what a post-flip request will look like: _prewarm compiles
+            # THIS executable (shape + params + engine opts) against the
+            # replacement segment, so the flip costs zero compiles for
+            # the traffic actually being served
+            self._last_shape = (int(q.shape[0]), int(k))
+            self._last_request = (params, dict(opts))
+        expects(sview is not None or dview is not None,
+                "mutable index is empty")
+        select_min = is_min_close(self.metric)
+        bad = jnp.inf if select_min else -jnp.inf
+        parts = []
+        if sview is not None:
+            sealed, filt, ids_dev = sview
+            ks = min(k, sealed.size)
+            d, i = self._search_sealed(sealed, q, ks, params, filt, opts)
+            ext = jnp.where(i >= 0,
+                            jnp.take(ids_dev, jnp.clip(i, 0, None)), -1)
+            parts.append(_pad_k(d, ext, k, bad))
+        if dview is not None:
+            didx, dfilt, dids_dev, cap = dview
+            kd = min(k, cap)
+            d, i = brute_force.search(didx, q, kd, filter=dfilt)
+            ext = jnp.where(i >= 0,
+                            jnp.take(dids_dev, jnp.clip(i, 0, None)), -1)
+            parts.append(_pad_k(d, ext, k, bad))
+        if len(parts) == 1:
+            return parts[0]
+        return brute_force.knn_merge_parts(
+            jnp.stack([p[0] for p in parts]),
+            jnp.stack([p[1] for p in parts]), select_min=select_min)
+
+    # -- background merge -------------------------------------------------
+    def should_merge(self) -> bool:
+        with self._lock:
+            if self._merging:
+                return False
+            n_sealed = len(self._alive)
+            tomb_frac = (self.tombstones / n_sealed) if n_sealed else 0.0
+            return (self.delta_rows >= self.merge_rows
+                    or tomb_frac >= self.merge_tomb_frac)
+
+    def maintenance(self) -> Optional[str]:
+        """The ``SnapshotWriter(hooks=[...])`` tick: merge when due,
+        through the ``mutable.merge`` breaker (an abandoned merge backs
+        off instead of re-failing every tick)."""
+        if not self.should_merge():
+            return None
+        return self.merge()
+
+    def merge(self, deadline_s: Optional[float] = None) -> str:
+        """Fold delta + tombstones into a fresh sealed generation.
+
+        Returns ``"committed"``, ``"backoff"`` (the breaker is open from
+        an earlier failure — no work attempted this tick), or
+        ``"in_progress"``. A failing merge raises inside the guard (so
+        the breaker opens), records ``merge_abandoned`` and leaves the
+        live index untouched."""
+        return guarded_call(
+            MERGE_SITE,
+            lambda: self._merge_once(deadline_s),
+            lambda: "backoff")
+
+    def _check_deadline(self, t0: float, deadline_s: float,
+                        phase: str) -> None:
+        if deadline_s and deadline_s > 0:
+            el = self._clock() - t0
+            if el > deadline_s:
+                raise RaftError(
+                    f"merge deadline exceeded after {phase} "
+                    f"({el:.1f}s > {deadline_s:.1f}s)")
+
+    def _post_merge_check(self, index, vecs: np.ndarray,
+                          ids: np.ndarray) -> dict:
+        """The candidate segment must prove itself BEFORE the flip: the
+        family health report must render, and sampled recall against an
+        exact brute-force reference over the merge snapshot must clear
+        the floor — a structurally broken or low-recall rebuild is
+        abandoned, not served. Recall is scored on DISTANCES (returned
+        k-th within epsilon of the true k-th, the ann-benchmarks tie
+        rule), not returned ids: duplicate vectors tie arbitrarily in
+        id, and an id-based self-hit would deterministically fail a
+        dedup-free corpus (and is simply wrong under InnerProduct,
+        where a row's best match need not be itself)."""
+        from . import brute_force
+        from .brute_force import health_sample_rows
+
+        rep = self._mod.health(index)
+        rows = health_sample_rows(len(vecs), 64)
+        if rows.size == 0:
+            return {"health_family": rep.get("family"),
+                    "merge_recall": 1.0}
+        q = jnp.asarray(vecs[rows])
+        kc = min(10, len(vecs))
+        ref_d, _ = brute_force.search(
+            brute_force.build(vecs, metric=self.metric), q, kc)
+        cand_d, _ = self._search_sealed(index, q, kc, None, None, {})
+        ref_d, cand_d = np.asarray(ref_d), np.asarray(cand_d)
+        kth = ref_d[:, -1:]
+        eps = 1e-5 + 1e-5 * np.abs(kth)
+        if is_min_close(self.metric):
+            ok = cand_d <= kth + eps
+        else:
+            ok = cand_d >= kth - eps
+        recall = float(ok.mean())
+        if recall < self.merge_recall_floor:
+            raise RaftError(
+                f"post-merge recall {recall:.3f} below floor "
+                f"{self.merge_recall_floor:.3f}")
+        return {"health_family": rep.get("family"),
+                "merge_recall": recall}
+
+    def _prewarm(self, index) -> None:
+        """Pre-warm the replacement segment at the last served shape AND
+        params (the serve/warmup.py role, scoped to the swap): the
+        executable compiled here is the one the first post-flip request
+        dispatches, non-default SearchParams included. ``res`` is
+        dropped (a deadline belongs to a request, not a warmup); no
+        filter can appear — ``search`` rejects user filters, and a
+        fresh merge has no tombstones, so the immediate post-flip trace
+        carries filter=None exactly like this warmup."""
+        if self._last_shape is None:
+            return
+        m, k = self._last_shape
+        k = min(k, max(1, index.size))
+        params, opts = self._last_request
+        opts = {kk: v for kk, v in opts.items() if kk != "res"}
+        out = self._search_sealed(
+            index, jnp.zeros((m, self.dim), jnp.float32), k, params,
+            None, opts)
+        jax.block_until_ready((out[0], out[1]))
+
+    def _merge_once(self, deadline_s: Optional[float]) -> str:
+        t0 = self._clock()
+        deadline_s = (self.merge_deadline_s if deadline_s is None
+                      else deadline_s)
+        old_wal = None
+        started = False          # did THIS call claim the merge?
+        try:
+            # ONE lock hold from the merging-flag set through the
+            # watermark capture: a mutation CANNOT slip between them —
+            # it either lands pre-watermark with _merging still False
+            # (merged into the new segment, not in _during) or
+            # post-watermark with _merging True (delta tail + _during +
+            # the rotated log). A gap here silently loses acked writes:
+            # pre-watermark AND in _during means the flip re-tombstones
+            # a row the compaction just dropped.
+            with self._lock:
+                if self._merging:
+                    return "in_progress"
+                # rotate the WAL FIRST: mutations arriving during the
+                # merge land in the new log, and the manifest references
+                # BOTH until the flip — a crash anywhere in the merge
+                # replays everything
+                self._epoch += 1
+                new_wal_name = self._wal_name(self._epoch)
+                try:
+                    # a failed append may have left torn un-acked bytes
+                    # past the last good frame; a rotated-out log is
+                    # replayed with allow_torn_tail=False, so it must be
+                    # whole-frames-only BEFORE anything references it as
+                    # a closed log
+                    self._wal.seal()
+                    new_wal = wal_mod.WriteAheadLog.create(
+                        os.path.join(self.path, new_wal_name))
+                    self._wal_names = self._wal_names + [new_wal_name]
+                    self._save_manifest()        # still the OLD generation
+                except BaseException:
+                    # rotation failed mid-way: roll the in-memory view
+                    # back to what the on-disk manifest references
+                    self._epoch -= 1
+                    if self._wal_names and \
+                            self._wal_names[-1] == new_wal_name:
+                        self._wal_names = self._wal_names[:-1]
+                    try:
+                        os.unlink(os.path.join(self.path, new_wal_name))
+                    except OSError:
+                        pass
+                    raise
+                self._merging = True
+                started = True
+                self._during = []
+                old_wal, self._wal = self._wal, new_wal
+                watermark = self._d_n
+                # merge snapshot: live rows as of now
+                sa = self._alive
+                da = self._d_alive[:watermark]
+                vecs = np.concatenate(
+                    [self._sealed_vecs[sa], self._d_vecs[:watermark][da]])
+                ids = np.concatenate(
+                    [self._sealed_ids[sa], self._d_ids[:watermark][da]])
+                gen0, gen2 = self._gen, self._gen + 1
+            self._event("merge_started", generation=gen0,
+                        rows=int(len(ids)), delta_rows=int(da.sum()),
+                        tombstones=self.tombstones)
+            hook = getattr(self, "_after_snapshot_hook", None)
+            if hook is not None:
+                hook()                    # test seam: mutate mid-merge
+            faults.crash("mutable.merge.build")
+            warm = self._warm_graph(ids)
+            new_sealed = (self._build_segment(vecs, warm=warm)
+                          if len(vecs) else None)
+            self._check_deadline(t0, deadline_s, "build")
+            check = {}
+            if new_sealed is not None:
+                check = self._post_merge_check(new_sealed, vecs, ids)
+                self._prewarm(new_sealed)
+            self._check_deadline(t0, deadline_s, "check")
+            # persist the new generation (orphans until the flip)
+            if new_sealed is not None:
+                self._save_segment_of(new_sealed, gen2)
+            self._save_snapshot(gen2, vecs, ids)
+            faults.crash("mutable.merge.pre_flip")
+            # THE FLIP: one atomic manifest replace moves recovery from
+            # (gen0 + both wals) to (gen2 + the rotated wal)
+            with self._lock:
+                old_names = (self._wal_names[:-1],
+                             self._seg_name(gen0), self._snap_name(gen0))
+                self._wal_names = self._wal_names[-1:]
+                self._gen = gen2
+                self._save_manifest()
+            faults.crash("mutable.merge.post_flip")
+            with self._lock:            # in-memory flip, under serve lock
+                during = self._during
+                self._during = []
+                self._install_sealed(new_sealed, ids, vecs)
+                # re-apply mutations that raced the build: any touched
+                # id's new sealed slot is stale (delta has newer or it
+                # was deleted) — identical to what a WAL replay does
+                for _kind, dids in during:
+                    for ext in dids:
+                        slot = self._slot_of.get(int(ext))
+                        if slot is not None and self._alive[slot]:
+                            self._alive[slot] = False
+                            self._n_tomb += 1
+                # compact the delta: merged rows drop, the tail (rows
+                # born during the merge) survives with its flags
+                tail_v = self._d_vecs[watermark:self._d_n].copy()
+                tail_i = self._d_ids[watermark:self._d_n].copy()
+                tail_a = self._d_alive[watermark:self._d_n].copy()
+                self._d_vecs = np.zeros((0, self.dim), np.float32)
+                self._d_ids = np.zeros(0, np.int64)
+                self._d_alive = np.zeros(0, bool)
+                self._d_n = 0
+                self._d_live = 0
+                self._d_row_of = {}
+                self._delta_cache = None
+                if len(tail_i):
+                    self._ensure_delta_cap(len(tail_i))
+                    self._d_vecs[:len(tail_i)] = tail_v
+                    self._d_ids[:len(tail_i)] = tail_i
+                    self._d_alive[:len(tail_i)] = tail_a
+                    self._d_n = len(tail_i)
+                    self._d_live = int(tail_a.sum())
+                    self._d_row_of = {
+                        int(e): r for r, e in enumerate(tail_i)
+                        if tail_a[r]}
+                self._merging = False
+            # retire the old generation (failure is cosmetic — recovery
+            # housekeeps orphans)
+            try:
+                old_wal.close()
+                for fn in (*old_names[0], old_names[1], old_names[2]):
+                    p = os.path.join(self.path, fn)
+                    if os.path.exists(p):
+                        os.unlink(p)
+                fsync_dir(self.path)
+            except OSError:
+                pass
+            dur = round(self._clock() - t0, 3)
+            self._last_merge = {"verdict": "committed",
+                                "generation": gen2, "rows": int(len(ids)),
+                                "dur_s": dur, **check}
+            self._event("merge_committed", generation=gen2,
+                        rows=int(len(ids)), dur_s=dur, **check)
+            self._count("mutable.merges.committed")
+            return "committed"
+        except Exception as e:
+            # ABANDON: live index untouched (the rotated WAL + manifest
+            # double-reference keep recovery correct); re-raise so the
+            # mutable.merge breaker opens and backs the tick off.
+            # InjectedCrash (BaseException) deliberately skips this —
+            # a dead process runs no abandon handler.
+            with self._lock:
+                self._merging = False
+                self._during = []
+            if old_wal is not None:
+                # the rotated-out log stays ON DISK (the manifest still
+                # references it); only the handle closes — nothing will
+                # append to it again
+                try:
+                    old_wal.close()
+                except OSError:
+                    pass
+            self._last_merge = {"verdict": "abandoned",
+                                "reason": f"{type(e).__name__}: {e}",
+                                "dur_s": round(self._clock() - t0, 3)}
+            self._event("merge_abandoned", generation=self._gen,
+                        error=e)
+            self._count("mutable.merges.abandoned")
+            if isinstance(e, faults.InjectedFault):
+                # an injected io_error genuinely abandoned this merge —
+                # rewrap so the breaker treats it like any other merge
+                # failure (guarded_call handles bare InjectedFault as a
+                # per-call kernel simulation that must not move the
+                # breaker; a merge that did not commit must)
+                raise RaftError(f"merge abandoned: {e}") from e
+            raise
+        finally:
+            # InjectedCrash safety net: the simulated-death object is
+            # discarded by the drill, but never leave a live object
+            # wedged mid-merge. ONLY the call that claimed the merge may
+            # clear the flag — the "in_progress" early return must not
+            # clobber the in-flight merge's flag (raced mutations would
+            # skip _during and survive the flip as stale sealed copies)
+            if started:
+                with self._lock:
+                    self._merging = False
+
+    # -- ops surface ------------------------------------------------------
+    def ops_entry(self) -> dict:
+        with self._lock:
+            ent = {
+                "family": self.family, "generation": self._gen,
+                "sealed_rows": self.sealed_rows,
+                "delta_rows": self.delta_rows,
+                "tombstones": self.tombstones,
+                "wal_bytes": self._wal.size_bytes() if self._wal else 0,
+                "merging": self._merging,
+            }
+            if self._last_merge is not None:
+                ent["last_merge"] = dict(self._last_merge)
+            return ent
+
+
+def create(path, dataset=None, ids=None, **kw) -> MutableIndex:
+    """Module-level alias of :meth:`MutableIndex.create`."""
+    return MutableIndex.create(path, dataset, ids, **kw)
+
+
+def recover(path) -> MutableIndex:
+    """Module-level alias of :meth:`MutableIndex.recover`."""
+    return MutableIndex.recover(path)
+
+
+def health(index: MutableIndex, sample: int = 256) -> dict:
+    """Mutable-tier health report (docs/observability.md "Quality"):
+    the tier decomposition plus the sealed family's own report."""
+    rep = {**index.ops_entry(), "family": "mutable",
+           "sealed_family": index.family, "n": index.size,
+           "dim": index.dim, "metric": index.metric.name}
+    sealed = index.sealed_index
+    if sealed is not None:
+        try:
+            rep["sealed"] = index._mod.health(sealed, sample=sample)
+        except Exception as e:  # noqa: BLE001 - one bad segment must not
+            rep["sealed"] = {"error": f"{type(e).__name__}: {e}"}
+    return rep
+
+
+def make_searcher(index: MutableIndex, params=None, **opts):
+    """Stable batchable signature for the serving runtime: returns
+    ``fn(queries, k, res=None) -> (distances, indices)``. The closure
+    holds the :class:`MutableIndex`, not a segment — a background merge
+    flips the sealed generation under the serve lock and the very next
+    call serves it (zero downtime; the replacement shapes were
+    pre-warmed before the flip)."""
+
+    def _fn(queries, k, res=None):
+        return index.search(queries, k, params, **opts)
+
+    return _fn
+
+
+def ops_snapshot() -> dict:
+    """Per-index mutable-tier state for the debugz ``mutable`` section:
+    delta rows, tombstone count, WAL bytes, last merge verdict."""
+    out: Dict[str, dict] = {}
+    live: List[MutableIndex] = []
+    for _ in range(4):
+        try:
+            live = list(_LIVE)
+            break
+        except RuntimeError:     # registration race (sharded precedent)
+            continue
+    for idx in live:
+        key = idx.name
+        if key in out:
+            key = f"{key}@{id(idx):x}"
+        try:
+            out[key] = idx.ops_entry()
+        except Exception as e:  # noqa: BLE001 - surface must render
+            out[key] = {"error": f"{type(e).__name__}: {e}"}
+    return {"indexes": out}

@@ -115,6 +115,10 @@ POLICIES: Dict[str, BreakerPolicy] = {
     # the ring merge compiles per mesh shape; probing it re-runs a whole
     # shard_map program, so keep the default (not a tighter) cadence
     "sharded.ring_topk": DEFAULT_POLICY,
+    # the mutable-tier background merge (neighbors/mutable.py): not a
+    # kernel site — the breaker keeps a repeatedly-failing merge from
+    # hot-looping the maintenance tick, and a probe retries one merge
+    "mutable.merge": DEFAULT_POLICY,
 }
 
 

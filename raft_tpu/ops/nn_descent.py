@@ -60,6 +60,7 @@ from ..core import tracing
 from ..core.errors import expects
 from ..distance.distance_types import DistanceType, canonical_metric
 from ..matrix.select_k import select_k
+from ..utils import env_int as _env_int
 
 __all__ = ["build_graph", "supports"]
 
@@ -72,10 +73,6 @@ def supports(metric) -> bool:
     mt = canonical_metric(metric)
     return mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
                   DistanceType.InnerProduct)
-
-
-def _env_int(name: str, default: int) -> int:
-    return int(os.environ.get(name, str(default)))
 
 
 @partial(jax.jit, static_argnames=("s",))

@@ -139,6 +139,16 @@ def snapshot(batcher=None, registry=None, events_n: int = 50,
         out["sharded"] = sharded_ann.ops_snapshot()
     except Exception:  # noqa: BLE001 - surface must render without parallel/
         pass
+    # mutable-tier state (docs/mutation.md): per-index delta rows,
+    # tombstone count, WAL bytes and the last merge verdict
+    try:
+        from ..neighbors import mutable as _mutable
+
+        mu = _mutable.ops_snapshot()
+        if mu["indexes"]:
+            out["mutable"] = mu["indexes"]
+    except Exception:  # noqa: BLE001 - surface must render without mutable
+        pass
     # quality half of the ops surface (docs/observability.md "Quality"):
     # sentinel rolling-recall estimates + watched-index health reports
     try:
@@ -230,6 +240,21 @@ def render_text(batcher=None, registry=None, events_n: int = 20,
         lines.append(
             f"  ring demotions: {sh.get('ring_demotions', 0)}"
             + (" (site demoted)" if sh.get("ring_demoted") else ""))
+    if s.get("mutable"):
+        lines += ["", "-- mutable indexes --"]
+        for name, ent in sorted(s["mutable"].items()):
+            if "error" in ent:
+                lines.append(f"  {name}: error {ent['error']}")
+                continue
+            lm = ent.get("last_merge") or {}
+            lines.append(
+                f"  {name}: {ent['family']} gen={ent['generation']} "
+                f"sealed={ent['sealed_rows']} delta={ent['delta_rows']} "
+                f"tombstones={ent['tombstones']} "
+                f"wal={ent['wal_bytes']}B"
+                + (" MERGING" if ent.get("merging") else "")
+                + (f" last_merge={lm.get('verdict')}"
+                   f"({lm.get('reason', '')})" if lm else ""))
     if s.get("slo"):
         sv = s["slo"]
         lines += ["", f"-- slo ({sv['verdict']}) --"]

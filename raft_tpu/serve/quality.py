@@ -355,8 +355,12 @@ def health(index, sample: int = 256) -> dict:
         from ..parallel import sharded_ann
 
         return sharded_ann.health(index)
-    from ..neighbors import brute_force, cagra, ivf_flat, ivf_pq
+    from ..neighbors import brute_force, cagra, ivf_flat, ivf_pq, mutable
 
+    if isinstance(index, mutable.MutableIndex):
+        # the mutable tier: its own decomposition plus the sealed
+        # segment's family report nested under "sealed"
+        return mutable.health(index, sample=sample)
     for mod in (cagra, ivf_flat, ivf_pq, brute_force):
         if isinstance(index, mod.Index):
             return mod.health(index, sample=sample)

@@ -49,6 +49,17 @@ def env_float(name: str, default: float) -> float:
         return default
 
 
+def env_int(name: str, default: int) -> int:
+    """Integer twin of :func:`env_float` — same never-crash contract."""
+    import os
+
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
 def round_up_to(x: int, m: int) -> int:
     """Round ``x`` up to the nearest multiple of ``m``."""
     return cdiv(x, m) * m

@@ -1,0 +1,689 @@
+"""Crash-safe mutable index tier (neighbors/mutable.py + core/wal.py).
+
+Covers the ISSUE 11 acceptance contract:
+
+* WAL framing: roundtrip, torn-tail truncation at the first bad frame,
+  CorruptIndexError on mid-log corruption (never silent drops of acked
+  data);
+* tombstone-filter parity on every family (brute/ivf_flat/ivf_pq/cagra,
+  edge AND gather engines), including the k-near-boundary case where
+  the tombstoned row was rank 1;
+* crash drills: for every named ``CRASH_POINTS`` site, kill at the
+  site → ``recover()`` → servable index, every acked upsert/delete
+  visible, no torn state loaded — plus a source sweep that FAILS the
+  suite when a new ``faults.crash(...)`` site is not in
+  ``CRASH_POINTS`` (and therefore not drilled);
+* merge lifecycle: upsert+merge == build on the concatenated corpus
+  (bit-exact ids on the exact path), mutations racing a merge, and the
+  fail-safe arc — a fault-injected merge failure leaves the live index
+  serving with a ``merge_abandoned`` event and an open ``mutable.merge``
+  breaker that later probes closed.
+"""
+import os
+import pathlib
+import re
+import struct
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.core import events, faults, wal
+from raft_tpu.core.errors import CorruptIndexError, RaftError
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq, mutable
+from raft_tpu.ops import guarded
+from raft_tpu.serve import debugz, metrics, quality
+
+pytestmark = pytest.mark.faults
+
+
+def _ambient_kernel_faults() -> bool:
+    return any(f.kind in ("kernel_compile", "kernel_fault")
+               for f in faults.active())
+
+
+def _merge(m: mutable.MutableIndex, **kw) -> str:
+    """Merge through the guarded path, skipping under the ambient
+    faults lane (kernel_compile@* makes every guarded site serve its
+    fallback per call — PR 8/9 precedent)."""
+    if _ambient_kernel_faults():
+        pytest.skip("ambient kernel faults serve guarded sites from the "
+                    "fallback")
+    return m.merge(**kw)
+
+
+def _live_ids(m: mutable.MutableIndex) -> set:
+    """External ids a search could ever return (sealed alive + delta
+    alive) — the test's oracle for acked-write visibility."""
+    sealed = set(np.asarray(m._sealed_ids)[m._alive].tolist())
+    d = np.asarray(m._d_ids[:m._d_n])[m._d_alive[:m._d_n]]
+    return sealed | set(d.tolist())
+
+
+def _corpus(rng, n, d):
+    return rng.normal(size=(n, d)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+class TestWal:
+    def _mk(self, tmp_path):
+        return wal.WriteAheadLog.create(str(tmp_path / "w.log"))
+
+    def test_roundtrip(self, tmp_path, rng):
+        w = self._mk(tmp_path)
+        v = _corpus(rng, 3, 4)
+        w.append("upsert", np.array([5, 6, 7]), v)
+        w.append("delete", np.array([6]))
+        w.close()
+        records, truncated = wal.replay(str(tmp_path / "w.log"))
+        assert truncated == 0
+        assert [r[0] for r in records] == ["upsert", "delete"]
+        np.testing.assert_array_equal(records[0][1], [5, 6, 7])
+        np.testing.assert_allclose(records[0][2], v)
+        assert records[1][2] is None
+
+    def test_torn_tail_truncates_and_reopens(self, tmp_path, rng):
+        p = str(tmp_path / "w.log")
+        w = self._mk(tmp_path)
+        w.append("delete", np.array([1]))
+        w.close()
+        good = os.path.getsize(p)
+        with open(p, "ab") as f:      # a frame cut mid-payload
+            f.write(struct.pack("<I", 1000) + b"partial")
+        records, truncated = wal.replay(p, repair=True)
+        assert len(records) == 1 and truncated > 0
+        assert os.path.getsize(p) == good
+        # the repaired log extends cleanly
+        w = wal.WriteAheadLog.open(p)
+        w.append("delete", np.array([2]))
+        w.close()
+        records, truncated = wal.replay(p)
+        assert [r[0] for r in records] == ["delete", "delete"]
+        assert truncated == 0
+
+    def test_torn_crc_on_last_frame_truncates(self, tmp_path):
+        p = str(tmp_path / "w.log")
+        w = self._mk(tmp_path)
+        w.append("delete", np.array([1]))
+        w.append("delete", np.array([2]))
+        w.close()
+        with open(p, "r+b") as f:     # corrupt the LAST byte (frame 2 CRC)
+            f.seek(-1, os.SEEK_END)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_END)
+            f.write(bytes([b[0] ^ 1]))
+        records, truncated = wal.replay(p, repair=False)
+        assert len(records) == 1 and truncated > 0
+
+    def test_midlog_corruption_raises(self, tmp_path):
+        p = str(tmp_path / "w.log")
+        w = self._mk(tmp_path)
+        w.append("delete", np.array([1]))
+        w.append("delete", np.array([2]))
+        w.close()
+        # flip a byte inside FRAME 1's payload: a later complete frame
+        # exists, so this is damaged ACKED data, not a torn tail
+        off = len(b"RAFTWAL1") + 4 + 4 + 2
+        with open(p, "r+b") as f:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 1]))
+        with pytest.raises(CorruptIndexError):
+            wal.replay(p)
+        # closed (non-last) logs may not even have a torn tail
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) - 2)
+        with pytest.raises(CorruptIndexError):
+            wal.replay(p, allow_torn_tail=False)
+
+    def test_bad_magic(self, tmp_path):
+        p = tmp_path / "not.log"
+        p.write_bytes(b"GARBAGE!")
+        with pytest.raises(CorruptIndexError):
+            wal.replay(str(p))
+
+    def test_append_after_failed_write_truncates_garbage(self, tmp_path):
+        """A failed append (ENOSPC mid-write) leaves torn un-acked
+        bytes; the NEXT append must truncate back to the last good
+        frame — an acked retry landing after garbage would be lost (or
+        read as mid-log corruption) at recovery."""
+        p = str(tmp_path / "w.log")
+        w = self._mk(tmp_path)
+        w.append("delete", np.array([1]))
+        # simulate the torn leftovers of a write that raised mid-frame
+        w._f.write(struct.pack("<I", 999) + b"torn")
+        w._f.flush()
+        w.append("delete", np.array([2]))       # the acked retry
+        w.close()
+        records, truncated = wal.replay(p)
+        assert [int(r[1][0]) for r in records] == [1, 2]
+        assert truncated == 0
+
+
+# ---------------------------------------------------------------------------
+class TestMutableBasics:
+    def test_upsert_delete_search_vs_reference(self, tmp_path, rng):
+        X = _corpus(rng, 200, 12)
+        m = mutable.create(tmp_path / "i", X)
+        up = _corpus(rng, 30, 12)
+        ids = m.upsert(None, up)
+        np.testing.assert_array_equal(ids, np.arange(200, 230))
+        assert m.delete([3, 8, 205, 9999]) == 3
+        # logical live corpus, external-id order
+        live_v = np.concatenate([np.delete(X, [3, 8], axis=0),
+                                 np.delete(up, [5], axis=0)])
+        live_i = np.concatenate([np.delete(np.arange(200), [3, 8]),
+                                 np.delete(np.arange(200, 230), [5])])
+        ref = brute_force.build(live_v)
+        q = _corpus(rng, 16, 12)
+        rd, ri = brute_force.search(ref, jnp.asarray(q), 10)
+        rd, ri = np.asarray(rd), live_i[np.asarray(ri)]
+        md, mi = m.search(q, 10)
+        np.testing.assert_array_equal(np.asarray(mi), ri)
+        np.testing.assert_allclose(np.asarray(md), rd, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_delete_then_reinsert_is_exact(self, tmp_path, rng):
+        X = _corpus(rng, 120, 8)
+        m = mutable.create(tmp_path / "i", X)
+        q = X[17:18]
+        _, i0 = m.search(q, 2)
+        assert int(np.asarray(i0)[0, 0]) == 17       # rank 1 = itself
+        m.delete([17])
+        _, i1 = m.search(q, 2)
+        assert 17 not in np.asarray(i1)
+        # reinsert id 17 with a DIFFERENT vector: the tombstone must
+        # keep masking the sealed copy and serve only the delta copy
+        newv = _corpus(rng, 1, 8)
+        m.upsert(np.array([17]), newv)
+        d2, i2 = m.search(newv, 1)
+        assert int(np.asarray(i2)[0, 0]) == 17
+        assert float(np.asarray(d2)[0, 0]) < 1e-6
+        d3, _ = m.search(q, 120)
+        # the ORIGINAL row-17 vector is gone: no ~0 distance for q
+        assert float(np.asarray(d3)[0, 0]) > 1e-3
+
+    def test_upsert_overwrite_in_delta(self, tmp_path, rng):
+        m = mutable.create(tmp_path / "i", dataset=None, dim=8)
+        v1, v2 = _corpus(rng, 1, 8), _corpus(rng, 1, 8)
+        m.upsert(np.array([42]), v1)
+        m.upsert(np.array([42]), v2)
+        assert m.delta_rows == 1                      # old copy is dead
+        d, i = m.search(v2, 1)
+        assert int(np.asarray(i)[0, 0]) == 42
+        assert float(np.asarray(d)[0, 0]) < 1e-6
+
+    def test_empty_errors_and_auto_ids_resume(self, tmp_path, rng):
+        m = mutable.create(tmp_path / "i", dataset=None, dim=8)
+        with pytest.raises(RaftError):
+            m.search(_corpus(rng, 1, 8), 1)
+        m.upsert(np.array([100]), _corpus(rng, 1, 8))
+        auto = m.upsert(None, _corpus(rng, 2, 8))
+        np.testing.assert_array_equal(auto, [101, 102])
+        r = mutable.recover(tmp_path / "i")
+        auto2 = r.upsert(None, _corpus(rng, 1, 8))    # resumes past 102
+        assert int(auto2[0]) == 103
+
+    def test_make_searcher_and_wal_bytes(self, tmp_path, rng):
+        X = _corpus(rng, 100, 8)
+        m = mutable.create(tmp_path / "i", X)
+        fn = mutable.make_searcher(m)
+        d, i = fn(X[:4], 3)
+        assert np.asarray(i).shape == (4, 3)
+        b0 = m.wal_bytes()
+        m.upsert(None, _corpus(rng, 2, 8))
+        assert m.wal_bytes() > b0
+
+    def test_user_filter_rejected(self, tmp_path, rng):
+        from raft_tpu.core.bitset import Bitset
+
+        X = _corpus(rng, 50, 8)
+        m = mutable.create(tmp_path / "i", X)
+        with pytest.raises(RaftError, match="filter"):
+            m.search(X[:2], 3, filter=Bitset.create(50))
+
+
+# ---------------------------------------------------------------------------
+# tier-1 keeps the exact family (the merge-parts fan-out reference) and
+# the cagra gather engine; the ≥2s builds (ivf kmeans fits, the
+# interpret-mode edge kernel) ride the slow lane per the tier-1 wall
+# policy — the tombstone MECHANISM under test is identical (the family
+# filter path), and the ivf filter path has its own tier-1 kernel
+# parity tests in test_ops.py
+_slow = pytest.mark.slow
+_FAMILY_CASES = [
+    pytest.param(("brute_force", {}, None), id="brute_force"),
+    pytest.param(
+        ("ivf_flat", {"n_lists": 4, "kmeans_n_iters": 2},
+         ivf_flat.SearchParams(n_probes=4)),
+        id="ivf_flat", marks=_slow),
+    pytest.param(
+        ("ivf_pq", {"n_lists": 4, "pq_dim": 4, "pq_bits": 4,
+                    "kmeans_n_iters": 2},
+         ivf_pq.SearchParams(n_probes=4)),
+        id="ivf_pq", marks=_slow),
+    pytest.param(
+        ("cagra-gather", {"graph_degree": 8,
+                          "intermediate_graph_degree": 16},
+         cagra.SearchParams(itopk_size=32, engine="gather")),
+        id="cagra-gather"),
+    pytest.param(
+        ("cagra-edge", {"graph_degree": 8, "intermediate_graph_degree": 16},
+         cagra.SearchParams(itopk_size=32, engine="edge")),
+        id="cagra-edge", marks=_slow),
+]
+
+
+class TestTombstoneParity:
+    """A deleted id NEVER appears in results, for every sealed family —
+    including at the k=1 boundary where the tombstoned row was rank 1."""
+
+    @pytest.mark.parametrize("case", _FAMILY_CASES)
+    def test_deleted_id_never_returned(self, tmp_path, rng, case):
+        name, fp, sp = case
+        family = name.split("-")[0]
+        X = _corpus(rng, 256, 16)
+        m = mutable.create(tmp_path / "i", X, family=family,
+                           family_params=fp)
+        if name == "cagra-edge":
+            # the Pallas frontier-expansion engine (interpret mode on
+            # CPU) with the in-kernel tombstone penalty
+            cagra.prepare_traversal(m.sealed_index, "int8")
+        victim = 23
+        q = X[victim:victim + 1]
+        d0, i0 = m.search(q, 5, params=sp)
+        assert int(np.asarray(i0)[0, 0]) == victim   # rank 1 = itself
+        runner_up = int(np.asarray(i0)[0, 1])
+        m.delete([victim])
+        # k=1: the boundary case — the tombstoned row WAS the answer
+        _, i1 = m.search(q, 1, params=sp)
+        assert int(np.asarray(i1)[0, 0]) != victim
+        d5, i5 = m.search(q, 5, params=sp)
+        assert victim not in np.asarray(i5)
+        if family in ("brute_force", "ivf_flat"):
+            # exact / probe-stable families: the old rank 2 is the new
+            # rank 1 (ivf_pq is quantized, cagra approximate)
+            assert int(np.asarray(i5)[0, 0]) == runner_up
+        # tombstones also hold with a delta tier in the fan-out
+        m.upsert(None, _corpus(rng, 8, 16))
+        _, i6 = m.search(q, 5, params=sp)
+        assert victim not in np.asarray(i6)
+
+
+# ---------------------------------------------------------------------------
+class TestCrashDrills:
+    def test_crash_site_sweep_matches_drilled_set(self):
+        """CI drift guard: every ``faults.crash(...)`` site in
+        mutable.py/wal.py must be a drilled ``CRASH_POINTS`` entry — a
+        new crash point without a kill-and-recover drill fails here."""
+        import raft_tpu
+
+        root = pathlib.Path(raft_tpu.__file__).parent
+        found = set()
+        for rel in ("neighbors/mutable.py", "core/wal.py"):
+            src = (root / rel).read_text()
+            found |= set(re.findall(
+                r'faults\.crash\(\s*\n?\s*"([^"]+)"', src))
+            if re.search(r"faults\.crash\(APPEND_SITE\)", src):
+                found.add(wal.APPEND_SITE)
+        assert found == set(mutable.CRASH_POINTS), (
+            f"crash sites drifted: source has {sorted(found)}, "
+            f"CRASH_POINTS drills {sorted(mutable.CRASH_POINTS)} — add "
+            "new sites to mutable.CRASH_POINTS so the kill-and-recover "
+            "drill below covers them")
+
+    @pytest.mark.parametrize("site", mutable.CRASH_POINTS)
+    def test_kill_at_site_then_recover(self, tmp_path, rng, site):
+        """Kill at the named site → recover() → servable, every acked
+        write visible, no torn state loaded."""
+        if site.startswith("mutable.merge") and _ambient_kernel_faults():
+            pytest.skip("ambient kernel faults pre-empt the guarded "
+                        "merge path")
+        X = _corpus(rng, 120, 8)
+        p = tmp_path / "i"
+        m = mutable.create(p, X)
+        acked_v = _corpus(rng, 3, 8)
+        m.upsert(np.array([500, 501, 502]), acked_v)     # acked
+        m.delete([5, 501])                                # acked
+        died = False
+        try:
+            with faults.inject("crash_point", site, count=1):
+                if site.startswith("mutable.merge"):
+                    m.merge()
+                else:
+                    m.upsert(np.array([900]), _corpus(rng, 1, 8))
+        except faults.InjectedCrash:
+            died = True
+        assert died, f"crash point {site} never fired"
+        r = mutable.recover(p)
+        live = _live_ids(r)
+        assert {500, 502} <= live and 501 not in live and 5 not in live
+        # acked upserts SERVE (not just bookkeeping): the new vector is
+        # found at ~0 distance, the deleted id never surfaces
+        d, i = r.search(acked_v[0:1], 1)
+        assert int(np.asarray(i)[0, 0]) == 500
+        assert float(np.asarray(d)[0, 0]) < 1e-6
+        _, i5 = r.search(X[5:6], 5)
+        assert 5 not in np.asarray(i5)
+        ev = [e for e in events.recent(kind="wal_recovered")
+              if e["site"] == r.name]
+        assert ev, "recover() must flight-record wal_recovered"
+
+    def test_wal_torn_tail_drill(self, tmp_path, rng):
+        """A write cut mid-frame: recovery truncates the torn tail, the
+        acked prefix survives, and the log extends cleanly after."""
+        X = _corpus(rng, 100, 8)
+        p = tmp_path / "i"
+        m = mutable.create(p, X)
+        m.upsert(np.array([700]), _corpus(rng, 1, 8))    # acked
+        with pytest.raises(faults.InjectedCrash):
+            with faults.inject("wal_torn_tail", wal.APPEND_SITE, count=1):
+                m.upsert(np.array([701]), _corpus(rng, 1, 8))  # never acked
+        r = mutable.recover(p)
+        live = _live_ids(r)
+        assert 700 in live and 701 not in live
+        ev = [e for e in events.recent(kind="wal_recovered")
+              if e["site"] == r.name]
+        assert ev and ev[-1]["truncated_bytes"] > 0
+        r.upsert(np.array([702]), _corpus(rng, 1, 8))
+        assert 702 in _live_ids(mutable.recover(p))
+
+    def test_corrupt_segment_rebuilt_from_snapshot(self, tmp_path, rng):
+        """A CRC-corrupt segment file is derived state: recover()
+        rebuilds it from the snapshot corpus instead of refusing."""
+        X = _corpus(rng, 100, 8)
+        p = tmp_path / "i"
+        m = mutable.create(p, X)
+        seg = p / m._seg_name(m.generation)
+        raw = bytearray(seg.read_bytes())
+        raw[len(raw) // 2] ^= 0x01
+        seg.write_bytes(bytes(raw))
+        r = mutable.recover(p)
+        assert r.sealed_rows == 100
+        _, i = r.search(X[:3], 1)
+        np.testing.assert_array_equal(np.asarray(i)[:, 0], [0, 1, 2])
+
+
+# ---------------------------------------------------------------------------
+class TestMergeLifecycle:
+    def test_upsert_merge_equals_build_bit_exact(self, tmp_path, rng):
+        """The ivf extend-deprecation satellite: MutableIndex.upsert +
+        merge == build on the concatenated corpus — bit-exact ids at
+        fixed k on the exact path."""
+        X = _corpus(rng, 300, 16)
+        up = _corpus(rng, 40, 16)
+        m = mutable.create(tmp_path / "i", X)
+        m.upsert(None, up)
+        q = _corpus(rng, 12, 16)
+        _, i_pre = m.search(q, 10)
+        assert _merge(m) == "committed"
+        d_post, i_post = m.search(q, 10)
+        # pre-merge fan-out and post-merge single-segment agree exactly
+        np.testing.assert_array_equal(np.asarray(i_pre),
+                                      np.asarray(i_post))
+        ref = brute_force.build(np.concatenate([X, up]))
+        rd, ri = brute_force.search(ref, jnp.asarray(q), 10)
+        np.testing.assert_array_equal(np.asarray(i_post), np.asarray(ri))
+        np.testing.assert_allclose(np.asarray(d_post), np.asarray(rd),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_merge_folds_retires_and_records(self, tmp_path, rng):
+        X = _corpus(rng, 150, 8)
+        p = tmp_path / "i"
+        m = mutable.create(p, X)
+        m.upsert(None, _corpus(rng, 10, 8))
+        m.delete([0, 1])
+        wal_before = m.wal_bytes()
+        assert wal_before > 0
+        gen0 = m.generation
+        assert _merge(m) == "committed"
+        assert m.generation == gen0 + 1
+        assert m.delta_rows == 0 and m.tombstones == 0
+        assert m.sealed_rows == 158
+        assert m.wal_bytes() < wal_before          # rotated fresh
+        # old generation retired from disk
+        names = set(os.listdir(p))
+        assert m._seg_name(gen0) not in names
+        assert m._snap_name(gen0) not in names
+        kinds = {e["kind"] for e in events.recent()
+                 if e.get("site") == m.name}
+        assert {"merge_started", "merge_committed"} <= kinds
+        # and the merged state survives a restart
+        r = mutable.recover(p)
+        assert (r.generation, r.sealed_rows, r.delta_rows) == (
+            m.generation, 158, 0)
+
+    def test_mutations_racing_the_merge(self, tmp_path, rng):
+        """Writes landing between the merge snapshot and the flip are
+        neither lost nor double-served: the rotated WAL carries them,
+        the flipped segment re-tombstones the ids they touched."""
+        X = _corpus(rng, 150, 8)
+        p = tmp_path / "i"
+        m = mutable.create(p, X)
+        m.upsert(None, _corpus(rng, 10, 8))
+        mid_new = _corpus(rng, 1, 8)
+
+        def mid_merge():
+            m.upsert(np.array([7]), mid_new)       # override a sealed row
+            m.delete([11])                          # delete a sealed row
+            m.upsert(np.array([800]), mid_new)      # brand-new id
+
+        m._after_snapshot_hook = mid_merge
+        try:
+            assert _merge(m) == "committed"
+        finally:
+            m._after_snapshot_hook = None
+        live = _live_ids(m)
+        assert 11 not in live and {7, 800} <= live
+        d, i = m.search(mid_new, 2)
+        assert {int(x) for x in np.asarray(i)[0]} == {7, 800}
+        assert float(np.asarray(d)[0, 0]) < 1e-6   # the NEW vector serves
+        _, i11 = m.search(X[11:12], 5)
+        assert 11 not in np.asarray(i11)
+        # recovery replays the same story
+        r = mutable.recover(p)
+        assert 11 not in _live_ids(r) and {7, 800} <= _live_ids(r)
+        d2, i2 = r.search(mid_new, 2)
+        assert {int(x) for x in np.asarray(i2)[0]} == {7, 800}
+
+    def test_merge_failure_is_failsafe(self, tmp_path, rng, monkeypatch):
+        """The acceptance drill: a fault-injected merge failure leaves
+        the live index serving, records merge_abandoned, opens the
+        mutable.merge breaker (backing off further ticks), and a later
+        probe commits and re-closes it."""
+        if _ambient_kernel_faults():
+            pytest.skip("ambient kernel faults pre-empt the guarded "
+                        "merge path")
+        now = {"t": 0.0}
+        monkeypatch.setattr(guarded, "_clock", lambda: now["t"])
+        X = _corpus(rng, 120, 8)
+        m = mutable.create(tmp_path / "i", X)
+        m.upsert(None, _corpus(rng, 6, 8))
+        n0 = metrics.counter("mutable.merges.abandoned").value
+        try:
+            with faults.inject("io_error", "core.serialize.*"):
+                assert m.merge() == "backoff"       # failed -> abandoned
+            assert m._last_merge["verdict"] == "abandoned"
+            assert metrics.counter(
+                "mutable.merges.abandoned").value == n0 + 1
+            assert [e for e in events.recent(kind="merge_abandoned")
+                    if e["site"] == m.name]
+            b = guarded.breaker_snapshot()[mutable.MERGE_SITE]
+            assert b["state"] == "open"
+            # live index untouched and still serving both tiers
+            assert m.delta_rows == 6 and m.generation == 1
+            _, i = m.search(X[:2], 3)
+            assert np.asarray(i).shape == (2, 3)
+            # breaker open: the maintenance tick backs off, no new event
+            assert m.merge() == "backoff"
+            # fault cleared + probation elapsed -> the probe merge runs,
+            # commits, and re-closes the breaker
+            now["t"] += b["next_probe_in_s"] + 1.0
+            assert m.merge() == "committed"
+            assert guarded.breaker_snapshot()[
+                mutable.MERGE_SITE]["state"] == "closed"
+            assert m.delta_rows == 0 and m.generation == 2
+        finally:
+            guarded.reset()
+
+    def test_deadline_abandons(self, tmp_path, rng):
+        if _ambient_kernel_faults():
+            pytest.skip("ambient kernel faults pre-empt the guarded "
+                        "merge path")
+        X = _corpus(rng, 120, 8)
+        m = mutable.create(tmp_path / "i", X)
+        m.upsert(None, _corpus(rng, 4, 8))
+        try:
+            assert m.merge(deadline_s=1e-9) == "backoff"
+            assert m._last_merge["verdict"] == "abandoned"
+            assert "deadline" in m._last_merge["reason"]
+            assert m.generation == 1 and m.delta_rows == 4
+        finally:
+            guarded.reset()
+
+    def test_recall_floor_abandons(self, tmp_path, rng, monkeypatch):
+        if _ambient_kernel_faults():
+            pytest.skip("ambient kernel faults pre-empt the guarded "
+                        "merge path")
+        X = _corpus(rng, 120, 8)
+        m = mutable.create(tmp_path / "i", X)
+        m.upsert(None, _corpus(rng, 4, 8))
+        m.merge_recall_floor = 1.1      # unattainable: force the check
+        try:
+            assert m.merge() == "backoff"
+            assert m._last_merge["verdict"] == "abandoned"
+            assert "recall" in m._last_merge["reason"]
+        finally:
+            guarded.reset()
+
+    def test_duplicate_vectors_still_merge(self, tmp_path, rng):
+        """Exact-duplicate rows under distinct ids tie arbitrarily in
+        id — the post-merge check scores distances, so a dedup-free
+        corpus must not abandon every merge forever."""
+        if _ambient_kernel_faults():
+            pytest.skip("ambient kernel faults pre-empt the guarded "
+                        "merge path")
+        base = _corpus(rng, 60, 8)
+        X = np.concatenate([base, base])        # 50% exact duplicates
+        m = mutable.create(tmp_path / "i", X)
+        m.upsert(None, base[:8])                # triplicate some rows
+        try:
+            assert m.merge() == "committed"
+        finally:
+            guarded.reset()
+        assert m._last_merge["merge_recall"] == 1.0
+
+    def test_prewarm_compiles_the_served_request(self, tmp_path, rng):
+        """The flip's pre-warm must trace the executable traffic is
+        ACTUALLY using (last shape + params + engine opts), not the
+        defaults — else the first post-flip request pays the compile
+        the pre-warm exists to prevent."""
+        X = _corpus(rng, 100, 8)
+        m = mutable.create(tmp_path / "i", X)
+        m.upsert(None, _corpus(rng, 4, 8))
+        calls = []
+        orig = m._search_sealed
+
+        def spy(idx, q, k, params, filt, opts):
+            calls.append((tuple(q.shape), k, params, dict(opts)))
+            return orig(idx, q, k, params, filt, opts)
+
+        m._search_sealed = spy
+        m.search(X[:6], 3, precision="default")
+        calls.clear()
+        try:
+            assert _merge(m) == "committed"
+        finally:
+            guarded.reset()
+        warm = [(shape, k, o) for shape, k, _p, o in calls
+                if o.get("precision") == "default"]
+        assert warm and warm[-1][0] == (6, 8) and warm[-1][1] == 3
+
+    def test_concurrent_merge_call_keeps_the_flag(self, tmp_path, rng):
+        """A second merge() landing mid-merge returns "in_progress" and
+        must NOT clear the in-flight merge's flag on its way out —
+        mutations raced after such a clear would skip _during and
+        survive the flip as live stale sealed copies."""
+        X = _corpus(rng, 60, 8)
+        m = mutable.create(tmp_path / "i", X)
+        m._merging = True                  # an in-flight merge
+        assert m._merge_once(None) == "in_progress"
+        assert m._merging is True
+        m._merging = False
+
+    def test_torn_unacked_tail_survives_rotation(self, tmp_path, rng):
+        """A failed append's torn leftovers in the active log must be
+        sealed away when a merge rotates it out — a closed log is
+        replayed with allow_torn_tail=False, and un-acked garbage must
+        not make the whole index unrecoverable."""
+        X = _corpus(rng, 80, 8)
+        p = tmp_path / "i"
+        m = mutable.create(p, X)
+        m.upsert(np.array([300]), _corpus(rng, 1, 8))      # acked
+        # a write that died mid-frame (exception propagated, un-acked)
+        m._wal._f.write(struct.pack("<I", 999) + b"torn")
+        m._wal._f.flush()
+        died = False
+        try:   # the rotation seals the old log, then the crash fires
+            with faults.inject("crash_point", "mutable.merge.build",
+                               count=1):
+                m.merge()
+        except faults.InjectedCrash:
+            died = True
+        if died:   # guarded path may be pre-empted in the faults lane
+            r = mutable.recover(p)      # must NOT raise CorruptIndexError
+            assert 300 in _live_ids(r)
+
+    def test_maintenance_thresholds(self, tmp_path, rng):
+        if _ambient_kernel_faults():
+            pytest.skip("ambient kernel faults pre-empt the guarded "
+                        "merge path")
+        X = _corpus(rng, 100, 8)
+        m = mutable.create(tmp_path / "i", X)
+        m.merge_rows = 5
+        assert m.maintenance() is None              # below threshold
+        m.upsert(None, _corpus(rng, 6, 8))
+        assert m.should_merge()
+        try:
+            assert m.maintenance() == "committed"   # SnapshotWriter hook
+        finally:
+            guarded.reset()
+        assert not m.should_merge()
+
+
+# ---------------------------------------------------------------------------
+class TestOpsSurface:
+    def test_debugz_health_and_events(self, tmp_path, rng):
+        X = _corpus(rng, 90, 8)
+        # unique basename: ops_snapshot keys on it, and not-yet-GC'd
+        # indexes from other tests (all named "i") would collide
+        m = mutable.create(tmp_path / "ops-drill-idx", X)
+        m.upsert(None, _corpus(rng, 3, 8))
+        m.delete([2])
+        snap = mutable.ops_snapshot()["indexes"]
+        ent = snap[m.name]
+        assert (ent["delta_rows"], ent["tombstones"]) == (3, 1)
+        assert ent["wal_bytes"] > 0 and ent["generation"] == 1
+        # rides the debugz surface, strict-JSON end to end
+        import json
+
+        s = debugz.snapshot(registry=metrics.Registry())
+        assert m.name in s["mutable"]
+        json.dumps(s, allow_nan=False)
+        txt = debugz.render_text(registry=metrics.Registry())
+        assert "mutable indexes" in txt and m.name in txt
+        # quality.health dispatches the mutable tier
+        rep = quality.health(m)
+        assert rep["family"] == "mutable"
+        assert rep["sealed"]["family"] == "brute_force"
+        # mutation events are in the flight-recorder tail
+        kinds = {e["kind"] for e in events.recent()
+                 if e.get("site") == m.name}
+        assert {"upsert", "delete"} <= kinds
+
+    def test_extend_docstrings_point_to_mutable(self):
+        """The deprecation-pointer satellite stays put."""
+        assert "MutableIndex" in ivf_flat.extend.__doc__
+        assert "MutableIndex" in ivf_pq.extend.__doc__

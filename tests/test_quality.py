@@ -436,7 +436,7 @@ class TestGuardedDriftGuard:
     # catches NEW guarded_call sites automatically
     KNOWN = {"select_k.kpass", "ivf_flat.scan", "ivf_pq.scan",
              "brute_force.fused", "cagra.graph_expand", "cagra.nn_descent",
-             "sharded.ring_topk"}
+             "sharded.ring_topk", "mutable.merge"}
 
     def _discover_sites(self):
         import raft_tpu
