@@ -1199,6 +1199,121 @@ def main():
             if rec >= 0.995 and (itopk, width, mi) != opener:
                 break
 
+    # --- serving_latency.cagra: the one-dispatch megakernel behind the
+    # serve runtime (ISSUE 12). The per-request story the ivf_flat
+    # serving lane tells, on the graph index with engine="fused" — the
+    # whole traversal is ONE kernel launch, so stage_p50_ms.dispatch is
+    # the number the megakernel exists to move. `one_dispatch` is
+    # verified structurally (jaxpr: no device-side hop loop survives,
+    # each of whose iterations would be a separate kernel launch) and
+    # recorded on the entry next to a per-batch host-dispatch counter.
+    with algo_section('serving_latency.cagra'):
+        from raft_tpu.ops import cagra_fused
+        from raft_tpu.serve import metrics as cserve_metrics
+        from raft_tpu.serve.batcher import BucketLadder as _CLadder, \
+            MicroBatcher as _CBatcher
+
+        remaining = budget_s - (time.perf_counter() - t_start)
+        from raft_tpu.core.errors import expects as _expects
+        _expects(remaining > 120,
+                 "cagra serving lane skip: %.0fs left < 120s", remaining)
+        sp_cs = cagra.SearchParams(itopk_size=32, search_width=4,
+                                   max_iterations=5)
+        es = getattr(ci, "_edge_store", None)
+        if es is None:
+            cagra.prepare_traversal(ci)
+            es = ci._edge_store
+        can_fuse = cagra_fused.fused_capable(
+            32, 4, es[1].shape[1], es[1].shape[2], es[1].dtype, 5)
+        serve_eng = ("fused" if can_fuse
+                     and jax.default_backend() == "tpu" else eng_winner)
+        kb_cs = 16
+        # structural one-dispatch check: trace the fused program (cheap,
+        # no compile/execution) and count surviving device-side loops
+        disp_stats = {}
+        if can_fuse:
+            try:
+                disp_stats = cagra_fused.one_dispatch_stats(
+                    lambda q: cagra.search(ci, q, kb_cs, sp_cs,
+                                           engine="fused"),
+                    queries[:16])
+            except Exception as e:  # noqa: BLE001
+                log(f"# one_dispatch trace failed ({type(e).__name__}: "
+                    f"{e})")
+        # donate="auto": the donated double-buffered pair is the lane's
+        # subject; the kernel path was just raced/rehearsed above, and a
+        # dispatch failure here fails the lane's futures, not the run
+        searcher_cs = cagra.make_searcher(ci, sp_cs, engine=serve_eng,
+                                          donate="auto")
+        host_dispatches = [0]
+
+        def cs_search(q, kk, res=None):
+            host_dispatches[0] += 1
+            return searcher_cs(q, kk, res=res)
+
+        reg_cs = cserve_metrics.Registry()
+        bc = _CBatcher(cs_search, d, ladder=_CLadder((16, 64), (kb_cs,)),
+                       registry=reg_cs, name="serve_cagra",
+                       trace_sample=1.0, max_wait_s=0.002)
+        try:
+            cs_warm = bc.warmup()
+            rng_cs = np.random.default_rng(13)
+            qhost_cs = np.asarray(queries[:1000])
+            n_req_cs, inflight_cap = 120, 8
+            sizes = rng_cs.choice([1, 2, 4, 8, 16], size=n_req_cs,
+                                  p=[.3, .25, .2, .15, .1])
+            t0 = time.perf_counter()
+            inflight = []
+            for m_cs in sizes:
+                s0 = int(rng_cs.integers(0, len(qhost_cs) - int(m_cs)))
+                inflight.append(bc.submit(qhost_cs[s0:s0 + int(m_cs)], k))
+                if len(inflight) >= inflight_cap:
+                    inflight.pop(0).result(300)
+            for r in inflight:
+                r.result(300)
+            cs_wall = time.perf_counter() - t0
+        finally:
+            bc.close()
+        snap_cs = reg_cs.snapshot()
+        # recall at the serving params, same engine (fused is
+        # bit-identical to edge, but record what actually served)
+        rec_cs = robust_call(lambda: device_recall(
+            cagra.search(ci, queries[:1000], k, sp_cs,
+                         engine=serve_eng)[1], cgt[:1000]),
+            "cagra serve recall")
+        lat_cs = snap_cs["histograms"]["serve_cagra.latency_s"]
+        stage_cs = {s: snap_cs["histograms"][f"serve_cagra.stage.{s}_s"]
+                    for s in ("queue_wait", "bucket_pad", "dispatch",
+                              "device", "demux")}
+        batches_cs = int(snap_cs["counters"]["serve_cagra.batches"])
+        add_entry(
+            "serving_latency",
+            f"serving_latency.cagra.{serve_eng}.itopk32",
+            cs_wall, lat_cs["p50"], rec_cs, 0.0,
+            {"p50_ms": round(lat_cs["p50"] * 1e3, 2),
+             "p99_ms": round(lat_cs["p99"] * 1e3, 2),
+             "stage_p50_ms": {s: round(h["p50"] * 1e3, 3)
+                              for s, h in stage_cs.items()},
+             "stage_p99_ms": {s: round(h["p99"] * 1e3, 3)
+                              for s, h in stage_cs.items()},
+             "engine": serve_eng,
+             # the acceptance bit: no device-side hop loop survives in
+             # the fused program AND the serving path issued exactly one
+             # host dispatch per batch
+             "one_dispatch": bool(
+                 disp_stats.get("one_dispatch", False)
+                 and serve_eng == "fused"
+                 and host_dispatches[0] - len(bc.ladder.shapes())
+                 == batches_cs),
+             "dispatch_structure": disp_stats,
+             "host_dispatches": host_dispatches[0],
+             "requests": n_req_cs, "closed_loop_inflight": inflight_cap,
+             "batches": batches_cs, "warmup_compiles": cs_warm,
+             "steady_state_recompiles": int(cserve_metrics.counter(
+                 "serve.recompiles").value),
+             "trace_sample": 1.0},
+            batch=n_req_cs, baseline_key=None)
+
     # --- cagra at the BASELINE 1M scale (the lane's missing point) ------
     # The graph build is the cost. knn_graph auto → nn_descent at 1M
     # (O(rounds·n·C·d), batch-shaped programs — the 1M single-program

@@ -42,7 +42,7 @@ import jax.numpy as jnp
 from ..ops.autotune import measure_value_read_wall
 
 __all__ = ["probe", "matmul_tflops", "hbm_stream_gbps", "gather_gbps",
-           "dispatch_us"]
+           "dispatch_us", "dispatch_split"]
 
 
 def _slope(make_fn, make_inputs, i1: int, i2: int) -> float:
@@ -161,6 +161,46 @@ def dispatch_us(reps: int = 11) -> float:
     return _median_time(f, x, reps=reps) * 1e6
 
 
+def dispatch_split(reps: int = 32) -> dict:
+    """The ISSUE 12 decomposition of the dispatch constant: first-call
+    vs amortized.
+
+    ``dispatch_once_us`` is the round trip of the FIRST post-compile
+    dispatch of a fresh executable (program upload + the full
+    dispatch+sync transport) — what an un-warmed serving bucket or a
+    per-hop kernel-launch loop pays. ``dispatch_steady_us`` is the
+    amortized per-dispatch cost of ``reps`` back-to-back asynchronous
+    dispatches closed by ONE sync — what a pipelined (double-buffered)
+    serving loop or the one-dispatch megakernel actually pays per call.
+    The gap between the two is the attribution the megakernel's win
+    needs: a big once/steady ratio says the fixed per-launch cost, not
+    the kernel math, bounded the old per-hop path."""
+    import time as _time
+
+    x = jnp.zeros((8, 128), jnp.float32)
+
+    def f(x):
+        return x + 1.0
+
+    # fresh executable per probe run (a lambda is a distinct jit cache
+    # key per call of dispatch_split, so re-probes stay honest)
+    g = jax.jit(lambda a: f(a) * 1.0)
+    compiled = g.lower(x).compile()
+    t0 = _time.perf_counter()
+    jax.block_until_ready(compiled(x))
+    once = _time.perf_counter() - t0
+    # steady: back-to-back async dispatches, one closing sync; each
+    # call feeds the next so the chain cannot be collapsed
+    y = x
+    t0 = _time.perf_counter()
+    for _ in range(reps):
+        y = compiled(y)
+    jax.block_until_ready(y)
+    steady = (_time.perf_counter() - t0) / reps
+    return {"dispatch_once_us": round(once * 1e6, 1),
+            "dispatch_steady_us": round(steady * 1e6, 1)}
+
+
 def probe(quick: bool = False) -> Dict[str, float]:
     """Measure this device's effective peaks via slope fits. ~8 compiles;
     each probe streams seconds of device work so the fit is stable.
@@ -179,6 +219,10 @@ def probe(quick: bool = False) -> Dict[str, float]:
             mbytes=512 if quick else 1024, i1=st[0], i2=st[1]), 1),
         "gather_gbps": round(gather_gbps(i1=ga[0], i2=ga[1]), 1),
         "dispatch_us": round(dispatch_us(), 1),
+        # first-call vs amortized split (ISSUE 12): attributes how much
+        # of dispatch_us is per-launch fixed cost a pipelined/one-shot
+        # dispatch path amortizes away
+        **dispatch_split(),
     }
 
 

@@ -59,9 +59,18 @@ from . import refine as refine_mod
 __all__ = ["BuildAlgo", "IndexParams", "SearchParams", "Index", "build",
            "build_knn_graph", "optimize", "search", "save", "load",
            "prepare_search", "prepare_traversal", "tune_search",
-           "make_searcher", "health"]
+           "make_searcher", "health", "ENGINES"]
 
 _SERIAL_VERSION = 2   # v2 adds optional seed_nodes
+
+# the concrete traversal engines (SearchParams.engine / search(engine=)
+# besides "auto"). THE registry the engine drift guard reads
+# (tests/test_quality.py): every member must appear in the tune_search
+# race and be warmable through serve/warmup.py's ladder, so a new
+# engine cannot ship without a measured race lane and a pre-compile
+# path — a first-request compile stall is exactly the regression the
+# serving warmup exists to prevent.
+ENGINES = ("gather", "edge", "fused")
 
 
 class BuildAlgo(enum.Enum):
@@ -121,11 +130,15 @@ class SearchParams:
     algo: str = "auto"
     # hop engine: "edge" streams each parent's contiguous neighbor tile
     # from the edge-resident candidate store (prepare_traversal) through
-    # the Pallas frontier-expansion kernel; "gather" is the composed-XLA
-    # random-row-gather path; "auto" consults the ops.autotune race cache
-    # (tune_search populates it) and otherwise picks "edge" only when a
-    # store is already attached on TPU — a read-only query never grows
-    # the index's HBM footprint as a side effect
+    # the Pallas frontier-expansion kernel; "fused" folds the WHOLE hop
+    # loop into one megakernel launch (ops/cagra_fused.py — frontier in
+    # VMEM, bit-identical to "edge", kills the per-hop dispatch floor);
+    # "gather" is the composed-XLA random-row-gather path; "auto"
+    # consults the ops.autotune race cache (tune_search populates it)
+    # and otherwise picks "edge" only when a store is already attached
+    # on TPU — a read-only query never grows the index's HBM footprint
+    # as a side effect, and the megakernel only dispatches off a
+    # measured race verdict
     engine: str = "auto"
 
 
@@ -167,7 +180,8 @@ class Index:
                   getattr(self, "_score_bf16", None),
                   getattr(self, "_score_i8", None),
                   es[1] if es is not None else None,
-                  es[2] if es is not None else None)
+                  es[2] if es is not None else None,
+                  es[3] if es is not None else None)
         return leaves, (self.metric, es[0] if es is not None else None)
 
     @classmethod
@@ -178,7 +192,7 @@ class Index:
         if leaves[4] is not None:
             out._score_i8 = leaves[4]
         if len(aux) > 1 and aux[1] is not None and leaves[5] is not None:
-            out._edge_store = (aux[1], leaves[5], leaves[6])
+            out._edge_store = (aux[1], leaves[5], leaves[6], leaves[7])
         return out
 
 
@@ -895,8 +909,8 @@ def _dup_mask(cand, keep=None):
                                    "n_seeds", "mt_val", "min_iter",
                                    "engine", "kprime", "interp"))
 def _search_jit(dataset, dataset_score, score_scales, graph, qc, mask_bits,
-                seed_key, seed_rows, edge_vecs, edge_aux, itopk, width,
-                max_iter, k, n_seeds, mt_val, min_iter=0,
+                seed_key, seed_rows, edge_vecs, edge_aux, edge_gp, itopk,
+                width, max_iter, k, n_seeds, mt_val, min_iter=0,
                 engine="gather", kprime=0, interp=False):
     """``dataset_score`` feeds the seed scoring and (engine="gather") the
     traversal's candidate gathers (bf16 in the default bandwidth-saving
@@ -908,14 +922,19 @@ def _search_jit(dataset, dataset_score, score_scales, graph, qc, mask_bits,
     contiguous neighbor tile from ``edge_vecs``/``edge_aux`` (the
     prepare_traversal store) through the Pallas frontier-expansion
     kernel, which emits a per-parent top-``kprime`` — the merge width
-    shrinks from width·degree to width·kprime."""
+    shrinks from width·degree to width·kprime. ``engine="fused"``: the
+    whole hop loop collapses into ONE megakernel launch
+    (ops/cagra_fused.py) — the frontier lives in VMEM across grid steps
+    and ``edge_gp`` (the store's tile-padded graph rows) feeds the
+    in-kernel id extraction; bit-identical to the edge engine by
+    construction."""
     mt = DistanceType(mt_val)
     m, dim = qc.shape
     n = dataset.shape[0]
     degree = graph.shape[1]
     metric_s = "ip" if mt is DistanceType.InnerProduct else "l2"
 
-    if engine == "edge" and mask_bits is not None:
+    if engine in ("edge", "fused") and mask_bits is not None:
         # the bitset filter in edge-major layout: the kernel adds this
         # penalty in-VMEM, so filtered edges never reach the merge. One
         # (n, degree) gather per CALL (not per hop), loop-invariant
@@ -1026,8 +1045,21 @@ def _search_jit(dataset, dataset_score, score_scales, graph, qc, mask_bits,
         new_e = jnp.take_along_axis(all_e, sel, axis=1)
         return new_i, new_d, new_e, it + 1
 
-    state = (buf_i, buf_d, explored, jnp.int32(0))
-    buf_i, buf_d, explored, _ = jax.lax.while_loop(cond, body, state)
+    if engine == "fused":
+        # ONE kernel launch for the whole traversal: the seeded buffer
+        # goes in, the converged buffer comes out — no host-visible hop
+        # loop remains (the fixed grid runs max_iter hops; converged
+        # hops are exact no-ops, see ops/cagra_fused.fused_traverse)
+        from ..ops.cagra_fused import fused_traverse
+
+        buf_d, buf_i = fused_traverse(
+            qc, buf_d, buf_i, edge_vecs, edge_aux, edge_gp, edge_pen,
+            itopk=itopk, width=width, max_iter=int(max_iter),
+            kprime=kprime, degree=degree, metric=metric_s,
+            interpret=interp)
+    else:
+        state = (buf_i, buf_d, explored, jnp.int32(0))
+        buf_i, buf_d, explored, _ = jax.lax.while_loop(cond, body, state)
 
     # exact f32 re-score + re-rank of the returned k (fixes any bf16
     # traversal rounding; one (m, k, d) gather)
@@ -1129,7 +1161,23 @@ def prepare_traversal(index: Index, candidate_dtype: str = "int8") -> None:
     aux = jnp.stack([es, en[g]], axis=1)
     if pad_d:
         aux = jnp.pad(aux, ((0, 0), (0, 0), (0, pad_d)))
-    index._edge_store = (meta, ev, aux)
+    # tile-padded graph rows ride with the store: the fused megakernel
+    # DMAs each parent's id row next to its edge tile (pad edges are
+    # masked in-kernel by `col < degree`, so the pad id value is inert)
+    gp = jnp.pad(g, ((0, 0), (0, pad_d))) if pad_d else g
+    index._edge_store = (meta, ev, aux, gp)
+
+
+def _plan_dims(p: "SearchParams", k: int):
+    """(itopk, width, max_iter) of the traversal plan — ONE derivation,
+    because ``search`` (the dispatch) and ``tune_search`` (the fused
+    VMEM-capability gate) must agree on the hop budget a shape implies."""
+    itopk = max(p.itopk_size, k)
+    width = max(1, p.search_width)
+    max_iter = p.max_iterations or (itopk // width + 16)
+    # min_iterations must win over the auto max (the reference adjusts
+    # max_iterations up the same way)
+    return itopk, width, max(int(max_iter), int(p.min_iterations))
 
 
 def _tune_key(index: Index, m: int, k: int, p: "SearchParams",
@@ -1151,16 +1199,21 @@ def _tune_key(index: Index, m: int, k: int, p: "SearchParams",
 def tune_search(index: Index, queries, k: int,
                 params: SearchParams | None = None, reps: int = 3,
                 suspect_floor_s: float = 0.0,
-                store_dtype: str = "int8"):
+                store_dtype: str = "int8", engines=None):
     """Measure the traversal engines on-device for this shape class and
     cache the winner (consulted by ``engine="auto"``): the streamed
-    edge-store hop (Pallas frontier expansion) races the XLA gather hop.
-    Attaches the edge store for the race and DROPS it again when the
-    gather engine wins — the store is ~``n·degree·dim`` bytes of extra
-    HBM and only earns it behind the winning engine. Call eagerly (not
-    under jit) — e.g. once at serving start, or from the bench harness.
-    Returns (winner, timings)."""
+    edge-store hop (Pallas frontier expansion) and the one-dispatch
+    megakernel (``engine="fused"``) race the XLA gather hop — every
+    member of :data:`ENGINES` runs (the fused lane is skipped only when
+    its VMEM working set exceeds the megakernel cap, see
+    ``ops.cagra_fused.fused_capable``). Attaches the edge store for the
+    race and DROPS it again when the gather engine wins — the store is
+    ~``n·degree·dim`` bytes of extra HBM and only earns it behind a
+    store-backed winning engine. Call eagerly (not under jit) — e.g.
+    once at serving start, or from the bench harness. Returns
+    (winner, timings)."""
     from ..ops import autotune
+    from ..ops.cagra_fused import fused_capable
 
     p = params or SearchParams()
     q = jnp.asarray(queries, jnp.float32)
@@ -1176,12 +1229,19 @@ def tune_search(index: Index, queries, k: int,
         return autotune.JitArgFn(jax.jit(
             lambda qq, idx, e=eng: search(idx, qq, k, p, engine=e)), index)
 
-    cands = {"gather": _engine("gather"), "edge": _engine("edge")}
+    itopk, width, max_iter = _plan_dims(p, k)
+    ev = index._edge_store[1]
+    # engines=None races the full registry (the drift guard holds the
+    # default to ENGINES); an explicit subset is a caller's cost choice
+    cands = {e: _engine(e) for e in (engines or ENGINES)
+             if e != "fused" or fused_capable(
+                 itopk, width, ev.shape[1], ev.shape[2], ev.dtype,
+                 max_iter)}
     winner, timings = autotune.tune_best(key, cands, q, reps=reps,
                                          force=True,
                                          suspect_floor_s=suspect_floor_s,
                                          value_read=True)
-    if winner != "edge":
+    if winner not in ("edge", "fused"):
         index.__dict__.pop("_edge_store", None)
         # the raced key carried the STORE dtype; with the store dropped,
         # auto queries are storeless and key on candidate_dtype — mirror
@@ -1211,20 +1271,18 @@ def search(
     ``engine``: overrides ``SearchParams.engine`` — "edge" (streamed
     edge-store hop via the Pallas frontier-expansion kernel; requires /
     eagerly builds the ``prepare_traversal`` store, and is guarded onto
-    the gather path on kernel failure), "gather" (composed-XLA random
-    row gather), or "auto" (autotune cache, then store-attached
-    heuristic).
+    the gather path on kernel failure), "fused" (the one-dispatch
+    traversal megakernel, ops/cagra_fused.py — same store requirement,
+    guarded onto the edge→gather chain via ``cagra.fused_search``),
+    "gather" (composed-XLA random row gather), or "auto" (autotune
+    cache, then store-attached heuristic; fused only off a measured
+    race verdict).
     """
     p = params or SearchParams()
     q = jnp.asarray(queries, jnp.float32)
     expects(q.ndim == 2 and q.shape[1] == index.dim, "bad query shape %s",
             tuple(q.shape))
-    itopk = max(p.itopk_size, k)
-    width = max(1, p.search_width)
-    max_iter = p.max_iterations or (itopk // width + 16)
-    # min_iterations must win over the auto max (the reference adjusts
-    # max_iterations up the same way)
-    max_iter = max(int(max_iter), int(p.min_iterations))
+    itopk, width, max_iter = _plan_dims(p, k)
     if (index.seed_nodes is not None and filter is None
             and index.seed_nodes.shape[0] >= 64):
         # the shared covering set does the heavy seeding; random seeds
@@ -1269,28 +1327,32 @@ def search(
             "unknown cagra search algo %r", p.algo)
 
     eng = engine or p.engine
-    expects(eng in ("auto", "edge", "gather"),
+    expects(eng in ("auto",) + ENGINES,
             "unknown cagra traversal engine %r", eng)
     store = getattr(index, "_edge_store", None)
     if eng == "auto":
         from ..ops import autotune
 
         hit = autotune.lookup(_tune_key(index, q.shape[0], k, p, store))
-        if hit == "gather" or (hit == "edge" and store is not None):
+        if hit == "gather" or (hit in ("edge", "fused")
+                               and store is not None):
             eng = hit
         elif store is not None and jax.default_backend() == "tpu":
             # a store someone paid for implies the streamed hop; without
             # one, auto never builds it — tune_search / prepare_traversal
-            # are the opt-ins (a read-only query must not double HBM)
+            # are the opt-ins (a read-only query must not double HBM).
+            # The megakernel only dispatches off a measured race verdict
+            # (tune_search) — an unraced shape stays on the rehearsed
+            # per-hop kernel.
             eng = "edge"
         else:
             eng = "gather"
-    if eng == "edge" and store is None:
+    if eng in ("edge", "fused") and store is None:
         from ..utils import in_jax_trace
 
         expects(not in_jax_trace(),
-                "engine='edge' requires prepare_traversal(index) before "
-                "tracing (the edge store cannot be built under jit)")
+                "engine=%r requires prepare_traversal(index) before "
+                "tracing (the edge store cannot be built under jit)", eng)
         prepare_traversal(index)
         store = index._edge_store
     kprime = min(index.graph_degree, itopk)
@@ -1298,20 +1360,32 @@ def search(
 
     def run(qc, key=key):
         def _go(e):
-            ev, ea = (store[1], store[2]) if e == "edge" else (None, None)
+            ev, ea, gp = ((store[1], store[2], store[3])
+                          if e in ("edge", "fused") else (None, None, None))
             return _search_jit(index.dataset, score, scales, index.graph,
                                qc, mask_bits, key, index.seed_nodes, ev,
-                               ea, itopk, width, int(max_iter), k,
+                               ea, gp, itopk, width, int(max_iter), k,
                                n_seeds, index.metric.value,
                                int(p.min_iterations), engine=e,
                                kprime=kprime, interp=interp)
 
-        if eng == "edge":
+        def _edge_guarded():
             # a frontier-kernel failure demotes this site to the exact
             # XLA gather path (ops/guarded.py) — one log line and a
             # slower call, never the request
             return guarded_call("cagra.graph_expand",
                                 lambda: _go("edge"), lambda: _go("gather"))
+
+        if eng == "fused":
+            # megakernel failure → the per-hop edge engine (itself
+            # guarded onto the gather path): the fallback chain serves
+            # bit-identical results at worst two demotion log lines
+            from ..ops.cagra_fused import FUSED_SITE
+
+            return guarded_call(FUSED_SITE,
+                                lambda: _go("fused"), _edge_guarded)
+        if eng == "edge":
+            return _edge_guarded()
         return _go("gather")
 
     if query_chunk <= 0 and deadline.carried(res) is not None:
@@ -1446,27 +1520,57 @@ def health(index: Index, sample: int = 256) -> dict:
 
 
 def make_searcher(index: Index, params: SearchParams | None = None, *,
-                  degrade=None, **opts):
+                  degrade=None, donate=False, **opts):
     """Stable batchable signature for the serving runtime
     (:mod:`raft_tpu.serve`): returns ``fn(queries, k, res=None) ->
     (distances, indices)`` with the traversal policy frozen at closure
     build time, so repeated bucketed-shape calls hit the same cached
     executables. ``opts`` forwards to :func:`search` (``filter``,
-    ``query_chunk``, ``engine``, ...). Pinning ``engine="edge"`` (via
-    opts or ``params.engine``) builds the edge-resident candidate store
-    at closure-build time, not on the first request — serve warmup then
-    only pays the per-shape compiles. ``degrade``: a
-    :class:`~raft_tpu.serve.degrade.BrownoutController` — under brownout
-    its current level overrides ``itopk_size``/``search_width`` per call
-    (docs/robustness.md)."""
+    ``query_chunk``, ``engine``, ...). Pinning ``engine="edge"`` or
+    ``"fused"`` (via opts or ``params.engine``) builds the edge-resident
+    candidate store at closure-build time, not on the first request —
+    serve warmup then only pays the per-shape compiles.
+
+    ``donate``: OPT-IN (default off) — donate the per-call query
+    block's device buffer to the jitted search
+    (``jax.jit(..., donate_argnums=)``), letting XLA reuse it for
+    outputs; with the batcher's double-buffered dispatch two batches
+    are in flight, and donation keeps that from doubling the transient
+    buffer footprint. ``"auto"`` donates on TPU only (CPU ignores
+    donation and warns per call). Caveats (docs/perf.md "One-dispatch
+    search"): the donated path wraps ``search`` in an OUTER jit, so
+    guarded-site breakers are consulted at trace time, not per call —
+    a kernel-engine failure surfaces as the compile error instead of
+    the demoted fallback, which is why it is opt-in; donation is
+    skipped for deadline-carrying requests (the chunked host loop owns
+    those), under ``degrade`` (per-call param changes would defeat the
+    jit cache), and for caller-owned device arrays (donating those
+    would delete the caller's buffer — only host-side blocks, the
+    batcher's case, are donated).
+
+    ``degrade``: a :class:`~raft_tpu.serve.degrade.BrownoutController`
+    — under brownout its current level overrides
+    ``itopk_size``/``search_width`` per call (docs/robustness.md)."""
     eng = opts.get("engine") or (params.engine if params is not None
                                  else None)
-    if eng == "edge":
+    if eng in ("edge", "fused"):
         prepare_traversal(index)
     base = params or SearchParams()
+    if donate == "auto":
+        donate = jax.default_backend() == "tpu"
+    jits: dict = {}
 
     def _fn(queries, k, res=None):
         p = base if degrade is None else degrade.params(base)
+        if (donate and res is None and degrade is None
+                and not isinstance(queries, jax.Array)):
+            fn = jits.get(k)
+            if fn is None:
+                fn = jax.jit(
+                    lambda qq, ix, kk=k: search(ix, qq, kk, base, **opts),
+                    donate_argnums=(0,))
+                jits[k] = fn
+            return fn(jnp.asarray(queries, jnp.float32), index)
         return search(index, queries, k, p, res=res, **opts)
 
     return _fn

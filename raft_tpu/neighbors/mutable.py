@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import os
 import threading
 import time
@@ -790,7 +791,37 @@ class MutableIndex:
         out = self._search_sealed(
             index, jnp.zeros((m, self.dim), jnp.float32), k, params,
             None, opts)
-        jax.block_until_ready((out[0], out[1]))
+        # NO unconditional sync (ISSUE 12 hot-path audit): the compile —
+        # the stall _prewarm exists to pre-pay — happens synchronously at
+        # the dispatch above; waiting for the warm EXECUTION would only
+        # serialize the serve path behind device time (post-flip requests
+        # queue behind it on-device either way). Like the batcher's
+        # device probe, a sync happens only on the telemetry sample so
+        # the warm execution's device wall stays observable.
+        try:
+            rate = tracing.sample_rate(None)
+        except Exception:  # noqa: BLE001 - a malformed knob is
+            rate = 0.0     # telemetry; it must never fail the merge
+        if rate > 0:
+            tick = getattr(self, "_prewarm_tick", 0)
+            self._prewarm_tick = tick + 1
+            if tick % max(1, math.ceil(1.0 / rate)) == 0:
+                t0 = self._clock()
+                # deliberately OUTSIDE any swallow: a sampled probe that
+                # surfaces a real device-side execution failure must
+                # abandon the merge (the pre-ISSUE-12 gate), not flip a
+                # segment whose serving shape cannot execute. Unsampled
+                # ticks trade that detection for the no-sync mandate —
+                # the post-flip breakers/sentinel own it there.
+                jax.block_until_ready(jax.tree_util.tree_leaves(out))
+                try:
+                    from ..serve import metrics as _metrics
+
+                    _metrics.default_registry.histogram(
+                        "mutable.prewarm.device_s").observe(
+                        self._clock() - t0)
+                except Exception:  # noqa: BLE001 - telemetry must not
+                    pass           # break the merge
 
     def _merge_once(self, deadline_s: Optional[float]) -> str:
         t0 = self._clock()

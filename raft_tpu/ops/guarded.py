@@ -4,7 +4,8 @@ equivalents on compile/execution failure — and probe their way back.
 Every custom-kernel engine in this library has an exact composed-XLA
 equivalent (that is what the parity tests assert; gated sites today:
 ``select_k`` KPASS, the ivf_flat/ivf_pq scans, ``brute_force.fused``,
-``cagra.graph_expand`` → the XLA gather hop, ``cagra.nn_descent`` → the
+``cagra.graph_expand`` → the XLA gather hop, ``cagra.fused_search`` →
+the per-hop edge/gather chain, ``cagra.nn_descent`` → the
 exact/ivf_pq graph builders, and the sharded merge's
 ``sharded.ring_topk`` → the allgather + ``knn_merge_parts`` program),
 so a Pallas failure — a Mosaic lowering bug on a new chip generation, a
@@ -111,6 +112,10 @@ POLICIES: Dict[str, BreakerPolicy] = {
     "ivf_pq.scan": DEFAULT_POLICY,
     "brute_force.fused": DEFAULT_POLICY,
     "cagra.graph_expand": DEFAULT_POLICY,
+    # the one-dispatch traversal megakernel (ops/cagra_fused.py): falls
+    # back to the per-hop edge engine, which carries its own breaker
+    # (cagra.graph_expand) onto the XLA gather path
+    "cagra.fused_search": DEFAULT_POLICY,
     "cagra.nn_descent": DEFAULT_POLICY,
     # the ring merge compiles per mesh shape; probing it re-runs a whole
     # shard_map program, so keep the default (not a tighter) cadence

@@ -174,17 +174,23 @@ def _ring_xla(d, gid, k: int, select_min: bool, comms):
 # Pallas ring kernel (TPU): VMEM-resident candidates, remote DMA hops
 # --------------------------------------------------------------------------
 
-def _vmem_fold(cd, cp, cg, k: int, kp: int):
+def _vmem_fold(cd, cp, cg, k: int, kp: int, extra=()):
     """The in-kernel fold: k (min-value, then min-position) extraction
     passes over a (m, w) candidate plane — the KPASS pattern with an
     explicit position plane as the tie key, so ties retire in the same
     lowest-column order ``select_k`` uses. Mosaic has no sort, so the
-    ``lax.sort`` fold is re-expressed as masked min-reductions."""
+    ``lax.sort`` fold is re-expressed as masked min-reductions.
+
+    ``extra``: optional int32 payload planes (same (m, w) shape) carried
+    through the fold — each output slot gets the payload of the cell it
+    extracted (the CAGRA megakernel rides its explored flags here).
+    Returns ``(d, pos, gid, *extras)``."""
     m = cd.shape[0]
     lane = lax.broadcasted_iota(jnp.int32, (m, kp), 1)
 
     def extract(t, state):
-        alive, nd, npos, ng = state
+        alive, nd, npos, ng = state[:4]
+        nex = state[4:]
         masked = jnp.where(alive, cd, jnp.inf)
         best = jnp.min(masked, axis=1, keepdims=True)
         cand = alive & (masked <= best)
@@ -197,19 +203,25 @@ def _vmem_fold(cd, cp, cg, k: int, kp: int):
         g = jnp.min(jnp.where(at, cg, jnp.iinfo(jnp.int32).max), axis=1,
                     keepdims=True)
         hit = lane == t
+        exs = tuple(
+            jnp.where(hit,
+                      jnp.min(jnp.where(at, ce, jnp.iinfo(jnp.int32).max),
+                              axis=1, keepdims=True), ne)
+            for ce, ne in zip(extra, nex))
         return (alive & ~at, jnp.where(hit, best, nd),
-                jnp.where(hit, bpos, npos), jnp.where(hit, g, ng))
+                jnp.where(hit, bpos, npos), jnp.where(hit, g, ng)) + exs
 
     state = (jnp.ones(cd.shape, jnp.bool_),
              jnp.full((m, kp), jnp.inf, jnp.float32),
              jnp.full((m, kp), _INT_BIG, jnp.int32),
              jnp.full((m, kp), -1, jnp.int32))
+    state = state + tuple(jnp.zeros((m, kp), jnp.int32) for _ in extra)
     if k <= 32:
         for t in range(k):
             state = extract(t, state)
     else:
         state = lax.fori_loop(0, k, extract, state)
-    return state[1], state[2], state[3]
+    return (state[1], state[2], state[3]) + tuple(state[4:])
 
 
 def _merge_step_kernel(rd_ref, rp_ref, rg_ref, bd_ref, bp_ref, bg_ref,

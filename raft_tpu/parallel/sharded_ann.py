@@ -649,7 +649,7 @@ def search_cagra(index: ShardedCagra, queries, k: int,
         # this trace, so an edge-resident store can never be attached
         d, i = cagra._search_jit(
             data[0], data[0], None, graph[0], qq, valid,
-            jax.random.key(sp.seed), seed_rows, None, None, itopk,
+            jax.random.key(sp.seed), seed_rows, None, None, None, itopk,
             width, int(max_iter), k, n_seeds, mt.value)
         gi = jnp.where(i >= 0, i + base[0], -1)
         gi = jnp.where(okf[0, 0], gi, -1)       # dead-shard containment

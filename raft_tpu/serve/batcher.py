@@ -35,7 +35,14 @@ form:
 
 The worker is one daemon thread: TPU dispatch is asynchronous, so a
 single submitting thread keeps the device pipelined while callers block
-on per-request futures.
+on per-request futures. Dispatch and demux are **double-buffered**
+(ISSUE 12): while batch N's device→host transfer and per-request
+slicing run on the host, batch N+1 is already dispatched and computing
+— the demux wall overlaps device time instead of serializing with it.
+Depth is exactly two, and an idle queue demuxes immediately, so the
+overlap never delays delivery. Pair with
+``make_searcher(..., donate=)`` closures so the in-flight pair does not
+double the transient device-buffer footprint (docs/serving.md).
 
 A popped batch splits per k bucket before dispatch (one k per
 executable), so heavily mixed-k traffic trades fill ratio for
@@ -263,6 +270,15 @@ class MicroBatcher:
 
     # -- worker -----------------------------------------------------------
     def _run(self) -> None:
+        # double-buffered dispatch (docs/serving.md): `pending` is a
+        # dispatched-but-not-demuxed group. Batch N+1 is DISPATCHED
+        # before batch N is demuxed, so the device computes N+1 while
+        # the host blocks on N's device→host transfer — the demux wall
+        # no longer serializes with device time. Depth is exactly two:
+        # one group on device, one being coalesced. When the queue is
+        # idle the pending group is demuxed immediately (overlap must
+        # never delay delivery behind the coalescing wait).
+        pending = None
         while True:
             wait = self._max_wait_s
             if self._degrade is not None:
@@ -270,9 +286,15 @@ class MicroBatcher:
                     wait *= self._degrade.max_wait_scale()
                 except Exception:  # noqa: BLE001 - a broken controller
                     pass           # must not stall the worker
-            batch = self.queue.pop_batch(self._max_batch, wait,
-                                         max_rows=self.ladder.max_queries)
+            if pending is not None and len(self.queue) == 0:
+                pending = self._safe_demux(pending)
+            batch = self.queue.pop_batch(
+                self._max_batch, 0.0 if pending is not None else wait,
+                max_rows=self.ladder.max_queries)
             if not batch:
+                if pending is not None:
+                    pending = self._safe_demux(pending)
+                    continue
                 if self.queue.closed:
                     return
                 continue
@@ -288,8 +310,17 @@ class MicroBatcher:
                 groups.setdefault(self.ladder.bucket_k(r.k), []).append(r)
             for kb in sorted(groups):
                 reqs = groups[kb]
+                # a deadline-carrying group dispatches through the
+                # blocking chunked host loop — deliver the finished
+                # pending batch BEFORE entering it (the overlap contract
+                # assumes dispatch returns asynchronously; post-warmup
+                # zero-recompile steady state covers the compile case)
+                if pending is not None and any(r.deadline is not None
+                                               for r in reqs):
+                    pending = self._safe_demux(pending)
+                cur = None
                 try:
-                    self._dispatch_group(kb, reqs)
+                    cur = self._dispatch_phase(kb, reqs)
                 except Exception as e:  # noqa: BLE001 - worker must survive
                     self._errors.inc()
                     rlog.log_warn(
@@ -305,6 +336,25 @@ class MicroBatcher:
                     for r in reqs:
                         if not r.done():
                             r.set_exception(e)
+                # demux N only AFTER N+1's dispatch is in flight
+                if pending is not None:
+                    pending = self._safe_demux(pending)
+                pending = cur
+
+    def _safe_demux(self, pend) -> None:
+        """Demux a dispatched group; a demux failure (a poisoned device
+        buffer surfacing at transfer) fails that group's futures, never
+        the worker. Returns None (the cleared pending slot)."""
+        try:
+            self._demux_phase(pend)
+        except Exception as e:  # noqa: BLE001 - worker must survive
+            self._errors.inc()
+            rlog.log_warn("serve %s: batch demux failed (%s: %s)",
+                          self._name, type(e).__name__, e)
+            for r in pend["live"]:
+                if not r.done():
+                    r.set_exception(e)
+        return None
 
     def _tightest_deadline(self, reqs: List[Request]) -> Optional[Deadline]:
         carried = [r.deadline for r in reqs if r.deadline is not None]
@@ -313,6 +363,17 @@ class MicroBatcher:
         return min(carried, key=lambda d: d.remaining())
 
     def _dispatch_group(self, kb: int, reqs: List[Request]) -> None:
+        """Dispatch + demux in one step (the unpipelined path: partial
+        re-dispatch after a mid-batch deadline expiry)."""
+        pend = self._dispatch_phase(kb, reqs)
+        if pend is not None:
+            self._demux_phase(pend)
+
+    def _dispatch_phase(self, kb: int, reqs: List[Request]):
+        """Coalesce + pad + issue the (asynchronous) search dispatch.
+        Returns the pending-demux state, or None when nothing was
+        dispatched (all shed, or a deadline expired mid-dispatch and
+        partials were delivered)."""
         # late shed: a deadline can expire between admission pop and here
         # (e.g. an earlier group's dispatch, or an armed slow worker)
         live = []
@@ -322,7 +383,7 @@ class MicroBatcher:
             else:
                 live.append(r)
         if not live:
-            return
+            return None
         # stage-telemetry probe decision: one falsy check when disabled;
         # when enabled, every _probe_every-th group tells the full story
         probe = False
@@ -351,8 +412,21 @@ class MicroBatcher:
                                    res=self._tightest_deadline(live))
         except DeadlineExceeded as e:
             self._deliver_partial(kb, live, offs, e)
-            return
+            return None
         dt = self._clock() - t0
+        return {"kb": kb, "live": live, "offs": offs, "out": out,
+                "probe": probe, "pad_dt": pad_dt, "dt": dt, "mb": mb,
+                "rows": rows}
+
+    def _demux_phase(self, pend) -> None:
+        """Block on the dispatched group's results, slice them back to
+        requests, deliver, and record the stage telemetry. Runs AFTER
+        the next group's dispatch is in flight (the double buffer)."""
+        kb, live, offs, out = (pend["kb"], pend["live"], pend["offs"],
+                               pend["out"])
+        probe, pad_dt, dt, mb, rows = (pend["probe"], pend["pad_dt"],
+                                       pend["dt"], pend["mb"],
+                                       pend["rows"])
         device_dt = 0.0
         if probe:
             # the off-hot-path device probe: dispatch is asynchronous, so

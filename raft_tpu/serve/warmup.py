@@ -40,6 +40,8 @@ from typing import List, Optional
 import jax
 import numpy as np
 
+from ..core.errors import expects
+
 __all__ = ["CompileCounter", "count_compilations", "warmup",
            "install_recompile_watch", "compile_context"]
 
@@ -155,7 +157,7 @@ def count_compilations():
 
 
 def warmup(search_fn, ladder, dim: int, dtype=np.float32, registry=None,
-           name: str = "serve", prepare=None) -> int:
+           name: str = "serve", prepare=None, engines=None) -> int:
     """Dispatch a dummy batch through ``search_fn`` at every ladder shape
     and block on each result. Returns the number of XLA compilations the
     sweep triggered (0 when the process is already warm). Records
@@ -169,23 +171,45 @@ def warmup(search_fn, ladder, dim: int, dtype=np.float32, registry=None,
     ``lambda: cagra.prepare_traversal(index)`` (the edge-resident
     candidate store is seconds of gather+pack at corpus scale, and the
     jitted ladder shapes can only reuse it if it exists before their
-    first trace)."""
+    first trace).
+
+    ``engines``: optional ``{engine_name: search_fn}`` mapping — every
+    engine closure is swept across the FULL ladder (``search_fn`` may
+    be None then). This is how a multi-engine family pre-compiles every
+    traversal engine at the serving buckets (the cagra fused megakernel
+    must never be first-request compiled; the engine drift guard in
+    tests/test_quality.py holds every registered engine to it)."""
     from . import metrics as _metrics
 
     reg = registry or _metrics.default_registry
     if prepare is not None:
         prepare()
+    if engines is not None:
+        # an explicitly-empty mapping (every engine capability-filtered
+        # out) warms nothing — it must NOT fall back to search_fn, which
+        # the engines contract allows to be None
+        fns = dict(engines)
+    else:
+        expects(search_fn is not None,
+                "warmup needs a search_fn or an engines mapping")
+        fns = {"": search_fn}
     shapes = 0
     with count_compilations() as cc:
-        for mb in ladder.query_buckets:
-            q = np.zeros((mb, int(dim)), dtype)
-            for kb in ladder.k_buckets:
-                with compile_context(f"{name}:warmup:{mb}x{kb}",
-                                     warmup=True):
-                    out = search_fn(q, kb)
-                    # block: compiles are lazy until the dispatch executes
-                    jax.block_until_ready((out[0], out[1]))
-                shapes += 1
+        for eng, fn in fns.items():
+            tag = f":{eng}" if eng else ""
+            for mb in ladder.query_buckets:
+                q = np.zeros((mb, int(dim)), dtype)
+                for kb in ladder.k_buckets:
+                    with compile_context(f"{name}:warmup{tag}:{mb}x{kb}",
+                                         warmup=True):
+                        out = fn(q, kb)
+                        # block the FULL output pytree: compiles are lazy
+                        # until the dispatch executes, and a 3-tuple
+                        # (shards_ok) or donated-closure output whose
+                        # tail leaves were never forced would leave the
+                        # first real request a residual trace to pay
+                        jax.block_until_ready(out)
+                    shapes += 1
     reg.gauge(f"{name}.warmup.shapes").set(shapes)
     reg.counter(f"{name}.warmup.compiles").inc(cc.count)
     return cc.count

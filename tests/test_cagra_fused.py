@@ -1,0 +1,195 @@
+"""One-dispatch CAGRA traversal (ISSUE 12): interpret-mode BIT-identity
+of the fused megakernel (``engine="fused"``) against the per-hop edge
+engine, the guarded fallback chain, and the structural one-dispatch
+property (no device-side hop loop survives in the fused program).
+
+Tier-1 cost discipline: ONE tiny geometry shared across the tier-1
+tests (module-scoped index; the guarded and one-dispatch tests reuse
+the parity test's cached executables/jaxprs), ``width=1`` +
+``max_iterations=4`` keeps the interpret-mode megakernel trace small,
+and the heavier corners (filters, k=1, off-tile degree + the k'
+truncation, the fori-loop fold, bf16/IP, the real three-way race) ride
+the ``slow`` lane per the tier-1 wall policy."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import faults
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import cagra
+from raft_tpu.ops import cagra_fused, guarded
+
+N, D, DEG, M, K = 800, 16, 16, 8, 5
+SP = cagra.SearchParams(itopk_size=16, search_width=1, max_iterations=4,
+                        candidate_dtype="int8")
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(21)
+    return rng.standard_normal((N, D)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def queries():
+    rng = np.random.default_rng(22)
+    return rng.standard_normal((M, D)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def index(dataset):
+    ix = cagra.build(dataset, cagra.IndexParams(
+        intermediate_graph_degree=24, graph_degree=DEG, seed=0))
+    cagra.prepare_traversal(ix)            # int8 edge store + graph rows
+    return ix
+
+
+def _parity(ix, qs, k, sp, filt=None):
+    de, ie = cagra.search(ix, qs, k, sp, engine="edge", filter=filt)
+    df, if_ = cagra.search(ix, qs, k, sp, engine="fused", filter=filt)
+    np.testing.assert_array_equal(np.asarray(if_), np.asarray(ie))
+    np.testing.assert_array_equal(np.asarray(df), np.asarray(de))
+    return np.asarray(ie)
+
+
+class TestFusedParity:
+    def test_bit_identity_core(self, index, queries):
+        """Same seeds, same store → the megakernel's whole traversal is
+        bit-identical to the per-hop edge engine (ids AND distances):
+        parent pick order, scoring, k' extraction, dedup and the
+        positional fold all mirror the hop body exactly."""
+        ids = _parity(index, queries, K, SP)
+        assert (ids >= 0).all() and (ids < N).all()
+
+    @pytest.mark.slow
+    def test_bit_identity_k1_and_filter(self, index, dataset, queries):
+        """k=1 boundary and the bitset filter (the in-kernel penalty
+        rows): still bit-identical, and filtered rows never surface."""
+        _parity(index, queries, 1, SP)
+        mask = np.ones(N, bool)
+        mask[::3] = False
+        filt = Bitset.from_mask(jnp.asarray(mask))
+        ids = _parity(index, queries, K, SP, filt=filt)
+        assert not np.isin(ids[ids >= 0], np.where(~mask)[0]).any()
+
+    @pytest.mark.slow
+    def test_bit_identity_off_tile_kprime_width(self, dataset, queries):
+        """degree=24 is off the int8 sublane tile (deg_p pads to 32) AND
+        exceeds itopk=16, engaging the per-parent top-k' truncation;
+        width=2 engages the cross-parent dedup and the multi-fold merge
+        — the tie-heaviest corner of the parity argument."""
+        ix = cagra.build(dataset, cagra.IndexParams(
+            intermediate_graph_degree=32, graph_degree=24, seed=0))
+        cagra.prepare_traversal(ix)
+        sp = dataclasses.replace(SP, search_width=2, max_iterations=3)
+        ids = _parity(ix, queries, K, sp)
+        assert (ids[ids >= 0] < N).all()
+
+    @pytest.mark.slow
+    def test_bit_identity_fori_paths_bf16_ip(self, dataset, queries):
+        """itopk=64 drives the fold through its fori_loop form (k>32)
+        and kprime>16 drives the extraction loop; bf16 store + IP metric
+        cover the other scoring branch."""
+        ix = cagra.build(dataset, cagra.IndexParams(
+            intermediate_graph_degree=24, graph_degree=DEG,
+            metric="inner_product", seed=0))
+        cagra.prepare_traversal(ix, "bfloat16")
+        sp = cagra.SearchParams(itopk_size=64, search_width=2,
+                                max_iterations=2)
+        _parity(ix, queries, K, sp)
+
+    @pytest.mark.slow
+    def test_tune_search_races_fused(self, index, queries, monkeypatch):
+        """The real three-way race: default engines include fused (when
+        VMEM-capable), the winner is recorded, and the store policy
+        follows store-backed winners."""
+        from raft_tpu.ops import autotune
+
+        monkeypatch.setenv("RAFT_TPU_AUTOTUNE_CACHE", "")
+        ix = cagra.Index(index.dataset, index.graph, index.metric,
+                         index.seed_nodes)
+        sp = dataclasses.replace(SP, max_iterations=2)
+        winner, timings = cagra.tune_search(ix, queries, K, sp, reps=2)
+        assert set(timings) == set(cagra.ENGINES)
+        assert winner in cagra.ENGINES
+        store = getattr(ix, "_edge_store", None)
+        assert (store is not None) == (winner in ("edge", "fused"))
+        key = cagra._tune_key(ix, M, K, sp,
+                              store if store is not None
+                              else (("int8",),))
+        assert autotune.lookup(key) == winner
+        autotune.forget(key)
+
+
+class TestFusedGuarded:
+    @pytest.mark.faults
+    def test_fallback_bit_identical_per_call(self, index, queries):
+        """An injected kernel_compile at the fused site serves THIS call
+        through the edge chain bit-identically and moves no breaker."""
+        de, ie = cagra.search(index, queries, K, SP, engine="edge")
+        with faults.inject("kernel_compile", "cagra.fused_search"):
+            df, if_ = cagra.search(index, queries, K, SP, engine="fused")
+        np.testing.assert_array_equal(np.asarray(if_), np.asarray(ie))
+        np.testing.assert_array_equal(np.asarray(df), np.asarray(de))
+        assert "cagra.fused_search" not in guarded.demoted_sites()
+
+    @pytest.mark.faults
+    def test_kernel_fault_opens_injected_breaker_serves_identical(
+            self, index, queries):
+        """kernel_fault drives the breaker (the persistent-failure
+        drill): the faulted calls serve the edge results bit-identically
+        and the open is flagged injected — never persisted, so it cannot
+        outlive the armed fault (no sticky demotion)."""
+        guarded.reset()
+        de, ie = cagra.search(index, queries, K, SP, engine="edge")
+        try:
+            with faults.inject("kernel_fault", "cagra.fused_search"):
+                df, if_ = cagra.search(index, queries, K, SP,
+                                       engine="fused")
+            np.testing.assert_array_equal(np.asarray(if_), np.asarray(ie))
+            np.testing.assert_array_equal(np.asarray(df), np.asarray(de))
+            snap = guarded.breaker_snapshot()["cagra.fused_search"]
+            assert snap["state"] == "open"
+            assert snap["injected"] is True
+        finally:
+            guarded.reset()
+
+
+class TestServingClosure:
+    def test_donated_closure_matches_plain(self, index, queries):
+        """make_searcher(donate=True) serves identical results through
+        its per-k donated jit cache (CPU ignores the donation itself —
+        the contract under test is correctness + one cached executable
+        per k, so serving buckets never retrace)."""
+        plain = cagra.make_searcher(index, SP, donate=False,
+                                    engine="gather")
+        donated = cagra.make_searcher(index, SP, donate=True,
+                                      engine="gather")
+        dp, ip = plain(queries, K)
+        dd, id_ = donated(queries, K)
+        np.testing.assert_array_equal(np.asarray(id_), np.asarray(ip))
+        np.testing.assert_array_equal(np.asarray(dd), np.asarray(dp))
+        dd2, id2 = donated(queries, K)       # second call: cached jit
+        np.testing.assert_array_equal(np.asarray(id2), np.asarray(ip))
+
+
+class TestOneDispatch:
+    def test_fused_program_has_no_hop_loop(self, index, queries):
+        """The acceptance property, structurally: the fused search's
+        jaxpr contains ZERO device-side while loops (each iteration of
+        one is a separate kernel launch on device) and the megakernel
+        launch site; the edge engine's program keeps its hop loop."""
+        stats = cagra_fused.one_dispatch_stats(
+            lambda q, ix: cagra.search(ix, q, K, SP, engine="fused"),
+            jnp.asarray(queries), index)
+        assert stats["one_dispatch"], stats
+        assert stats["while_loops"] == 0
+        assert stats["pallas_calls"] >= 1
+        edge = cagra_fused.one_dispatch_stats(
+            lambda q, ix: cagra.search(ix, q, K, SP, engine="edge"),
+            jnp.asarray(queries), index)
+        assert edge["while_loops"] >= 1
+        assert not edge["one_dispatch"]

@@ -687,3 +687,32 @@ class TestOpsSurface:
         """The deprecation-pointer satellite stays put."""
         assert "MutableIndex" in ivf_flat.extend.__doc__
         assert "MutableIndex" in ivf_pq.extend.__doc__
+
+
+# ---------------------------------------------------------------------------
+class TestHotPathSync:
+    def test_search_dispatch_does_not_synchronize(self, tmp_path, rng,
+                                                  monkeypatch):
+        """ISSUE 12 hot-path sync audit: a mutable-tier search dispatch
+        (sealed + delta fan-out + merge) must not call
+        ``block_until_ready`` — results stay asynchronous until the
+        caller materializes them; the only serve-path syncs are the
+        SAMPLED probes (batcher device stage, merge pre-warm)."""
+        import jax
+
+        X = _corpus(rng, 96, 8)
+        m = mutable.create(tmp_path / "nosync-idx", X)
+        m.upsert(None, _corpus(rng, 5, 8))      # populate the delta tier
+        q = X[:4]
+        m.search(q, 4)                          # warm executables first
+        syncs = []
+        orig = jax.block_until_ready
+
+        def spy(x):
+            syncs.append(x)
+            return orig(x)
+
+        monkeypatch.setattr(jax, "block_until_ready", spy)
+        d, i = m.search(q, 4)
+        assert not syncs, "mutable search synchronized on the hot path"
+        assert np.asarray(i).shape == (4, 4)    # results still land

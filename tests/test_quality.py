@@ -435,7 +435,8 @@ class TestGuardedDriftGuard:
     # the sites the current tree must keep gated; the sweep below also
     # catches NEW guarded_call sites automatically
     KNOWN = {"select_k.kpass", "ivf_flat.scan", "ivf_pq.scan",
-             "brute_force.fused", "cagra.graph_expand", "cagra.nn_descent",
+             "brute_force.fused", "cagra.graph_expand",
+             "cagra.fused_search", "cagra.nn_descent",
              "sharded.ring_topk", "mutable.merge"}
 
     def _discover_sites(self):
@@ -446,9 +447,11 @@ class TestGuardedDriftGuard:
         for p in root.rglob("*.py"):
             src = p.read_text()
             sites |= set(re.findall(r'guarded_call\(\s*\n?\s*"([^"]+)"', src))
-            # constants passed as the site argument (the sharded merge)
-            sites |= set(re.findall(r'^MERGE_SITE\s*=\s*"([^"]+)"', src,
-                                    re.MULTILINE))
+            # constants passed as the site argument (the sharded merge's
+            # MERGE_SITE, the fused traversal's FUSED_SITE, ...)
+            sites |= set(re.findall(
+                r'^(?:MERGE|FUSED)_SITE\s*=\s*"([^"]+)"', src,
+                re.MULTILINE))
         return sites
 
     def test_every_site_has_breaker_policy(self):
@@ -634,3 +637,115 @@ class TestAcceptanceDrill:
             b.close()
             sentinel.close()
             quality.unwatch_index("drill_cagra")
+
+
+class TestEngineLadderDrift:
+    """ISSUE 12 engine drift guard: every traversal engine registered on
+    a family (cagra.ENGINES) must (a) be in the family's DEFAULT
+    tune_search race and (b) be pre-compilable through serve/warmup.py's
+    ladder sweep — a new engine without a measured race lane or a
+    warmup path would be an unraceable, first-request-compiled static.
+    The source sweep keeps the registry itself honest: every concrete
+    ``engine ==``/``_go("...")`` static in cagra must be a registered
+    member."""
+
+    def test_engine_statics_are_registered(self):
+        import raft_tpu
+        from raft_tpu.neighbors import cagra
+
+        src = (pathlib.Path(raft_tpu.__file__).parent / "neighbors"
+               / "cagra.py").read_text()
+        # the traversal dispatch statics: search()'s _go("<engine>")
+        # branches plus every comparison against the resolved `eng`
+        # (build_knn_graph's brute-pass engines are a different knob)
+        statics = set(re.findall(r'_go\("(\w+)"\)', src))
+        eng_cmp = set(re.findall(r'\beng\s*==\s*"(\w+)"', src))
+        eng_cmp |= {m for grp in re.findall(r'\beng\s+in\s+\(([^)]*)\)',
+                                            src)
+                    for m in re.findall(r'"(\w+)"', grp)}
+        assert statics == set(cagra.ENGINES), (
+            f"dispatch statics {sorted(statics)} drifted from "
+            f"cagra.ENGINES {sorted(cagra.ENGINES)} — register the "
+            "engine (race + warmup coverage) or remove the static")
+        assert eng_cmp - {"auto"} <= set(cagra.ENGINES), (
+            f"unregistered engine comparisons: "
+            f"{sorted(eng_cmp - {'auto'} - set(cagra.ENGINES))}")
+
+    def test_default_race_covers_every_engine(self, tmp_path, rng,
+                                              monkeypatch):
+        """tune_search's DEFAULT candidate set == cagra.ENGINES (the
+        race is captured, not run — the real three-way race is
+        test_cagra_fused.py's slow lane)."""
+        from raft_tpu.neighbors import cagra
+        from raft_tpu.ops import autotune
+
+        seen = {}
+
+        def fake_tune_best(key, cands, *a, **kw):
+            seen["cands"] = set(cands)
+            return "gather", {c: 0.0 for c in cands}
+
+        monkeypatch.setattr(autotune, "tune_best", fake_tune_best)
+        data = rng.normal(size=(256, 8)).astype(np.float32)
+        from raft_tpu.neighbors import cagra as _cg
+        ix = _cg.build(data, _cg.IndexParams(
+            intermediate_graph_degree=12, graph_degree=8, seed=0))
+        _cg.tune_search(ix, data[:8], 4, _cg.SearchParams(
+            itopk_size=16, search_width=1, max_iterations=1))
+        assert seen["cands"] == set(cagra.ENGINES)
+
+    def test_warmup_sweeps_engine_ladder(self, reg_or_none=None):
+        """serve/warmup.py warms an ``engines`` mapping across the FULL
+        ladder (shape count = engines × ladder shapes), labeling each
+        engine's compiles — the plumbing that pre-compiles the fused
+        engine at serving buckets instead of on the first request. The
+        real cagra-closure zero-recompile assertion rides the slow lane
+        below."""
+        from raft_tpu.neighbors import cagra
+        from raft_tpu.serve import warmup as warmup_mod
+
+        calls = []
+
+        def mk(tag):
+            def fn(q, k):
+                calls.append((tag, q.shape[0], k))
+                return (np.zeros((q.shape[0], k), np.float32),
+                        np.zeros((q.shape[0], k), np.int32))
+            return fn
+
+        ladder = BucketLadder((4, 8), (4,))
+        reg = metrics.Registry()
+        warmup_mod.warmup(None, ladder, 8, registry=reg, name="drift",
+                          engines={e: mk(e) for e in cagra.ENGINES})
+        want = {(e, mb, 4) for e in cagra.ENGINES for mb in (4, 8)}
+        assert set(calls) == want
+        assert reg.gauge("drift.warmup.shapes").value == len(want)
+
+    @pytest.mark.slow
+    def test_every_engine_precompiled_at_serving_buckets(self, rng):
+        """Functional form: after an engines-ladder warmup of REAL cagra
+        closures, a request on ANY engine at a ladder shape triggers
+        zero XLA compilations — the fused megakernel included."""
+        from raft_tpu.neighbors import cagra
+        from raft_tpu.serve import warmup as warmup_mod
+        from raft_tpu.serve.warmup import count_compilations
+
+        data = rng.normal(size=(512, 8)).astype(np.float32)
+        ix = cagra.build(data, cagra.IndexParams(
+            intermediate_graph_degree=12, graph_degree=8, seed=0))
+        sp = cagra.SearchParams(itopk_size=16, search_width=1,
+                                max_iterations=2, candidate_dtype="int8")
+        fns = {e: cagra.make_searcher(ix, sp, engine=e)
+               for e in cagra.ENGINES}
+        ladder = BucketLadder((8,), (4,))
+        warmup_mod.warmup(None, ladder, 8, registry=metrics.Registry(),
+                          name="drift2", engines=fns)
+        q = np.zeros((8, 8), np.float32)
+        with count_compilations() as cc:
+            for fn in fns.values():
+                out = fn(q, 4)
+                import jax as _jax
+                _jax.block_until_ready(out)
+        assert cc.count == 0, (
+            f"{cc.count} first-request compiles after the engine-ladder "
+            "warmup")

@@ -220,6 +220,51 @@ class TestBatcher:
             with pytest.raises(Exception):
                 b.submit(q[:2, :8], 5)  # wrong query width
 
+    def test_double_buffer_demux_overlaps_next_dispatch(self, reg):
+        """ISSUE 12 double buffering: with a backlog, batch N+1 must be
+        DISPATCHED before batch N is demuxed (device computes N+1 while
+        the host demuxes N), and an emptied queue demuxes immediately.
+        Instrumented at the two host boundaries: the search call
+        (dispatch) and the ``np.asarray`` device→host conversion
+        (demux)."""
+        log = []
+
+        class _Arr:
+            def __init__(self, tag, val):
+                self.tag, self.val = tag, val
+
+            def __array__(self, dtype=None):
+                log.append(("demux", self.tag))
+                return np.asarray(self.val, dtype)
+
+        calls = [0]
+
+        def fn(q, k, res=None):
+            tag = calls[0]
+            calls[0] += 1
+            log.append(("dispatch", tag))
+            m = q.shape[0]
+            return (_Arr(tag, np.zeros((m, k), np.float32)),
+                    _Arr(tag, np.zeros((m, k), np.int32)))
+
+        b = MicroBatcher(fn, 4, ladder=BucketLadder((1,), (4,)),
+                         registry=reg, autostart=False, max_wait_s=0.0,
+                         max_batch_requests=1, trace_sample=0)
+        try:
+            rs = [b.submit(np.zeros((1, 4), np.float32), 4)
+                  for _ in range(3)]
+            b.start()           # worker sees a 3-deep backlog
+            for r in rs:
+                r.result(30)
+        finally:
+            b.close()
+        assert calls[0] == 3
+        # demux(N) strictly after dispatch(N+1) while the backlog lasts
+        assert log.index(("dispatch", 1)) < log.index(("demux", 0)), log
+        assert log.index(("dispatch", 2)) < log.index(("demux", 1)), log
+        # the final batch (queue drained) is demuxed without waiting
+        assert ("demux", 2) in log
+
     def test_codeadline_collateral_is_redispatched(self, reg):
         """A request with no deadline co-batched behind a tighter
         deadline must be re-dispatched when that deadline fires, never
