@@ -356,6 +356,172 @@ class TwoPart:
         return fresh
 
 
+def store_bytes_of(indexes) -> dict:
+    """{store_bytes, bytes_per_vector} for an index or list of part
+    indexes, via the memz decomposition (serve/quality.device_bytes) —
+    the storage-ladder evidence block recorded on cagra/ivf entries
+    (ISSUE 13). Host-streamed indexes divide by ALL answered rows (cold
+    included), so the number IS the rung's capacity claim."""
+    from raft_tpu.serve import quality as _q
+
+    idxs = indexes if isinstance(indexes, (list, tuple)) else [indexes]
+    reps = [_q.device_bytes(ix) for ix in idxs]
+    total = sum(r["total_device_bytes"] for r in reps)
+    rows = sum(int(r.get("n_total") or r["n"]) for r in reps)
+    return {"store_bytes": total,
+            "bytes_per_vector": round(total / max(rows, 1), 2)}
+
+
+def run_storage_ladder(lad_n: int, d: int, nq: int = 1000, k: int = 10,
+                       out_json: str = None, graph_degree: int = 32,
+                       hbm_budget_frac: float = 0.5) -> list:
+    """Storage-ladder capacity rung (ROADMAP "Scale ladder, rung 1"):
+    one corpus at ``lad_n`` rows, every cagra edge-store rung
+    (int8 → int4 → pq) measured at fixed k with the exact-refine
+    recipe, then the ivf_flat HBM-resident vs host-streamed
+    decomposition under an HBM budget of ``hbm_budget_frac`` of the
+    resident store. Each entry records ``store_bytes``,
+    ``bytes_per_vector`` and the ratio vs the int8 rung — the
+    ladder's capacity claims as bench artifacts, not README math.
+
+    Standalone so the 10M TPU run and the CPU-gated proxy
+    (``RAFT_TPU_BENCH_LADDER_N``) share one code path; ``main()`` wires
+    it behind RAFT_TPU_BENCH_LADDER."""
+    from raft_tpu.neighbors import (brute_force, cagra, ivf_flat,
+                                    refine as refine_mod)
+
+    entries = []
+    t0 = time.perf_counter()
+    data, queries = make_corpus(lad_n, d, nq, seed=21)
+    qj = jnp.asarray(queries)
+    # exact GT through the parted brute path (compile-cap safe at 10M)
+    gt = jnp.asarray(np.argsort(
+        (queries**2).sum(1)[:, None] - 2.0 * queries @ data[:100_000].T
+        + (data[:100_000]**2).sum(1)[None, :],
+        axis=1)[:, :k]) if lad_n <= 100_000 else None
+    if gt is None:
+        part_cap = 500_000
+        parts = [data[i:i + part_cap] for i in range(0, lad_n, part_cap)]
+        bfs = [brute_force.build(p) for p in parts]
+        fn = jax.jit(lambda q, ix: brute_force.search(ix, q, k,
+                                                      algo="matmul"))
+        tp = TwoPart(fn, bfs,
+                     [i * part_cap for i in range(len(parts))], k)
+        gt = robust_call(lambda: tp(qj)[1], "ladder gt")
+        del bfs
+    log(f"# ladder corpus {lad_n}x{d} + gt in "
+        f"{time.perf_counter() - t0:.0f}s")
+
+    t0 = time.perf_counter()
+    ci = robust_call(lambda: cagra.build(data, cagra.IndexParams(
+        graph_degree=graph_degree,
+        intermediate_graph_degree=graph_degree + graph_degree // 2,
+        seed=0)), "ladder cagra build", tries=1)
+    build_s = time.perf_counter() - t0
+    log(f"# ladder cagra built in {build_s:.0f}s")
+    dj = jnp.asarray(data)
+    itopk = max(64, 4 * k)
+    sp = cagra.SearchParams(itopk_size=itopk, search_width=2,
+                            max_iterations=10)
+
+    def refined(qs):
+        _, cand = cagra.search(ci, qs, itopk, sp, engine="edge")
+        return refine_mod.refine(dj, qs, cand, k)
+
+    rung_bytes = {}
+    for rung in ("int8", "int4", "pq"):
+        ci.__dict__.pop("_edge_store", None)
+        t0 = time.perf_counter()
+        robust_call(lambda r=rung: cagra.prepare_traversal(ci, r),
+                    f"ladder prepare {rung}", tries=1)
+        prep_s = time.perf_counter() - t0
+        sb = store_bytes_of(ci)
+        ev = ci._edge_store[1]
+        rung_bytes[rung] = int(ev.size * ev.dtype.itemsize)
+        thr = median_time(lambda: jax.block_until_ready(
+            refined(qj)), reps=3)
+        rec = robust_call(lambda: device_recall(refined(qj)[1], gt),
+                          f"ladder {rung} recall")
+        e = {"algo": "storage_ladder",
+             "name": f"storage_ladder.cagra.deg{graph_degree}.{rung}",
+             "qps": round(nq / thr, 1) if thr else None,
+             "latency_ms": None,
+             "recall": round(float(rec), 4),
+             "build_s": round(build_s + prep_s, 1),
+             "corpus_n": lad_n, "engine": "edge",
+             "edge_store_bytes": rung_bytes[rung],
+             "edge_bytes_per_vector": round(rung_bytes[rung] / lad_n, 2),
+             **sb}
+        if "int8" in rung_bytes:
+            e["edge_bytes_vs_int8"] = round(
+                rung_bytes["int8"] / max(rung_bytes[rung], 1), 2)
+        entries.append(e)
+        log(f"#   {e['name']}: qps={e['qps']} recall={rec:.4f} "
+            f"edge store {rung_bytes[rung]:,}B "
+            f"({e.get('edge_bytes_vs_int8', 1.0)}x under int8)")
+    ci.__dict__.pop("_edge_store", None)
+
+    # ivf_flat: resident vs host-streamed under an HBM budget
+    n_lists = max(64, min(8192, int(np.sqrt(lad_n) * 3)))
+    fi = robust_call(lambda: ivf_flat.build(
+        data, ivf_flat.IndexParams(n_lists=n_lists, seed=0)),
+        "ladder ivf build", tries=1)
+    ivf_flat.prepare_scan(fi)
+    spf = ivf_flat.SearchParams(n_probes=max(8, n_lists // 50))
+    res_bytes = store_bytes_of(fi)
+    t_res = median_time(lambda: jax.block_until_ready(
+        ivf_flat.search(fi, qj, k, spf, algo="pallas")), reps=3)
+    rec_res = robust_call(lambda: device_recall(
+        ivf_flat.search(fi, qj, k, spf, algo="pallas")[1], gt),
+        "ladder ivf resident recall")
+    # budget against the RAW list rows (what the planner admits), not
+    # the memz total (which counts scan caches the tier doesn't move)
+    budget_gb = lad_n * (d * 4 + 8) * hbm_budget_frac / (1 << 30)
+    ivf_flat.prepare_host_stream(fi, budget_gb=budget_gb,
+                                 sample_queries=queries[:256])
+    tier = getattr(fi, "_host_tier", None)
+    t_hs = median_time(lambda: jax.block_until_ready(
+        ivf_flat.search(fi, qj, k, spf, algo="pallas")), reps=3)
+    rec_hs = robust_call(lambda: device_recall(
+        ivf_flat.search(fi, qj, k, spf, algo="pallas")[1], gt),
+        "ladder ivf streamed recall")
+    hs_bytes = store_bytes_of(fi)
+    entries.append({
+        "algo": "storage_ladder",
+        "name": f"storage_ladder.ivf_flat.nlist{n_lists}.host_stream",
+        "qps": round(nq / t_hs, 1) if t_hs else None, "latency_ms": None,
+        "recall": round(float(rec_hs), 4), "build_s": 0.0,
+        "corpus_n": lad_n, "hbm_budget_gb": round(budget_gb, 3),
+        # the HBM-resident vs host-streamed decomposition the ROADMAP
+        # bench gate asks for: where the bytes sit, what PCIe moved,
+        # and what the split cost at fixed probes
+        "decomposition": {
+            "resident_qps": round(nq / t_res, 1) if t_res else None,
+            "resident_recall": round(float(rec_res), 4),
+            "resident_store_bytes": res_bytes["store_bytes"],
+            "streamed_device_bytes": hs_bytes["store_bytes"],
+            "host_tier": tier.snapshot() if tier is not None else None,
+        },
+        **hs_bytes})
+    log(f"#   host_stream: resident {res_bytes['store_bytes']:,}B -> "
+        f"device {hs_bytes['store_bytes']:,}B + host tier; streamed "
+        f"recall {rec_hs:.4f} (resident {rec_res:.4f}) at "
+        f"{budget_gb:.3f} GB budget")
+
+    if out_json:
+        payload = {"schema": "raft_tpu_bench_v1", "lane": "storage_ladder",
+                   "n": lad_n, "d": d, "entries": entries}
+        os.makedirs(os.path.dirname(out_json) or ".", exist_ok=True)
+        tmp = out_json + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, out_json)
+        log(f"# ladder artifact -> {out_json}")
+    return entries
+
+
 def main():
     t_wall0 = time.perf_counter()
     budget_s = float(os.environ.get("RAFT_TPU_BENCH_BUDGET_S", "2400"))
@@ -638,7 +804,8 @@ def main():
                               "ivf_flat recall")
             add_entry("raft_ivf_flat",
                       f"raft_ivf_flat.nlist1024.nprobe{probes}",
-                      thr, lat, rec, flat_build)
+                      thr, lat, rec, flat_build,
+                      extra=store_bytes_of(fis))
             if rec >= 0.95 and (flat_best is None
                                 or nq / thr > flat_best[0]):
                 # FULL entry name: the headline-first sort matches on it
@@ -691,7 +858,8 @@ def main():
                 add_entry("raft_ivf_flat",
                           f"raft_ivf_flat.nlist1024.nprobe{best_probes}"
                           ".bf16",
-                          thr, lat, rec, bf16_build)
+                          thr, lat, rec, bf16_build,
+                          extra=store_bytes_of(fihs))
                 if rec >= 0.95 and nq / thr > (flat_best or (0,))[0]:
                     flat_best = (nq / thr, rec,
                                  f"raft_ivf_flat.nlist1024"
@@ -991,7 +1159,8 @@ def main():
             add_entry("raft_ivf_pq",
                       f"raft_ivf_pq.nlist1024.pq{min(d, 128)}x4.int8"
                       f".nprobe{probes}.refine{ratio}",
-                      thr, lat, rec, pq_build)
+                      thr, lat, rec, pq_build,
+                      extra=store_bytes_of(pis))
             return rec
 
         rec_a = measure_pq(20, 2)
@@ -1189,7 +1358,8 @@ def main():
             rec = robust_call(lambda: device_recall(fn(queries, ci)[1], cgt),
                               "cagra recall")
             extra = {"corpus_n": cagra_n, "engine": eng_winner,
-                     "build_decomposition": build_decomp}
+                     "build_decomposition": build_decomp,
+                     **store_bytes_of(ci)}
             if (itopk, width, mi) == opener:
                 extra["decomposition"] = cagra_decomp
             add_entry("raft_cagra",
@@ -1370,10 +1540,30 @@ def main():
                       thr, lat, rec, build_1m,
                       {"corpus_n": n, "reduced_sweep": True,
                        "engine": eng_1m,
-                       "build_decomposition": decomp_1m},
+                       "build_decomposition": decomp_1m,
+                       **store_bytes_of(ci1m)},
                       baseline_key=None)
             if rec >= 0.95:
                 break
+
+    # --- storage ladder capacity rung (ISSUE 13 / ROADMAP rung 1) -------
+    # Edge-store rungs int8 -> int4 -> pq at fixed k with exact refine,
+    # plus the ivf_flat HBM-resident vs host-streamed decomposition, at
+    # n=10M (RAFT_TPU_BENCH_LADDER_N overrides — the CPU-gated proxy).
+    # RAFT_TPU_BENCH_LADDER=1 forces / =0 skips.
+    with algo_section('storage_ladder'):
+        remaining = budget_s - (time.perf_counter() - t_start)
+        from raft_tpu.core.errors import expects as _expects
+        force_lad = os.environ.get("RAFT_TPU_BENCH_LADDER")
+        _expects(force_lad != "0" and (
+            force_lad == "1" or (jax.default_backend() == "tpu"
+                                 and not hurry and remaining > 2400)),
+            "storage ladder skip: forced=%s %.0fs left < 2400s "
+            "(set RAFT_TPU_BENCH_LADDER=1 to force)", force_lad,
+            remaining)
+        lad_n = int(os.environ.get("RAFT_TPU_BENCH_LADDER_N",
+                                   str(10_000_000)))
+        entries.extend(run_storage_ladder(lad_n, d, nq=1000, k=k))
 
     # --- graph-build race: fused exact all-pairs vs NN-descent ----------
     # The two CAGRA graph builders at one shape (100k×128 at k=96, the

@@ -49,16 +49,20 @@ class Index:
 
     ``dataset`` may be stored low-precision (the per-dtype dataset modes of
     detail/ivf_flat_interleaved_scan-inl.cuh:99-584 applied to brute
-    force): bf16 halves and int8 quarters the HBM scan traffic. ``scales``
-    holds per-row dequant factors for int8 (row ≈ scale * int8_vec);
+    force): bf16 halves, int8 quarters and int4 (nibble-packed, see
+    ops/quant.py) eighths the HBM scan traffic. ``scales`` holds per-row
+    dequant factors for int8/int4 (row ≈ scale * quantized_vec);
     ``norms`` are always exact f32 norms of the *stored* representation.
+    ``logical_dim`` is set ONLY for int4 stores, whose packed byte width
+    is not the row width.
     """
 
     dataset: jax.Array          # (n, d) f32 | bf16 | int8 | uint8
     norms: Optional[jax.Array]  # (n,) squared L2 norms, for expanded metrics
     metric: DistanceType
     metric_arg: float = 2.0
-    scales: Optional[jax.Array] = None   # (n,) f32, int8 mode only
+    scales: Optional[jax.Array] = None   # (n,) f32, int8/int4 modes only
+    logical_dim: Optional[int] = None    # int4 mode: the unpacked row width
 
     @property
     def size(self) -> int:
@@ -66,11 +70,20 @@ class Index:
 
     @property
     def dim(self) -> int:
-        return self.dataset.shape[1]
+        return (self.logical_dim if self.logical_dim is not None
+                else self.dataset.shape[1])
 
     @property
     def store_dtype(self):
         return self.dataset.dtype
+
+    @property
+    def store_name(self) -> str:
+        """Storage-rung tag ("float32" | "bfloat16" | "int8" | "uint8" |
+        "int4") — what autotune keys and health reports should use; the
+        physical ``store_dtype`` of an int4 store is int8."""
+        return ("int4" if self.logical_dim is not None
+                else str(jnp.dtype(self.dataset.dtype)))
 
     def tree_flatten(self):
         # the fused engine's tile-aligned corpus cache (prepare_fused)
@@ -81,49 +94,24 @@ class Index:
         fp = getattr(self, "_fused_pad", None)
         pad_leaves = tuple(fp[1:]) if fp is not None else (None,) * 4
         return ((self.dataset, self.norms, self.scales) + pad_leaves,
-                (self.metric, self.metric_arg,
+                (self.metric, self.metric_arg, self.logical_dim,
                  fp[0] if fp is not None else None))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        out = cls(children[0], children[1], aux[0], aux[1], children[2])
-        if len(aux) > 2 and aux[2] is not None:
-            out._fused_pad = (aux[2],) + tuple(children[3:])
+        out = cls(children[0], children[1], aux[0], aux[1], children[2],
+                  aux[2])
+        if len(aux) > 3 and aux[3] is not None:
+            out._fused_pad = (aux[3],) + tuple(children[3:])
         return out
 
 
-def quantize_rows(dataset: jax.Array, dtype) -> Tuple[jax.Array, Optional[jax.Array]]:
-    """f32 rows → (stored rows, per-row scales|None) for a storage dtype."""
-    dtype = jnp.dtype(dtype)
-    if dtype == jnp.float32:
-        return dataset, None
-    if dtype == jnp.bfloat16:
-        return dataset.astype(jnp.bfloat16), None
-    if dtype == jnp.uint8:
-        # byte corpora (SIFT/DEEP): exact for integral [0, 255] inputs,
-        # no scales (the reference's native uint8 dataset mode)
-        q = jnp.clip(jnp.round(dataset), 0, 255)
-        if not in_jax_trace():
-            # silent clamping of float data would collapse recall with no
-            # error; scaled float data belongs in int8 mode
-            expects(bool(jnp.all(jnp.abs(dataset - q) < 1e-3)),
-                    "uint8 storage expects byte-valued data (integral in "
-                    "[0, 255]); use dtype='int8' for scaled float data")
-        return q.astype(jnp.uint8), None
-    expects(dtype == jnp.int8,
-            "store dtype must be f32/bf16/int8/uint8, got %s", dtype)
-    amax = jnp.max(jnp.abs(dataset), axis=1)
-    scale = jnp.maximum(amax, 1e-30) / 127.0
-    q = jnp.clip(jnp.round(dataset / scale[:, None]), -127, 127)
-    return q.astype(jnp.int8), scale
-
-
-def dequantize_rows(rows: jax.Array, scales: Optional[jax.Array]) -> jax.Array:
-    """Stored rows (any dtype) → f32, applying int8 per-row scales."""
-    out = rows.astype(jnp.float32)
-    if scales is not None:
-        out = out * scales[..., None]
-    return out
+# the per-row storage coding lives in ops/quant.py (the ladder's shared
+# home — cagra/ivf_flat/mutable import these THROUGH this module, so the
+# historical names keep working); semantics are byte-identical to the
+# former local definitions
+from ..ops.quant import (dequantize_rows, int8_scale_report,  # noqa: E402
+                         quantize_rows)
 
 
 @tracing.annotate("raft_tpu::brute_force::build")
@@ -133,20 +121,32 @@ def build(dataset: jax.Array, metric="sqeuclidean", metric_arg: float = 2.0,
 
     ``dtype``: storage dtype — float32 (exact), bfloat16 (half the HBM
     scan traffic, ~1e-3 relative distance error), int8 (quarter
-    traffic, per-row symmetric quantization; the ANN-candidate mode) or
+    traffic, per-row symmetric quantization; the ANN-candidate mode),
     uint8 (quarter traffic, exact — byte-valued corpora like SIFT/DEEP
-    only; scaled float data belongs in int8).
+    only; scaled float data belongs in int8) or ``"int4"`` (eighth
+    traffic: nibble-packed rows, per-row scales, in-kernel unpack on
+    the fused engine — expanded metrics only; pair with
+    ``refine.refine`` for exact final distances).
     """
     dataset = jnp.asarray(dataset, jnp.float32)
     expects(dataset.ndim == 2, "dataset must be (n, d)")
     mt = canonical_metric(metric)
+    int4 = isinstance(dtype, str) and dtype in ("int4", "i4")
+    if int4:
+        expects(mt in _PALLAS_METRICS,
+                "int4 storage supports L2/cosine/IP metrics, got %s",
+                mt.name)
     stored, scales = quantize_rows(dataset, dtype)
     norms = None
     if mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
               DistanceType.CosineExpanded):
-        deq = dequantize_rows(stored, scales)
+        from ..ops.quant import dequantize_int4
+
+        deq = (dequantize_int4(stored, scales, dataset.shape[1]) if int4
+               else dequantize_rows(stored, scales))
         norms = jnp.sum(deq * deq, axis=1)
-    return Index(stored, norms, mt, metric_arg, scales)
+    return Index(stored, norms, mt, metric_arg, scales,
+                 dataset.shape[1] if int4 else None)
 
 
 def health_sample_rows(n: int, sample: int):
@@ -177,35 +177,26 @@ def quantization_error(original, dequantized) -> dict:
             "max_abs_err": round(float(np.abs(err).max()), 6)}
 
 
-def int8_scale_report(scales) -> dict:
-    """Sampled per-row int8 scale stats for a health report: the f32
-    originals are not retained by int8 stores, so the report carries the
-    quantization *step bound* ``max_scale/2`` per component rather than
-    a measured reconstruction error. Shared by every family with an
-    int8 storage mode (brute_force, ivf_flat)."""
-    import numpy as np
-
-    sc = np.asarray(scales, np.float64)
-    return {"int8": {
-        "mean_scale": round(float(sc.mean()), 6),
-        "max_scale": round(float(sc.max()), 6),
-        "max_abs_err_bound": round(float(sc.max()) / 2.0, 6)}}
-
-
 def health(index: Index, sample: int = 256) -> dict:
     """Index health report (docs/observability.md "Quality"): geometry,
-    storage width, and — for int8 stores — sampled per-row scale stats
-    (see :func:`int8_scale_report`)."""
+    storage width, and — for int8/int4 stores — sampled per-row scale
+    stats (see :func:`int8_scale_report`)."""
     import numpy as np
 
     report = {
         "family": "brute_force", "n": int(index.size),
         "dim": int(index.dim), "metric": index.metric.name,
-        "store_dtype": str(jnp.dtype(index.store_dtype)),
+        "store_dtype": index.store_name,
         "fused_cache": getattr(index, "_fused_pad", None) is not None,
     }
     dt = jnp.dtype(index.store_dtype)
-    if dt == jnp.int8 and index.scales is not None:
+    if index.logical_dim is not None:
+        rows = health_sample_rows(index.size, sample)
+        if rows.size:
+            # same scale-step summary as int8, under the rung's own key
+            report["quant"] = {
+                "int4": int8_scale_report(index.scales[rows])["int8"]}
+    elif dt == jnp.int8 and index.scales is not None:
         rows = health_sample_rows(index.size, sample)
         if rows.size:
             report["quant"] = int8_scale_report(index.scales[rows])
@@ -384,18 +375,36 @@ def _search_matmul(index: Index, q, k, filter, valid_rows, precision,
     ds = index.dataset
 
     def one(qc):
-        if ds.dtype == jnp.bfloat16:
-            lhs = qc.astype(jnp.bfloat16)
-            rhs = ds
-        elif ds.dtype in (jnp.int8, jnp.uint8):
-            # XLA fuses the convert into the GEMM: byte rows stream from
-            # HBM at 1/4 the f32 traffic; int8 scales fold in after
-            lhs, rhs = qc, ds.astype(jnp.float32)
+        if index.logical_dim is not None:
+            # int4 resident fallback: the same split-half nibble dot the
+            # fused kernel runs (two half-width GEMMs — identical
+            # operand grouping, so values match the kernel's), composed
+            # in XLA
+            from ..ops.quant import int4_nibbles
+
+            half = ds.shape[1]
+            low, high = int4_nibbles(ds.astype(jnp.int32))
+            qp = jnp.pad(qc, ((0, 0), (0, 2 * half - qc.shape[1])))
+            dot = (jax.lax.dot_general(
+                       qp[:, :half], low, (((1,), (1,)), ((), ())),
+                       preferred_element_type=jnp.float32, precision=prec)
+                   + jax.lax.dot_general(
+                       qp[:, half:], high, (((1,), (1,)), ((), ())),
+                       preferred_element_type=jnp.float32, precision=prec))
         else:
-            lhs, rhs = qc, ds
-        dot = jax.lax.dot_general(lhs, rhs, (((1,), (1,)), ((), ())),
-                                  preferred_element_type=jnp.float32,
-                                  precision=prec)
+            if ds.dtype == jnp.bfloat16:
+                lhs = qc.astype(jnp.bfloat16)
+                rhs = ds
+            elif ds.dtype in (jnp.int8, jnp.uint8):
+                # XLA fuses the convert into the GEMM: byte rows stream
+                # from HBM at 1/4 the f32 traffic; int8 scales fold in
+                # after
+                lhs, rhs = qc, ds.astype(jnp.float32)
+            else:
+                lhs, rhs = qc, ds
+            dot = jax.lax.dot_general(lhs, rhs, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32,
+                                      precision=prec)
         if index.scales is not None:     # q·(s·v) = s·(q·v)
             dot = dot * index.scales[None, :]
         if mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
@@ -433,7 +442,7 @@ def _tune_key(index: Index, m: int, k: int) -> str:
 
     return autotune.shape_bucket("bf_search", n=index.size, m=m,
                                  d=index.dim, k=k,
-                                 store=str(index.store_dtype))
+                                 store=index.store_name)
 
 
 def _fused_align_key(index: Index):
@@ -444,6 +453,12 @@ def _fused_align_key(index: Index):
     k: ``_pick_tiles`` varies tm with k, never tn)."""
     from ..ops.fused_knn import _pick_tiles
 
+    if index.logical_dim is not None:
+        # int4: the packed byte width IS the corpus minor dim (already
+        # sublane-pair aligned by quantize_int4); tiles are sized for
+        # the double-half query width the split dot contracts against
+        d_w = index.dataset.shape[1]
+        return _pick_tiles(2 * d_w, 1, 1)[1], d_w
     dtype = index.store_dtype
     itemsize = (jnp.dtype(dtype).itemsize
                 if dtype in (jnp.bfloat16, jnp.int8, jnp.uint8) else 4)
@@ -572,7 +587,8 @@ def _search_pallas(index: Index, q, k, filter, valid_rows, precision):
     def one(qc):
         return fused_knn(qc, ds, k, metric=_PALLAS_METRICS[mt],
                          data_norms=dn, penalty=pen,
-                         precision=precision, scales=sc)
+                         precision=precision, scales=sc,
+                         int4_dim=index.logical_dim)
 
     if m > chunk > 0:
         vals, idxs = _chunked_queries(one, q, chunk, k)
@@ -696,7 +712,7 @@ def search(
         norms = jnp.zeros((n,), jnp.float32)
     norms_p = jnp.pad(norms, (0, n_pad - n))
     n_tiles = n_pad // tile
-    data_t = data.reshape(n_tiles, tile, index.dim)
+    data_t = data.reshape(n_tiles, tile, data.shape[1])
     norms_t = norms_p.reshape(n_tiles, tile)
     scales_t = None
     if index.scales is not None:
@@ -722,7 +738,12 @@ def search(
             tile_data, tile_norm, base, tile_scale = inp
         else:
             tile_data, tile_norm, base = inp
-        tile_data = dequantize_rows(tile_data, tile_scale)
+        if index.logical_dim is not None:
+            from ..ops.quant import dequantize_int4
+
+            tile_data = dequantize_int4(tile_data, tile_scale, index.dim)
+        else:
+            tile_data = dequantize_rows(tile_data, tile_scale)
         d = _tile_distances(q, q_norm, tile_data, tile_norm, mt, index.metric_arg)
         limit = n if valid_rows is None else jnp.minimum(valid_rows, n)
         valid = (base + col) < limit
@@ -783,7 +804,9 @@ def save(index: Index, path) -> None:
     ds = index.dataset
     meta = {"metric": index.metric.value,
             "metric_arg": float(index.metric_arg),
-            "store_dtype": str(ds.dtype)}
+            "store_dtype": index.store_name}
+    if index.logical_dim is not None:
+        meta["logical_dim"] = int(index.logical_dim)
     if ds.dtype == jnp.bfloat16:
         ds = np.asarray(jax.device_get(ds)).view(np.uint16)
     arrays = {"dataset": ds}
@@ -809,6 +832,7 @@ def load(path) -> Index:
         DistanceType(meta["metric"]),
         meta["metric_arg"],
         jnp.asarray(arrays["scales"]) if "scales" in arrays else None,
+        meta.get("logical_dim"),
     )
 
 

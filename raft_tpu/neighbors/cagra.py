@@ -176,12 +176,15 @@ class Index:
         # store (prepare_traversal) rides the same way, its static meta
         # tuple in aux_data so executables re-key on geometry changes
         es = getattr(self, "_edge_store", None)
+        cbs = es[4] if es is not None and len(es) > 4 else None
         leaves = (self.dataset, self.graph, self.seed_nodes,
                   getattr(self, "_score_bf16", None),
                   getattr(self, "_score_i8", None),
                   es[1] if es is not None else None,
                   es[2] if es is not None else None,
-                  es[3] if es is not None else None)
+                  es[3] if es is not None else None,
+                  cbs[0] if cbs is not None else None,
+                  cbs[1] if cbs is not None else None)
         return leaves, (self.metric, es[0] if es is not None else None)
 
     @classmethod
@@ -192,7 +195,9 @@ class Index:
         if leaves[4] is not None:
             out._score_i8 = leaves[4]
         if len(aux) > 1 and aux[1] is not None and leaves[5] is not None:
-            out._edge_store = (aux[1], leaves[5], leaves[6], leaves[7])
+            cbs = (leaves[8], leaves[9]) if leaves[8] is not None else None
+            out._edge_store = (aux[1], leaves[5], leaves[6], leaves[7],
+                               cbs)
         return out
 
 
@@ -907,11 +912,12 @@ def _dup_mask(cand, keep=None):
 
 @partial(jax.jit, static_argnames=("itopk", "width", "max_iter", "k",
                                    "n_seeds", "mt_val", "min_iter",
-                                   "engine", "kprime", "interp"))
+                                   "engine", "kprime", "interp", "smode"))
 def _search_jit(dataset, dataset_score, score_scales, graph, qc, mask_bits,
                 seed_key, seed_rows, edge_vecs, edge_aux, edge_gp, itopk,
                 width, max_iter, k, n_seeds, mt_val, min_iter=0,
-                engine="gather", kprime=0, interp=False):
+                engine="gather", kprime=0, interp=False, edge_cb=None,
+                edge_cbs=None, smode="dense"):
     """``dataset_score`` feeds the seed scoring and (engine="gather") the
     traversal's candidate gathers (bf16 in the default bandwidth-saving
     mode, int8 + per-row ``score_scales`` in the quarter-traffic mode);
@@ -1011,7 +1017,8 @@ def _search_jit(dataset, dataset_score, score_scales, graph, qc, mask_bits,
             pvals, pepos = graph_expand(psafe, qc, edge_vecs, edge_aux,
                                         kprime, metric=metric_s,
                                         degree=degree, pen=edge_pen,
-                                        interpret=interp)
+                                        interpret=interp, mode=smode,
+                                        cbm=edge_cb, cb_scale=edge_cbs)
             nbr = graph[psafe]                               # (m, w, deg)
             cand = jnp.take_along_axis(nbr, jnp.maximum(pepos, 0), axis=2)
             # empty kernel slots (epos -1) must not alias a real node id:
@@ -1056,7 +1063,7 @@ def _search_jit(dataset, dataset_score, score_scales, graph, qc, mask_bits,
             qc, buf_d, buf_i, edge_vecs, edge_aux, edge_gp, edge_pen,
             itopk=itopk, width=width, max_iter=int(max_iter),
             kprime=kprime, degree=degree, metric=metric_s,
-            interpret=interp)
+            interpret=interp, mode=smode)
     else:
         state = (buf_i, buf_d, explored, jnp.int32(0))
         buf_i, buf_d, explored, _ = jax.lax.while_loop(cond, body, state)
@@ -1093,35 +1100,54 @@ def prepare_search(index: Index, candidate_dtype: str = "bfloat16") -> None:
             index._score_i8 = quantize_rows(index.dataset, jnp.int8)
 
 
-def prepare_traversal(index: Index, candidate_dtype: str = "int8") -> None:
+def prepare_traversal(index: Index, candidate_dtype: str = "int8",
+                      pq_dim: int = 0, pq_lut: str = "int8") -> None:
     """Eagerly build the edge-resident candidate store and attach it to
-    the index: for every node, its ``degree`` neighbors' quantized
-    vectors packed into one contiguous ``(n, deg_p, dim_p)`` HBM array
-    (plus a ``(n, 2, deg_p)`` f32 aux of per-edge dequant scales and
-    norms), so the ``engine="edge"`` hop streams one 8 KB tile per
-    expanded parent instead of ``degree`` random 128-256 B lines — the
-    GGNN co-location move (arXiv:1912.01059) in TPU form.
+    the index: for every node, its ``degree`` neighbors' coded vectors
+    packed into one contiguous ``(n, deg_p, W)`` HBM array (plus a
+    ``(n, 2, deg_p)`` f32 aux of per-edge dequant scales and norms), so
+    the ``engine="edge"`` hop streams one contiguous tile per expanded
+    parent instead of ``degree`` random 128-256 B lines — the GGNN
+    co-location move (arXiv:1912.01059) in TPU form.
 
-    OPT-IN, exactly like ``brute_force.prepare_fused``: the store costs
-    ``n·deg_p·dim_p`` bytes at storage width (int8 default — 4.1 GB at
-    500k×deg64×dim128 — bf16 doubles that), so a read-only query never
-    doubles index HBM as a side effect; ``tune_search`` attaches it for
-    the race and drops it again if the gather engine wins. Idempotent on
-    a matching (dtype, degree) geometry — a second call is a no-op, no
-    HBM double-alloc. The store travels through the Index pytree, so
-    jitted functions taking the index as an argument reuse it; it is
-    derived data and is NOT serialized (rebuild after :func:`load`).
-    Never built under a jax trace (cache writes there would store
-    tracers)."""
+    Storage rungs (docs/perf.md "Storage ladder"; ``W`` = minor width at
+    1M·deg64·d128):
+
+    * ``"bfloat16"`` — W=dim_p bf16 (16.8 GB);
+    * ``"int8"`` (default) — W=dim_p int8, per-edge scales (8.4 GB);
+    * ``"int4"`` — W=dim_p/2 nibble-packed int8 (ops/quant.py
+      split-half layout; unpacked in-kernel, 4.2 GB);
+    * ``"pq"`` — W=pq_dim uint8 PQ codes per edge, decoded in-kernel by
+      the ivf_pq one-hot LUT GEMM (~0.5 GB of codes at pq8·book256 —
+      the rung that puts 100M·deg32 within one host's HBM). ``pq_dim``
+      overrides the ``ops.quant.default_pq_dim`` subspace count;
+      ``pq_lut`` picks the decode matrix precision ("int8" = the
+      fp8-LUT role with exact int32 accumulation, or "f32").
+
+    OPT-IN, exactly like ``brute_force.prepare_fused``: a read-only
+    query never doubles index HBM as a side effect; ``tune_search``
+    attaches it for the race and drops it again if the gather engine
+    wins. Idempotent on a matching (dtype, degree) geometry — a second
+    call is a no-op, no HBM double-alloc. The store travels through the
+    Index pytree, so jitted functions taking the index as an argument
+    reuse it; it is derived data and is NOT serialized (rebuild after
+    :func:`load`). Never built under a jax trace (cache writes there
+    would store tracers)."""
     from ..utils import in_jax_trace
 
     if in_jax_trace():
         return
-    expects(candidate_dtype in ("int8", "i8", "bfloat16", "bf16"),
-            "edge store dtype must be int8/bfloat16, got %r",
+    expects(candidate_dtype in ("int8", "i8", "bfloat16", "bf16",
+                                "int4", "i4", "pq"),
+            "edge store dtype must be int8/bfloat16/int4/pq, got %r",
             candidate_dtype)
+    from ..ops import quant
+
     int8 = candidate_dtype in ("int8", "i8")
-    dtype_str = "int8" if int8 else "bfloat16"
+    int4 = candidate_dtype in ("int4", "i4")
+    pq = candidate_dtype == "pq"
+    dtype_str = ("int8" if int8 else "int4" if int4 else
+                 "pq" if pq else "bfloat16")
     degree = index.graph_degree
     deg_p = round_up_to(degree, 32)       # int8 sublane tile (bf16 needs 16)
     dim_p = round_up_to(index.dim, 128)
@@ -1130,17 +1156,39 @@ def prepare_traversal(index: Index, candidate_dtype: str = "int8") -> None:
     if cur is not None and cur[0] == meta:
         return
     g = index.graph
+    cbs = None
     if int8:
-        from .brute_force import quantize_rows
-
         cached = getattr(index, "_score_i8", None)
         if cached is None:
-            cached = quantize_rows(index.dataset, jnp.int8)
+            cached = quant.quantize_rows(index.dataset, jnp.int8)
             index._score_i8 = cached   # int8 candidate_dtype searches reuse it
         stored, scales = cached
         en = (scales * scales) * jnp.sum(
             jnp.square(stored.astype(jnp.float32)), axis=1)
         es = scales[g]
+    elif int4:
+        stored, scales = quant.quantize_int4(index.dataset)
+        low, high = quant.int4_nibbles(stored.astype(jnp.int32))
+        en = (scales * scales) * jnp.sum(low * low + high * high, axis=1)
+        es = scales[g]
+    elif pq:
+        # PQ row codes + the subspace-major decode table the expand
+        # kernel consumes (ops/quant.pq_decode_table; int8 mode applies
+        # the same per-subspace symmetric quantization as the ivf_pq
+        # scan's fp8-LUT role)
+        pqd = pq_dim or quant.default_pq_dim(index.dim)
+        expects(dim_p % pqd == 0,
+                "pq_dim %d must divide the padded dim %d", pqd, dim_p)
+        cb = quant.train_pq_rows(index.dataset, pqd)
+        stored = quant.encode_pq_rows(index.dataset, cb)   # (n, pqd) u8
+        en = quant.pq_decoded_norms(stored, cb)
+        es = jnp.ones(g.shape, jnp.float32)    # decode carries magnitude
+        tbl = quant.pq_decode_table(cb)        # (pqd*book, dim_p) f32
+        if pq_lut == "int8":
+            cb_mat, cb_scale = quant.pq_int8_cb(tbl, pqd, cb.shape[1])
+        else:
+            cb_mat, cb_scale = tbl, jnp.ones((1, dim_p), jnp.float32)
+        cbs = (cb_mat, cb_scale)
     else:
         stored = getattr(index, "_score_bf16", None)
         if stored is None:
@@ -1148,7 +1196,8 @@ def prepare_traversal(index: Index, candidate_dtype: str = "int8") -> None:
             index._score_bf16 = stored
         en = jnp.sum(jnp.square(stored.astype(jnp.float32)), axis=1)
         es = jnp.ones(g.shape, jnp.float32)
-    pad_d, pad_f = deg_p - degree, dim_p - index.dim
+    pad_d = deg_p - degree
+    pad_f = 0 if (int4 or pq) else dim_p - index.dim
     if pad_d or pad_f:
         # gather + pad under one jit write a single padded output buffer;
         # eagerly, stored[g] then jnp.pad holds TWO copies of the store
@@ -1165,7 +1214,16 @@ def prepare_traversal(index: Index, candidate_dtype: str = "int8") -> None:
     # DMAs each parent's id row next to its edge tile (pad edges are
     # masked in-kernel by `col < degree`, so the pad id value is inert)
     gp = jnp.pad(g, ((0, 0), (0, pad_d))) if pad_d else g
-    index._edge_store = (meta, ev, aux, gp)
+    index._edge_store = (meta, ev, aux, gp, cbs)
+
+
+def _store_mode(store) -> str:
+    """Edge-store meta → the expand kernels' storage mode ("dense" for
+    int8/bf16 rows, "int4"/"pq" for the packed rungs)."""
+    if store is None:
+        return "dense"
+    tag = store[0][0]
+    return tag if tag in ("int4", "pq") else "dense"
 
 
 def _plan_dims(p: "SearchParams", k: int):
@@ -1232,11 +1290,15 @@ def tune_search(index: Index, queries, k: int,
     itopk, width, max_iter = _plan_dims(p, k)
     ev = index._edge_store[1]
     # engines=None races the full registry (the drift guard holds the
-    # default to ENGINES); an explicit subset is a caller's cost choice
+    # default to ENGINES); an explicit subset is a caller's cost choice.
+    # The megakernel sits the race out for PQ stores (no in-kernel PQ
+    # decode — those shapes serve the per-hop edge engine) and for
+    # over-VMEM working sets.
     cands = {e: _engine(e) for e in (engines or ENGINES)
-             if e != "fused" or fused_capable(
-                 itopk, width, ev.shape[1], ev.shape[2], ev.dtype,
-                 max_iter)}
+             if e != "fused" or (
+                 _store_mode(index._edge_store) != "pq" and fused_capable(
+                     itopk, width, ev.shape[1], ev.shape[2], ev.dtype,
+                     max_iter))}
     winner, timings = autotune.tune_best(key, cands, q, reps=reps,
                                          force=True,
                                          suspect_floor_s=suspect_floor_s,
@@ -1355,6 +1417,12 @@ def search(
                 "tracing (the edge store cannot be built under jit)", eng)
         prepare_traversal(index)
         store = index._edge_store
+    smode = _store_mode(store)
+    if eng == "fused" and smode == "pq":
+        # the megakernel has no in-kernel PQ decode (the edge engine
+        # carries that rung); a PQ store serves the per-hop kernel —
+        # same results, one launch per hop
+        eng = "edge"
     kprime = min(index.graph_degree, itopk)
     interp = jax.default_backend() != "tpu"
 
@@ -1362,17 +1430,32 @@ def search(
         def _go(e):
             ev, ea, gp = ((store[1], store[2], store[3])
                           if e in ("edge", "fused") else (None, None, None))
+            cbs = (store[4] if e in ("edge", "fused")
+                   and len(store) > 4 and store[4] is not None
+                   else (None, None))
             return _search_jit(index.dataset, score, scales, index.graph,
                                qc, mask_bits, key, index.seed_nodes, ev,
                                ea, gp, itopk, width, int(max_iter), k,
                                n_seeds, index.metric.value,
                                int(p.min_iterations), engine=e,
-                               kprime=kprime, interp=interp)
+                               kprime=kprime, interp=interp,
+                               edge_cb=cbs[0], edge_cbs=cbs[1],
+                               smode=smode if e in ("edge", "fused")
+                               else "dense")
 
         def _edge_guarded():
             # a frontier-kernel failure demotes this site to the exact
             # XLA gather path (ops/guarded.py) — one log line and a
-            # slower call, never the request
+            # slower call, never the request. The PQ rung carries its
+            # own breaker (cagra.pq_expand): its in-kernel LUT decode is
+            # a different program from the dense expand, and demoting
+            # one rung must not take the other's kernel down with it.
+            # (Two literal guarded_call sites on purpose — the drift
+            # guard's source sweep discovers sites by string literal.)
+            if smode == "pq":
+                return guarded_call("cagra.pq_expand",
+                                    lambda: _go("edge"),
+                                    lambda: _go("gather"))
             return guarded_call("cagra.graph_expand",
                                 lambda: _go("edge"), lambda: _go("gather"))
 
@@ -1512,8 +1595,9 @@ def health(index: Index, sample: int = 256) -> dict:
     es = getattr(index, "_edge_store", None)
     if es is not None:
         ev = es[1]
-        quant["edge_store"] = {"dtype": str(ev.dtype),
-                               "shape": tuple(int(s) for s in ev.shape)}
+        quant["edge_store"] = {"dtype": es[0][0],
+                               "shape": tuple(int(s) for s in ev.shape),
+                               "bytes": int(ev.size * ev.dtype.itemsize)}
     if quant:
         report["quant"] = quant
     return report

@@ -1,0 +1,292 @@
+"""Host-RAM cold tier for IVF lists: the beyond-HBM rung of the ladder.
+
+The paper targets 100M–1B-row indexes; a single host's HBM does not
+hold 100M×128 f32 lists next to a serving workload. DiskANN (Subramanya
+et al., 2019) solves the same problem one tier further out (SSD); here
+the cheap tier is **host RAM over PCIe**: lists past an HBM budget
+(``RAFT_TPU_HBM_BUDGET_GB``) stay on the host and are double-buffered
+onto the device per probed-list batch, while the hottest lists — ranked
+by measured probe frequency over a query sample — stay resident.
+
+Mechanics (family-agnostic; ivf_flat/ivf_pq wire their own scorers):
+
+* :func:`plan_hot_cold` picks the resident set: lists sorted by probe
+  frequency per byte, admitted until the budget is spent. With no
+  sample, list size stands in for frequency (under near-uniform query
+  traffic a list's probe probability tracks its share of the corpus).
+* :class:`HostTier` holds the cold rows as dense host numpy arrays,
+  pre-partitioned into fixed-shape CHUNKS (≤ ``chunk_rows`` rows and
+  ≤ ``chunk_lists`` lists each, padded to identical shapes) so every
+  chunk upload hits ONE compiled scan executable — the same
+  corpus-resident tiling discipline the fused kernels use for HBM,
+  applied across PCIe.
+* :meth:`HostTier.stream` walks only the chunks the batch actually
+  probed and keeps the NEXT chunk's ``jax.device_put`` in flight while
+  the current chunk computes (two-deep, the serve/batcher
+  double-buffering pattern) — PCIe upload hides behind the scan.
+* Cold-list scan results merge with the resident search through
+  ``knn_merge_parts`` — per-list kernel results are bit-identical to
+  the fully-resident scan (same kernel, same per-list row order), so
+  on distinct-valued corpora the merged top-k is bit-identical to the
+  resident path; equal-distance ties may order differently across the
+  hot/cold boundary (the same caveat query chunking already carries).
+
+Search-time streaming is EAGER-only (host arrays cannot ride a jit
+trace); serving dispatch is eager, so this is the serving path's
+contract already. The scan of each streamed chunk runs behind the
+``ivf.host_stream`` breaker with an XLA rescore of the same chunk as
+the fallback — a kernel failure costs arithmetic parity with the
+resident scan, never the request.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["HostTier", "budget_bytes", "plan_hot_cold", "build_tier",
+           "probe_frequency"]
+
+
+def budget_bytes(budget_gb: Optional[float] = None) -> int:
+    """HBM budget for one index's list data: the explicit argument, else
+    ``RAFT_TPU_HBM_BUDGET_GB``, else 0 (no budget → no host tier)."""
+    if budget_gb is None:
+        budget_gb = float(os.environ.get("RAFT_TPU_HBM_BUDGET_GB", "0"))
+    return int(float(budget_gb) * (1 << 30))
+
+
+def probe_frequency(probed: np.ndarray, n_lists: int) -> np.ndarray:
+    """(m, p) probed list ids over a query sample → per-list probe
+    counts (the pinning signal)."""
+    flat = np.asarray(probed).reshape(-1)
+    return np.bincount(flat[(flat >= 0) & (flat < n_lists)],
+                       minlength=n_lists).astype(np.int64)
+
+
+def plan_hot_cold(list_sizes: np.ndarray, row_bytes: float,
+                  budget: int, probe_freq: Optional[np.ndarray] = None
+                  ) -> np.ndarray:
+    """(n_lists,) bool hot mask: admit lists by probe frequency per byte
+    until the budget is spent. Frequency defaults to the list size
+    itself (≈ uniform-traffic probe probability)."""
+    sizes = np.asarray(list_sizes, np.int64)
+    freq = (sizes.astype(np.float64) if probe_freq is None
+            else np.asarray(probe_freq, np.float64))
+    bytes_per = np.maximum(sizes * row_bytes, 1.0)
+    # value density: probes served per resident byte; empty lists are
+    # free to keep (zero bytes of rows) and sort first
+    order = np.argsort(-(freq / bytes_per), kind="stable")
+    hot = np.zeros(len(sizes), bool)
+    spent = 0
+    for li in order:
+        b = int(sizes[li] * row_bytes)
+        if spent + b <= budget or sizes[li] == 0:
+            hot[li] = True
+            spent += b
+    return hot
+
+
+@dataclasses.dataclass
+class _Chunk:
+    lists: np.ndarray        # global list ids in this chunk
+    offsets: np.ndarray      # (chunk_lists,) local row offsets (padded)
+    sizes: np.ndarray        # (chunk_lists,) local sizes (0 on pad slots)
+    arrays: Dict[str, np.ndarray]   # padded host arrays, chunk-local rows
+
+
+class HostTier:
+    """Cold-list host tier: dense host arrays pre-cut into fixed-shape
+    streaming chunks, plus the global→chunk-local routing tables."""
+
+    def __init__(self, chunks: List[_Chunk], chunk_of: np.ndarray,
+                 local_of: np.ndarray, lmax: int, chunk_rows: int,
+                 chunk_lists: int, cold_rows: int, host_bytes: int,
+                 device_bytes_saved: int):
+        self.chunks = chunks
+        self.chunk_of = chunk_of       # (n_lists,) int32, -1 = resident
+        self.local_of = local_of       # (n_lists,) int32 slot in chunk
+        self.lmax = int(lmax)          # max cold list size (static)
+        self.chunk_rows = int(chunk_rows)
+        self.chunk_lists = int(chunk_lists)
+        self.cold_rows = int(cold_rows)
+        self.host_bytes = int(host_bytes)
+        self.device_bytes_saved = int(device_bytes_saved)
+        self.probe_counts = np.zeros(len(chunk_of), np.int64)
+        self.streamed_chunks = 0
+        self.streamed_bytes = 0
+        # family-filled per-chunk side arrays (e.g. ivf_pq's chunk-local
+        # rotated centers) — uploaded with the chunk's row arrays
+        self.extras: List[Dict[str, np.ndarray]] = [{} for _ in chunks]
+
+    @property
+    def n_cold_lists(self) -> int:
+        return int((self.chunk_of >= 0).sum())
+
+    def cold_probed(self, probed: np.ndarray) -> np.ndarray:
+        """Chunk ids touched by this batch's probes, ascending."""
+        self.probe_counts += probe_frequency(probed, len(self.chunk_of))
+        cids = self.chunk_of[probed.reshape(-1)]
+        return np.unique(cids[cids >= 0])
+
+    def local_probed(self, probed: np.ndarray, ci: int) -> np.ndarray:
+        """(m, p) global probed ids → chunk-local ids; probes outside
+        this chunk land on the dead pad slot (size 0 — the scan
+        kernel's dead-group gate skips them)."""
+        in_chunk = self.chunk_of[probed] == ci
+        return np.where(in_chunk, self.local_of[probed],
+                        self.chunk_lists - 1).astype(np.int32)
+
+    def stream(self, probed: np.ndarray,
+               run: Callable[[int, Dict[str, jax.Array], np.ndarray],
+                             Tuple[jax.Array, jax.Array]]
+               ) -> List[Tuple[jax.Array, jax.Array]]:
+        """Run ``run(chunk_idx, device_arrays, local_probed)`` over every
+        chunk this batch probes, keeping the next chunk's host→device
+        upload in flight while the current chunk computes."""
+        touched = self.cold_probed(probed)
+        if touched.size == 0:
+            return []
+
+        def put(ci: int) -> Dict[str, jax.Array]:
+            ch = self.chunks[ci]
+            dev = {k: jax.device_put(v) for k, v in ch.arrays.items()}
+            for k, v in self.extras[ci].items():
+                dev[k] = jax.device_put(v)
+            dev["offsets"] = jax.device_put(ch.offsets)
+            dev["sizes"] = jax.device_put(ch.sizes)
+            return dev
+
+        results = []
+        pending = put(int(touched[0]))     # warm-up upload
+        for i, ci in enumerate(touched):
+            dev, pending = pending, None
+            if i + 1 < len(touched):
+                # device_put is async: the NEXT chunk's PCIe transfer
+                # overlaps this chunk's dispatch+scan
+                pending = put(int(touched[i + 1]))
+            self.streamed_chunks += 1
+            self.streamed_bytes += sum(
+                v.size * v.dtype.itemsize
+                for v in self.chunks[int(ci)].arrays.values())
+            results.append(run(int(ci), dev,
+                               self.local_probed(probed, int(ci))))
+        return results
+
+    def snapshot(self) -> dict:
+        """Strict-JSON tier stats for debugz/memz."""
+        return {
+            "cold_lists": self.n_cold_lists,
+            "cold_rows": self.cold_rows,
+            "host_bytes": self.host_bytes,
+            "device_bytes_saved": self.device_bytes_saved,
+            "chunks": len(self.chunks),
+            "chunk_rows": self.chunk_rows,
+            "streamed_chunks": int(self.streamed_chunks),
+            "streamed_bytes": int(self.streamed_bytes),
+        }
+
+
+def build_tier(arrays: Dict[str, np.ndarray], list_offsets: np.ndarray,
+               list_sizes: np.ndarray, hot: np.ndarray,
+               chunk_rows: int, pad_tail: int = 0,
+               fills: Optional[Dict[str, float]] = None
+               ) -> Tuple[HostTier, Dict[str, np.ndarray], np.ndarray,
+                          np.ndarray]:
+    """Split cluster-sorted ``arrays`` (rows axis 0) into a packed
+    resident copy (cold lists shrunk to size 0) and a :class:`HostTier`
+    of fixed-shape cold chunks.
+
+    ``chunk_rows``: row budget per streamed chunk (rounded up to hold
+    at least the largest cold list). ``pad_tail``: extra zero rows past
+    ``chunk_rows`` on every chunk's row axis (the scan kernels' aligned
+    DMA window — padding HERE means the device never re-pads a streamed
+    chunk). ``fills``: per-array pad value (default 0).
+
+    Returns ``(tier, hot_arrays, hot_offsets, hot_sizes)``; the caller
+    swaps the resident arrays/offsets into its index and attaches the
+    tier."""
+    fills = fills or {}
+    n_lists = len(list_sizes)
+    sizes = np.asarray(list_sizes, np.int64)
+    offsets = np.asarray(list_offsets, np.int64)
+    cold_ids = np.flatnonzero(~np.asarray(hot))
+    cold_sizes = sizes[cold_ids]
+    lmax = int(cold_sizes.max()) if cold_ids.size else 0
+    chunk_rows = max(int(chunk_rows), lmax, 1)
+
+    # ---- greedy fixed-shape chunk plan over cold lists (+1 dead slot
+    # per chunk that out-of-chunk probes are routed to)
+    plans: List[List[int]] = []
+    cur: List[int] = []
+    cur_rows = 0
+    for li in cold_ids:
+        s = int(sizes[li])
+        if cur and cur_rows + s > chunk_rows:
+            plans.append(cur)
+            cur, cur_rows = [], 0
+        cur.append(int(li))
+        cur_rows += s
+    if cur:
+        plans.append(cur)
+    # shrink the shared chunk shape to the fullest chunk actually
+    # planned: every chunk still hits one executable, and a tier whose
+    # cold set is far under the row budget does not pad host RAM (or
+    # PCIe uploads) out to the budget
+    chunk_rows = max((int(sizes[p].sum()) for p in plans), default=1)
+    chunk_lists = max((len(p) for p in plans), default=0) + 1
+
+    chunk_of = np.full(n_lists, -1, np.int32)
+    local_of = np.zeros(n_lists, np.int32)
+    chunks: List[_Chunk] = []
+    host_bytes = 0
+    for ci, lists in enumerate(plans):
+        offs = np.zeros(chunk_lists, np.int64)
+        szs = np.zeros(chunk_lists, np.int64)
+        ch_arrays: Dict[str, np.ndarray] = {}
+        row0 = 0
+        sel = []
+        for sl, li in enumerate(lists):
+            chunk_of[li] = ci
+            local_of[li] = sl
+            offs[sl] = row0
+            szs[sl] = sizes[li]
+            sel.append((int(offsets[li]), int(sizes[li])))
+            row0 += int(sizes[li])
+        total = chunk_rows + pad_tail
+        for name, arr in arrays.items():
+            out = np.full((total,) + arr.shape[1:], fills.get(name, 0),
+                          arr.dtype)
+            r = 0
+            for off, s in sel:
+                out[r:r + s] = arr[off:off + s]
+                r += s
+            ch_arrays[name] = out
+            host_bytes += out.size * out.dtype.itemsize
+        chunks.append(_Chunk(np.asarray(lists, np.int64), offs, szs,
+                             ch_arrays))
+
+    # ---- packed resident copy: hot lists keep their rows (and order),
+    # cold lists shrink to zero-size spans
+    hot_offsets = np.zeros(n_lists + 1, np.int64)
+    hot_sizes = sizes.copy()
+    hot_sizes[cold_ids] = 0
+    np.cumsum(hot_sizes, out=hot_offsets[1:])
+    hot_arrays: Dict[str, np.ndarray] = {}
+    saved = 0
+    for name, arr in arrays.items():
+        out = np.empty((int(hot_offsets[-1]),) + arr.shape[1:], arr.dtype)
+        for li in np.flatnonzero(hot_sizes > 0):
+            o, s = int(offsets[li]), int(sizes[li])
+            out[int(hot_offsets[li]):int(hot_offsets[li]) + s] = \
+                arr[o:o + s]
+        hot_arrays[name] = out
+        saved += (arr.size - out.size) * arr.dtype.itemsize
+
+    tier = HostTier(chunks, chunk_of, local_of, lmax, chunk_rows,
+                    chunk_lists, int(cold_sizes.sum()), host_bytes, saved)
+    return tier, hot_arrays, hot_offsets, hot_sizes

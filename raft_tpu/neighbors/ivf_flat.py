@@ -38,7 +38,8 @@ from ..utils import cdiv, hdot, in_jax_trace, run_query_chunks
 
 __all__ = ["IndexParams", "SearchParams", "Index", "build",
            "build_from_batches", "extend", "search", "prepare_scan",
-           "reconstruct", "save", "load", "make_searcher", "health"]
+           "prepare_host_stream", "reconstruct", "save", "load",
+           "make_searcher", "health"]
 
 # v2: store_dtype meta + uint16-framed bf16 rows + int8 scales; v1 files
 # (dense f32) remain readable
@@ -388,10 +389,26 @@ def search(
     role of the interleaved-scan kernel; ``filter`` rides in-kernel as a
     penalty row), "xla" (gather-based composed-XLA path), "auto" (pallas
     on TPU).
+
+    A host-streamed index (:func:`prepare_host_stream`) serves its
+    resident lists through the same engines and double-buffers the
+    probed COLD lists' rows from host RAM per batch; host streaming is
+    eager-only (host arrays cannot ride a jit trace).
     """
     p = params or SearchParams()
     q = jnp.asarray(queries, jnp.float32)
     expects(q.ndim == 2 and q.shape[1] == index.dim, "bad query shape %s", q.shape)
+    tier = getattr(index, "_host_tier", None)
+    if tier is not None and not getattr(_hot_local, "skip", False):
+        # loud, not silent: a traced search of a host-streamed index
+        # would skip every cold list and return systematically partial
+        # results
+        expects(not in_jax_trace(),
+                "host-streamed indexes search eagerly (host arrays "
+                "cannot ride a jit trace) — drop the outer jit or "
+                "search before prepare_host_stream")
+        return _search_host_stream(index, tier, q, k, p, filter,
+                                   query_chunk, algo, precision, res)
     expects(index.size > 0, "index is empty")
     n_probes = min(p.n_probes, index.n_lists)
     mt = index.metric
@@ -520,6 +537,244 @@ def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
                          index.scales)
 
 
+_hot_local = __import__("threading").local()   # re-entry guard: the hot
+# half of a host-streamed search runs the ordinary resident path
+
+
+def prepare_host_stream(index: Index, budget_gb: Optional[float] = None,
+                        sample_queries=None, n_probes: int = 20,
+                        chunk_mb: int = 64) -> None:
+    """Move cold lists past the HBM budget into a host-RAM tier
+    (docs/perf.md "Storage ladder", the beyond-HBM rung): the device
+    keeps the hottest lists — ranked by measured probe frequency over
+    ``sample_queries`` (list size standing in without a sample) — and
+    every search double-buffers the probed cold lists' rows from host
+    numpy over PCIe, scanning them with the SAME kernel as the resident
+    lists and merging via ``knn_merge_parts``.
+
+    ``budget_gb`` defaults to ``RAFT_TPU_HBM_BUDGET_GB``. A corpus that
+    already fits is a no-op (no tier, nothing changes). Idempotent.
+    Mutates the index in place (resident arrays shrink to the hot
+    lists); ``index._host_tier`` carries the cold chunks and stats.
+    Host-streamed search is EAGER-only — serving dispatch already is.
+    """
+    from ..ops.ivf_scan import scan_window
+    from ..utils import round_up_to
+    from . import host_stream as hs
+
+    if getattr(index, "_host_tier", None) is not None:
+        return
+    budget = hs.budget_bytes(budget_gb)
+    expects(budget > 0, "prepare_host_stream needs budget_gb or "
+            "RAFT_TPU_HBM_BUDGET_GB")
+    sizes = index.list_sizes
+    itemsize = jnp.dtype(index.data.dtype).itemsize
+    row_bytes = (index.dim * itemsize + 8
+                 + (4 if index.scales is not None else 0))
+    if int(sizes.sum()) * row_bytes <= budget:
+        return   # everything fits: stay fully resident
+    freq = None
+    if sample_queries is not None:
+        from ..ops.ivf_scan import coarse_probe
+
+        cmetric = ("ip" if index.metric is DistanceType.InnerProduct
+                   else "cos" if index.metric is DistanceType.CosineExpanded
+                   else "l2")
+        probed = np.asarray(coarse_probe(
+            jnp.asarray(sample_queries, jnp.float32), index.centers,
+            min(n_probes, index.n_lists), metric=cmetric,
+            center_norms=index.center_norms))
+        freq = hs.probe_frequency(probed, index.n_lists)
+    hot = hs.plan_hot_cold(sizes, row_bytes, budget, freq)
+
+    dim_pad = round_up_to(index.dim, 128)
+    # cold chunks carry their rows SCAN-READY: dim padded to the lane
+    # tile and `scan_window` tail rows for the kernel's aligned DMA —
+    # a streamed chunk is never re-padded on device
+    data_np = np.asarray(jax.device_get(index.data))
+    if data_np.dtype == np.uint16:   # defensive: never expected
+        raise AssertionError("unexpected raw-framed dataset")
+    arrays = {
+        "data": np.pad(np.asarray(data_np),
+                       ((0, 0), (0, dim_pad - index.dim))),
+        "norms": np.asarray(index.data_norms, np.float32),
+        "ids": np.asarray(index.source_ids, np.int32),
+    }
+    fills = {"ids": -1}
+    if index.scales is not None:
+        arrays["scales"] = np.asarray(index.scales, np.float32)
+        fills["scales"] = 1.0
+    chunk_rows = max(1, int(float(chunk_mb) * (1 << 20)) // max(row_bytes, 1))
+    cold_lmax = int(sizes[~hot].max()) if (~hot).any() else 0
+    tier, hot_arrays, hot_offsets, hot_sizes = hs.build_tier(
+        arrays, index.list_offsets, sizes, hot, chunk_rows,
+        pad_tail=scan_window(cold_lmax), fills=fills)
+
+    index.data = jnp.asarray(
+        hot_arrays["data"][:, :index.dim].astype(data_np.dtype))
+    index.data_norms = jnp.asarray(hot_arrays["norms"])
+    index.source_ids = jnp.asarray(hot_arrays["ids"])
+    if index.scales is not None:
+        index.scales = jnp.asarray(hot_arrays["scales"])
+    index.list_offsets = hot_offsets
+    index.list_sizes_arr = hot_sizes
+    index.__dict__.pop("_scan_pad", None)   # stale resident-scan cache
+    index._host_tier = tier
+
+
+@dataclasses.dataclass
+class _ColdScanArgs:
+    """Static scan geometry shared by every chunk of one tier (one jit
+    executable serves all chunks)."""
+
+    k: int
+    lmax: int
+    metric: str
+    precision: str
+
+
+def _cold_chunk_scan_flat(index, dev, probed_local, qc, args, mask_bits):
+    """Scan one streamed cold chunk with the SAME kernel as the resident
+    lists (ops/ivf_scan.py) — per-list results are bit-identical to the
+    fully-resident scan's."""
+    from ..ops.ivf_scan import _ivf_flat_scan_jit
+
+    ids = dev["ids"]
+    pen_p = None
+    if mask_bits is not None:
+        pen_p = jnp.where((ids >= 0)
+                          & jnp.take(mask_bits, jnp.maximum(ids, 0)),
+                          0.0, jnp.inf).astype(jnp.float32)
+    interpret = jax.default_backend() != "tpu"
+    vals, rows = _ivf_flat_scan_jit(
+        dev["data"], dev["norms"], pen_p, dev.get("scales"),
+        jnp.asarray(probed_local), dev["offsets"].astype(jnp.int32),
+        dev["sizes"].astype(jnp.int32), qc, args.k, args.lmax,
+        args.metric, interpret, args.precision)
+    out_i = jnp.where(rows >= 0, jnp.take(ids, jnp.maximum(rows, 0)), -1)
+    return vals, out_i
+
+
+def _cold_chunk_xla_flat(index, dev, probed_local, qc, args, mask_bits):
+    """Guarded fallback: XLA rescore of the same streamed chunk (the
+    search_arrays math on block-local lists) — correct, not
+    arithmetic-identical to the kernel."""
+    n_probes = probed_local.shape[1]
+    max_rows = args.lmax * min(n_probes, dev["offsets"].shape[0])
+    rows, valid, _ = _candidate_rows(
+        jnp.asarray(probed_local), dev["offsets"].astype(jnp.int32),
+        dev["sizes"].astype(jnp.int32), max_rows)
+    from .brute_force import dequantize_rows
+
+    sc = dev.get("scales")
+    cand = dequantize_rows(dev["data"][rows],
+                           None if sc is None else sc[rows])[..., :index.dim]
+    mt = index.metric
+    ip = jnp.einsum("msd,md->ms", cand, qc, precision="highest")
+    if mt is DistanceType.InnerProduct:
+        dist = -ip
+    elif mt is DistanceType.CosineExpanded:
+        qn = jnp.sqrt(jnp.maximum(
+            jnp.sum(qc * qc, axis=1, keepdims=True), 1e-30))
+        cn = jnp.sqrt(jnp.maximum(dev["norms"][rows], 1e-30))
+        dist = 1.0 - ip / (qn * cn)
+    else:
+        q2 = jnp.sum(qc * qc, axis=1, keepdims=True)
+        dist = jnp.maximum(q2 + dev["norms"][rows] - 2.0 * ip, 0.0)
+    ids = dev["ids"][rows]
+    valid = valid & (ids >= 0)
+    if mask_bits is not None:
+        valid = valid & jnp.take(mask_bits, jnp.maximum(ids, 0))
+    dist = jnp.where(valid, dist, jnp.inf)
+    kk = min(args.k, max_rows)
+    vals, locs = select_k(dist, kk, select_min=True)
+    out_i = jnp.where(jnp.isfinite(vals),
+                      jnp.take_along_axis(ids, locs, axis=1), -1)
+    if kk < args.k:
+        vals = jnp.pad(vals, ((0, 0), (0, args.k - kk)),
+                       constant_values=jnp.inf)
+        out_i = jnp.pad(out_i, ((0, 0), (0, args.k - kk)),
+                        constant_values=-1)
+    return vals, out_i
+
+
+def _postprocess(mt, vals):
+    if mt is DistanceType.L2SqrtExpanded:
+        return jnp.sqrt(jnp.maximum(vals, 0.0))
+    if mt is DistanceType.InnerProduct:
+        return jnp.where(jnp.isfinite(vals), -vals, -jnp.inf)
+    return vals
+
+
+def _search_host_stream(index, tier, q, k, p, filter, query_chunk, algo,
+                        precision, res):
+    """Resident half through the ordinary engines + probed cold lists
+    streamed from the host tier, merged exactly like shard results
+    (knn_merge_parts)."""
+    from ..ops.ivf_scan import coarse_probe
+
+    mt = index.metric
+    select_min = is_min_close(mt)
+    n_probes = min(p.n_probes, index.n_lists)
+    mask_bits = filter.to_mask() if filter is not None else None
+    cmetric = ("ip" if mt is DistanceType.InnerProduct
+               else "cos" if mt is DistanceType.CosineExpanded else "l2")
+    args = _ColdScanArgs(k, tier.lmax, _PALLAS_METRICS.get(mt, "l2"),
+                         precision)
+    if query_chunk <= 0:
+        per_q = n_probes * (-(-index.dim // 128) * 128) * 4
+        query_chunk = max(1, min(q.shape[0],
+                                 workspace_chunk_bytes(res) // max(per_q, 1)))
+
+    def one(qc, _s0):
+        bad = jnp.inf if select_min else -jnp.inf
+        if index.size > 0:
+            _hot_local.skip = True
+            try:
+                hot_d, hot_i = search(index, qc, min(k, max(index.size, 1)),
+                                      SearchParams(n_probes), filter,
+                                      0, algo, precision)
+            finally:
+                _hot_local.skip = False
+            if hot_d.shape[1] < k:
+                pad = k - hot_d.shape[1]
+                hot_d = jnp.pad(hot_d, ((0, 0), (0, pad)),
+                                constant_values=bad)
+                hot_i = jnp.pad(hot_i, ((0, 0), (0, pad)),
+                                constant_values=-1)
+        else:
+            hot_d = jnp.full((qc.shape[0], k), bad, jnp.float32)
+            hot_i = jnp.full((qc.shape[0], k), -1, jnp.int32)
+        # the hot half just probed the same centers inside its own
+        # fused executable; re-deriving the (m, p) ids here costs one
+        # small GEMM + a host copy and keeps the resident executables
+        # byte-identical to the tier-less path (threading probes out of
+        # them would fork every compiled signature)
+        probed = np.asarray(coarse_probe(
+            qc, index.centers, n_probes, metric=cmetric,
+            center_norms=index.center_norms, precision=precision))
+
+        def run(ci, dev, probed_local):
+            return guarded_call(
+                "ivf.host_stream",
+                lambda: _cold_chunk_scan_flat(index, dev, probed_local,
+                                              qc, args, mask_bits),
+                lambda: _cold_chunk_xla_flat(index, dev, probed_local,
+                                             qc, args, mask_bits))
+
+        cold = tier.stream(probed, run)
+        if not cold:
+            return hot_d, hot_i
+        parts_d = [hot_d] + [_postprocess(mt, cd) for cd, _ in cold]
+        parts_i = [hot_i] + [ci_ for _, ci_ in cold]
+        from .brute_force import knn_merge_parts
+
+        return knn_merge_parts(jnp.stack(parts_d), jnp.stack(parts_i),
+                               select_min)
+
+    return run_query_chunks(one, q, query_chunk, res)
+
+
 def reconstruct(index: Index, row_ids) -> jax.Array:
     """Decode stored rows back to f32 input-space vectors by physical row
     id (role of the reference's ivf_flat helpers unpack/reconstruct list
@@ -553,8 +808,18 @@ def save(index: Index, path) -> None:
     """Serialize (analog of ivf_flat_serialize.cuh). Capacity slack is
     stripped: the file holds densely-packed valid rows (v1 layout), so
     files are slack-free and old readers stay compatible. bf16 rows are
-    framed as uint16 (npy has no bfloat16) with the dtype in the header."""
+    framed as uint16 (npy has no bfloat16) with the dtype in the header.
+
+    Host-streamed indexes refuse to serialize: the device arrays hold
+    only the HOT lists, so a silent save would permanently drop every
+    cold row — save before :func:`prepare_host_stream` (the tier is
+    derived state; rebuild it after load)."""
     from ._list_layout import gather_dense
+
+    expects(getattr(index, "_host_tier", None) is None,
+            "cannot save a host-streamed index (cold lists live in the "
+            "host tier, not the device arrays); save before "
+            "prepare_host_stream and re-prepare after load")
 
     sizes = index.list_sizes
     arrays = [index.data, index.source_ids]

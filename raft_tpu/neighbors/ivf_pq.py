@@ -53,9 +53,9 @@ from ..utils import cdiv, hdot, in_jax_trace, run_query_chunks
 from .ivf_flat import _candidate_rows, _probe_budget
 
 __all__ = ["CodebookGen", "IndexParams", "SearchParams", "Index", "build",
-           "build_from_batches", "extend", "search", "prepare_scan", "save",
-           "load", "pack_codes", "unpack_codes", "reconstruct",
-           "make_searcher", "health"]
+           "build_from_batches", "extend", "search", "prepare_scan",
+           "prepare_host_stream", "save", "load", "pack_codes",
+           "unpack_codes", "reconstruct", "make_searcher", "health"]
 
 _SERIAL_VERSION = 1
 
@@ -580,6 +580,15 @@ def search(
     q = jnp.asarray(queries, jnp.float32)
     expects(q.ndim == 2 and q.shape[1] == index.dim, "bad query shape %s",
             tuple(q.shape))
+    tier = getattr(index, "_host_tier", None)
+    if tier is not None and not getattr(_hot_local, "skip", False):
+        # loud, not silent: a traced search would skip every cold list
+        expects(not in_jax_trace(),
+                "host-streamed indexes search eagerly (host arrays "
+                "cannot ride a jit trace) — drop the outer jit or "
+                "search before prepare_host_stream")
+        return _search_host_stream(index, tier, q, k, p, filter,
+                                   query_chunk, algo, precision, res)
     expects(index.size > 0, "index is empty")
     n_probes = min(p.n_probes, index.n_lists)
 
@@ -752,6 +761,228 @@ def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
     return out_d, out_i
 
 
+_hot_local = __import__("threading").local()   # re-entry guard (the hot
+# half of a host-streamed search runs the ordinary resident path)
+
+
+def prepare_host_stream(index: Index, budget_gb: Optional[float] = None,
+                        sample_queries=None, n_probes: int = 20,
+                        chunk_mb: float = 64) -> None:
+    """Move cold PQ lists past the HBM budget into a host-RAM tier —
+    same contract as :func:`ivf_flat.prepare_host_stream` (probe-
+    frequency pinning, fixed-shape double-buffered chunks, eager-only
+    search; ``RAFT_TPU_HBM_BUDGET_GB`` default budget). PQ codes are
+    16-32x smaller than raw rows, so this rung matters for indexes whose
+    *code* store outgrows HBM (the DEEP-1B shape) or that share a device
+    with raw-row indexes. Chunk rows carry codes (scan-padded), decoded
+    row norms, source ids and the row's chunk-local list label."""
+    from ..ops.ivf_pq_scan import decoded_row_norms
+    from ..ops.ivf_scan import scan_window
+    from ..utils import round_up_to
+    from . import host_stream as hs
+
+    if getattr(index, "_host_tier", None) is not None:
+        return
+    budget = hs.budget_bytes(budget_gb)
+    expects(budget > 0, "prepare_host_stream needs budget_gb or "
+            "RAFT_TPU_HBM_BUDGET_GB")
+    sizes = index.list_sizes
+    row_bytes = index.pq_dim + 12
+    if int(sizes.sum()) * row_bytes <= budget:
+        return
+    freq = None
+    if sample_queries is not None:
+        from ..ops.ivf_scan import coarse_probe
+
+        q_rot = hdot(jnp.asarray(sample_queries, jnp.float32),
+                     index.rotation.T)
+        probed = np.asarray(coarse_probe(
+            q_rot, index.centers_rot, min(n_probes, index.n_lists),
+            metric="ip" if index.metric is DistanceType.InnerProduct
+            else "l2"))
+        freq = hs.probe_frequency(probed, index.n_lists)
+    hot = hs.plan_hot_cold(sizes, row_bytes, budget, freq)
+
+    rn = decoded_row_norms(index.codes, index.centers_rot,
+                           index.codebooks, index.list_offsets)
+    code_pad = round_up_to(index.pq_dim, 128)
+    labels = np.repeat(np.arange(index.n_lists),
+                       np.diff(index.list_offsets)).astype(np.int32)
+    arrays = {
+        "codes": np.pad(np.asarray(index.codes, np.uint8),
+                        ((0, 0), (0, code_pad - index.pq_dim))),
+        "norms": np.asarray(rn, np.float32),
+        "ids": np.asarray(index.source_ids, np.int32),
+        "labels": labels,
+    }
+    chunk_rows = max(1, int(float(chunk_mb) * (1 << 20))
+                     // max(row_bytes, 1))
+    cold_lmax = int(sizes[~hot].max()) if (~hot).any() else 0
+    tier, hot_arrays, hot_offsets, hot_sizes = hs.build_tier(
+        arrays, index.list_offsets, sizes, hot, chunk_rows,
+        pad_tail=scan_window(cold_lmax), fills={"ids": -1})
+    # chunk-local labels (build_tier copied GLOBAL list ids' rows; remap
+    # each chunk's label rows to chunk-local slots for the XLA fallback)
+    cent = np.asarray(index.centers_rot, np.float32)
+    for ci, ch in enumerate(tier.chunks):
+        lab = ch.arrays["labels"]
+        ch.arrays["labels"] = np.where(
+            tier.chunk_of[np.clip(lab, 0, index.n_lists - 1)] == ci,
+            tier.local_of[np.clip(lab, 0, index.n_lists - 1)],
+            0).astype(np.int32)
+        loc_cent = np.zeros((tier.chunk_lists, cent.shape[1]), np.float32)
+        loc_cent[:len(ch.lists)] = cent[ch.lists]
+        tier.extras[ci]["centers"] = loc_cent
+
+    index.codes = jnp.asarray(
+        hot_arrays["codes"][:, :index.pq_dim].astype(np.uint8))
+    index.source_ids = jnp.asarray(hot_arrays["ids"])
+    index.list_offsets = hot_offsets
+    index.list_sizes_arr = hot_sizes
+    index.__dict__.pop("_scan_cache", None)
+    index._host_tier = tier
+
+
+def _cold_chunk_scan_pq(index, dev, probed_local, qc, k, lut_dtype,
+                        precision, mask_bits):
+    """Scan one streamed cold chunk with the SAME PQ kernel (and LUT
+    mode) as the resident lists (ops/ivf_pq_scan.py): chunk-local
+    rotated centers + the index's codebook matrix."""
+    from ..ops.ivf_pq_scan import _ivf_pq_scan_jit
+
+    cache = getattr(index, "_scan_cache", None)
+    cbm = cache["cbm"] if cache is not None else \
+        getattr(index, "_cold_cbm", None)
+    if cbm is None:
+        from ..ops.ivf_pq_scan import make_cb_matrix
+
+        cbm = make_cb_matrix(index.codebooks)
+        if not in_jax_trace():
+            index._cold_cbm = cbm
+    ids = dev["ids"]
+    pen_p = None
+    if mask_bits is not None:
+        pen_p = jnp.where((ids >= 0)
+                          & jnp.take(mask_bits, jnp.maximum(ids, 0)),
+                          0.0, jnp.inf).astype(jnp.float32)
+    q_rot = hdot(qc, index.rotation.T)
+    interpret = jax.default_backend() != "tpu"
+    mt = index.metric
+    vals, rows = _ivf_pq_scan_jit(
+        dev["codes"], dev["norms"], pen_p, dev["centers"], cbm,
+        jnp.asarray(probed_local), dev["offsets"].astype(jnp.int32),
+        dev["sizes"].astype(jnp.int32), q_rot, k,
+        index._host_tier.lmax, index.pq_dim, index.pq_book_size,
+        "ip" if mt is DistanceType.InnerProduct else "l2",
+        _lut_mode(lut_dtype), interpret, precision)
+    out_i = jnp.where(rows >= 0, jnp.take(ids, jnp.maximum(rows, 0)), -1)
+    return vals, out_i
+
+
+def _cold_chunk_xla_pq(index, dev, probed_local, qc, k, mask_bits):
+    """Guarded fallback: exact rescore of the streamed chunk's candidate
+    rows via decode + GEMM in rotated space — correct, not
+    arithmetic-identical to the kernel's LUT path."""
+    tier = index._host_tier
+    n_probes = probed_local.shape[1]
+    offs = dev["offsets"].astype(jnp.int32)
+    szs = dev["sizes"].astype(jnp.int32)
+    max_rows = tier.lmax * min(n_probes, offs.shape[0])
+    rows, valid, _ = _candidate_rows(jnp.asarray(probed_local), offs, szs,
+                                     max_rows)
+    codes = dev["codes"][rows][..., :index.pq_dim].astype(jnp.int32)
+    decoded = index.codebooks[
+        jnp.arange(index.pq_dim)[None, None, :], codes]   # (m,S,s,len)
+    y = (dev["centers"][dev["labels"][rows]]
+         + decoded.reshape(codes.shape[0], codes.shape[1], -1))
+    q_rot = hdot(qc, index.rotation.T)
+    ip = jnp.einsum("msd,md->ms", y, q_rot, precision="highest")
+    mt = index.metric
+    if mt is DistanceType.InnerProduct:
+        dist = -ip
+    else:
+        q2 = jnp.sum(q_rot * q_rot, axis=1, keepdims=True)
+        dist = jnp.maximum(q2 + dev["norms"][rows] - 2.0 * ip, 0.0)
+    ids = dev["ids"][rows]
+    valid = valid & (ids >= 0)
+    if mask_bits is not None:
+        valid = valid & jnp.take(mask_bits, jnp.maximum(ids, 0))
+    dist = jnp.where(valid, dist, jnp.inf)
+    kk = min(k, max_rows)
+    vals, locs = select_k(dist, kk, select_min=True)
+    out_i = jnp.where(jnp.isfinite(vals),
+                      jnp.take_along_axis(ids, locs, axis=1), -1)
+    if kk < k:
+        vals = jnp.pad(vals, ((0, 0), (0, k - kk)),
+                       constant_values=jnp.inf)
+        out_i = jnp.pad(out_i, ((0, 0), (0, k - kk)), constant_values=-1)
+    return vals, out_i
+
+
+def _search_host_stream(index, tier, q, k, p, filter, query_chunk, algo,
+                        precision, res):
+    """Resident half through the ordinary PQ engines + probed cold lists
+    streamed from the host tier, merged like shard results."""
+    from ..ops.ivf_scan import coarse_probe
+    from .brute_force import knn_merge_parts
+
+    mt = index.metric
+    select_min = mt is not DistanceType.InnerProduct
+    n_probes = min(p.n_probes, index.n_lists)
+    mask_bits = filter.to_mask() if filter is not None else None
+    if query_chunk <= 0:
+        per_q = n_probes * index.rot_dim * 4 * 2
+        query_chunk = max(1, min(q.shape[0],
+                                 workspace_chunk_bytes(res) // max(per_q, 1)))
+
+    def _post(vals):
+        if mt is DistanceType.L2SqrtExpanded:
+            return jnp.sqrt(jnp.maximum(vals, 0.0))
+        if mt is DistanceType.InnerProduct:
+            return jnp.where(jnp.isfinite(vals), -vals, -jnp.inf)
+        return vals
+
+    def one(qc, _s0):
+        bad = jnp.inf if select_min else -jnp.inf
+        if index.size > 0:
+            _hot_local.skip = True
+            try:
+                hot_d, hot_i = search(index, qc, k, p, filter, 0, algo,
+                                      precision)
+            finally:
+                _hot_local.skip = False
+        else:
+            hot_d = jnp.full((qc.shape[0], k), bad, jnp.float32)
+            hot_i = jnp.full((qc.shape[0], k), -1, jnp.int32)
+        # duplicate of the hot half's in-executable coarse probe — see
+        # ivf_flat._search_host_stream: one small GEMM buys unchanged
+        # resident executables
+        q_rot = hdot(qc, index.rotation.T)
+        probed = np.asarray(coarse_probe(
+            q_rot, index.centers_rot, n_probes,
+            metric="ip" if mt is DistanceType.InnerProduct else "l2",
+            precision=precision))
+
+        def run(ci, dev, probed_local):
+            return guarded_call(
+                "ivf.host_stream",
+                lambda: _cold_chunk_scan_pq(index, dev, probed_local, qc,
+                                            k, p.lut_dtype, precision,
+                                            mask_bits),
+                lambda: _cold_chunk_xla_pq(index, dev, probed_local, qc,
+                                           k, mask_bits))
+
+        cold = tier.stream(probed, run)
+        if not cold:
+            return hot_d, hot_i
+        parts_d = [hot_d] + [_post(cd) for cd, _ in cold]
+        parts_i = [hot_i] + [ci_ for _, ci_ in cold]
+        return knn_merge_parts(jnp.stack(parts_d), jnp.stack(parts_i),
+                               select_min)
+
+    return run_query_chunks(one, q, query_chunk, res)
+
+
 def reconstruct(index: Index, row_ids) -> jax.Array:
     """Decode rows back to (approximate) input-space vectors
     (ivf_pq helpers reconstruct_list_data, detail/ivf_pq_build.cuh)."""
@@ -796,8 +1027,16 @@ def unpack_codes(packed: np.ndarray, pq_dim: int, pq_bits: int) -> np.ndarray:
 
 def save(index: Index, path) -> None:
     """Serialize (analog of detail/ivf_pq_serialize.cuh). Capacity slack is
-    stripped: files hold densely-packed valid rows only."""
+    stripped: files hold densely-packed valid rows only. Host-streamed
+    indexes refuse to serialize (the device arrays hold only the hot
+    lists — a silent save would drop every cold row); save before
+    :func:`prepare_host_stream`."""
     from ._list_layout import gather_dense
+
+    expects(getattr(index, "_host_tier", None) is None,
+            "cannot save a host-streamed index (cold lists live in the "
+            "host tier, not the device arrays); save before "
+            "prepare_host_stream and re-prepare after load")
 
     sizes = index.list_sizes
     if index.list_sizes_arr is not None:

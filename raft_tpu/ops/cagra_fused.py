@@ -73,7 +73,7 @@ _VMEM_CAP_BYTES = 8 << 20
 def _kernel(q_ref, bd0_ref, bi0_ref, vecs_hbm, aux_hbm, gph_hbm, *rest,
             P_q: int, width: int, deg_p: int, degree: int, itopk: int,
             itopk_p: int, kprime: int, kp: int, n_hops: int, n: int,
-            metric: str, with_pen: bool):
+            metric: str, with_pen: bool, mode: str):
     from .ring_topk import _vmem_fold
 
     if with_pen:
@@ -147,14 +147,16 @@ def _kernel(q_ref, bd0_ref, bi0_ref, vecs_hbm, aux_hbm, gph_hbm, *rest,
     # bit-identical to the edge engine's kernel)
     cvals, cids, coks = [], [], []
     for w in range(width):
-        V = vtile[w * P_q:(w + 1) * P_q]             # (P_q, deg_p, dim_p)
+        V = vtile[w * P_q:(w + 1) * P_q]             # (P_q, deg_p, W)
         A = atile[w * P_q:(w + 1) * P_q]             # (P_q, 2, deg_p)
         scales = A[:, 0, :]
         vnorm = A[:, 1, :]
-        Vw = (V.astype(jnp.int32).astype(jnp.float32)
-              if V.dtype in (jnp.int8, jnp.uint8)
-              else V.astype(jnp.float32))
-        cross = jnp.sum(q[:, None, :] * Vw, axis=2)   # (P_q, deg_p)
+        # storage-rung widen + scoring SHARED with graph_expand (the
+        # bit-parity contract: both engines evaluate the identical
+        # expression — int4's split nibble reduce included)
+        from .graph_expand import edge_tile_widen
+
+        cross = edge_tile_widen(V, q, mode)           # (P_q, deg_p)
         cross = cross * scales
         if metric == "l2":
             dist = jnp.maximum(qn + vnorm - 2.0 * cross, 0.0)
@@ -238,12 +240,13 @@ def _kernel(q_ref, bd0_ref, bi0_ref, vecs_hbm, aux_hbm, gph_hbm, *rest,
 @functools.partial(
     jax.jit,
     static_argnames=("itopk", "width", "max_iter", "kprime", "degree",
-                     "metric", "P_q", "interpret", "with_pen"))
+                     "metric", "P_q", "interpret", "with_pen", "mode"))
 def _fused_padded(q, bd0, bi0, vecs, aux, gph, pen, itopk: int, width: int,
                   max_iter: int, kprime: int, degree: int, metric: str,
-                  P_q: int, interpret: bool, with_pen: bool):
+                  P_q: int, interpret: bool, with_pen: bool,
+                  mode: str = "dense"):
     m_pad, dim_p = q.shape
-    n, deg_p, _ = vecs.shape
+    n, deg_p, store_w = vecs.shape
     P = P_q * width
     itopk_p = round_up_to(itopk, 128)
     kp = round_up_to(kprime, 128)
@@ -252,7 +255,7 @@ def _fused_padded(q, bd0, bi0, vecs, aux, gph, pen, itopk: int, width: int,
     kern = functools.partial(_kernel, P_q=P_q, width=width, deg_p=deg_p,
                              degree=degree, itopk=itopk, itopk_p=itopk_p,
                              kprime=kprime, kp=kp, n_hops=max_iter, n=n,
-                             metric=metric, with_pen=with_pen)
+                             metric=metric, with_pen=with_pen, mode=mode)
     blk = lambda shape: pl.BlockSpec(shape, lambda i, h: (i, 0),
                                      memory_space=pltpu.VMEM)
     in_specs = [
@@ -271,7 +274,7 @@ def _fused_padded(q, bd0, bi0, vecs, aux, gph, pen, itopk: int, width: int,
         pltpu.VMEM((P_q, itopk_p), jnp.float32),   # frontier: distances
         pltpu.VMEM((P_q, itopk_p), jnp.int32),     # frontier: ids
         pltpu.VMEM((P_q, itopk_p), jnp.int32),     # frontier: explored
-        pltpu.VMEM((P, deg_p, dim_p), vecs.dtype),
+        pltpu.VMEM((P, deg_p, store_w), vecs.dtype),
         pltpu.VMEM((P, 2, deg_p), jnp.float32),
         pltpu.VMEM((P, 1, deg_p), jnp.int32),
     ]
@@ -310,6 +313,7 @@ def fused_traverse(
     degree: int,
     metric: str = "l2",
     interpret: Optional[bool] = None,
+    mode: str = "dense",
 ) -> Tuple[jax.Array, jax.Array]:
     """Run the whole multi-hop traversal in one kernel launch.
 
@@ -318,9 +322,16 @@ def fused_traverse(
     — bit-identical to ``max_iter`` iterations of the edge-engine hop
     body (the fixed grid runs every hop; a converged frontier yields no
     finite parents, so extra hops are exact no-ops on the buffer, which
-    is also why early exit costs nothing but the idle steps)."""
+    is also why early exit costs nothing but the idle steps). ``mode``:
+    the edge store's rung — "dense" (int8/bf16 rows) or "int4"
+    (nibble-packed; the shared ``graph_expand.edge_tile_widen`` keeps
+    both engines' arithmetic identical). PQ stores serve the edge
+    engine — the megakernel carries no in-kernel LUT decode."""
+    from .graph_expand import score_dim
+
     m = queries.shape[0]
-    n, deg_p, dim_p = vecs.shape
+    n, deg_p, _ = vecs.shape
+    dim_p = score_dim(vecs, mode)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     P_q = _pick_pq(width)
@@ -338,7 +349,7 @@ def fused_traverse(
     pen3 = pen.reshape(n, 1, deg_p) if pen is not None else None
     od, oi = _fused_padded(q, bd, bi, vecs, aux, gph3, pen3, itopk, width,
                            int(max_iter), kprime, degree, metric, P_q,
-                           bool(interpret), pen is not None)
+                           bool(interpret), pen is not None, mode)
     return od[:m, :itopk], oi[:m, :itopk]
 
 

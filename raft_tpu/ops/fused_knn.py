@@ -162,7 +162,7 @@ def _extract_smallest(c, ci, k: int, kp: int):
 
 def _kernel(q_ref, d_ref, dn_ref, pen_ref, *rest, k: int, kp: int, tn: int,
             nc: int, metric: str, n_dtiles: int, precision: str,
-            with_scales: bool):
+            with_scales: bool, int4: bool = False):
     if with_scales:
         sc_ref, ov_ref, oi_ref, sv_ref, si_ref = rest
     else:
@@ -178,7 +178,23 @@ def _kernel(q_ref, d_ref, dn_ref, pen_ref, *rest, k: int, kp: int, tn: int,
     q = q_ref[:]                                   # (tm, dim_p) f32
     d = d_ref[:]                                   # (tn, dim_p) stored dtype
     tm = q.shape[0]
-    if d.dtype == jnp.bfloat16:
+    if int4:
+        # nibble-packed corpus (ops/quant.py split-half layout): byte j
+        # holds components j (low nibble) and j+half (high). Unpacking
+        # is a lane-axis shift+mask — never a minor-axis reshape — and
+        # the dot splits into two half-width GEMMs against the query's
+        # (low, high) column halves. HBM stream traffic: 1/8 of f32.
+        from .quant import int4_nibbles
+
+        half = d.shape[1]
+        low, high = int4_nibbles(d.astype(jnp.int32))
+        kw = dict(preferred_element_type=jnp.float32,
+                  precision=jax.lax.Precision(precision))
+        dot = (jax.lax.dot_general(q[:, :half], low,
+                                   (((1,), (1,)), ((), ())), **kw)
+               + jax.lax.dot_general(q[:, half:], high,
+                                     (((1,), (1,)), ((), ())), **kw))
+    elif d.dtype == jnp.bfloat16:
         # bf16 corpus mode: rows stream from HBM at half the f32 traffic;
         # the product accumulates in f32 (precision knob is moot — the
         # stored operand is already bf16)
@@ -280,12 +296,13 @@ def _kernel(q_ref, d_ref, dn_ref, pen_ref, *rest, k: int, kp: int, tn: int,
 
 @functools.partial(jax.jit,
                    static_argnames=("k", "metric", "interpret", "precision",
-                                    "tiles"))
+                                    "tiles", "int4"))
 def _fused_knn_padded(q, d, dn, pen, sc, k: int, metric: str,
                       interpret: bool, precision: str,
-                      tiles: Tuple[int, int]):
+                      tiles: Tuple[int, int], int4: bool = False):
     m_pad, dim_p = q.shape
     n_pad = d.shape[0]
+    d_w = d.shape[1]               # packed byte width (= dim_p/2 for int4)
     tm, tn = tiles
     tm = min(tm, m_pad)
     tn = min(tn, n_pad)
@@ -299,14 +316,15 @@ def _fused_knn_padded(q, d, dn, pen, sc, k: int, metric: str,
 
     kern = functools.partial(_kernel, k=k, kp=kp, tn=tn, nc=nc,
                              metric=metric, n_dtiles=grid[1],
-                             precision=precision, with_scales=sc is not None)
+                             precision=precision, with_scales=sc is not None,
+                             int4=int4)
     flops = 2 * m_pad * n_pad * dim_p
     row_spec = pl.BlockSpec((1, tn), lambda i, j: (0, j),
                             memory_space=pltpu.VMEM)
     in_specs = [
         pl.BlockSpec((tm, dim_p), lambda i, j: (i, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((tn, dim_p), lambda i, j: (j, 0),
+        pl.BlockSpec((tn, d_w), lambda i, j: (j, 0),
                      memory_space=pltpu.VMEM),
         row_spec,
         row_spec,
@@ -355,6 +373,7 @@ def fused_knn(
     interpret: Optional[bool] = None,
     precision: str = "highest",
     scales: Optional[jax.Array] = None,
+    int4_dim: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """k nearest rows of ``dataset`` for each query, fused on-TPU.
 
@@ -370,31 +389,44 @@ def fused_knn(
     (3-pass bf16, ~f32-accurate; the exact-search default) or "default"
     (single-pass bf16 multiplies, ~3x the MXU throughput, distance error
     ~1e-3 relative — fine as an ANN candidate generator).
+    ``int4_dim``: when set, ``dataset`` is a nibble-packed int4 corpus
+    (``(n, half_p)`` int8, ops/quant.py split-half layout) for a logical
+    row width of ``int4_dim``; unpacking happens in-kernel (lane-axis
+    shift+mask) so the HBM stream is 1/8 of f32. ``scales`` required.
     Pre-aligned inputs (rows a tile multiple, dim a 128 multiple — see
     ``brute_force.prepare_fused``) pass through without the trace-time
     pad copy, keeping the corpus genuinely HBM-resident across calls.
     Returns (values (m, k), indices (m, k)) sorted best-first; excluded /
     out-of-range slots have value +inf and index -1.
     """
+    from ..core.errors import expects
+
     q = jnp.asarray(queries, jnp.float32)
     d = jnp.asarray(dataset)
-    if d.dtype not in (jnp.bfloat16, jnp.int8, jnp.uint8):
+    int4 = int4_dim is not None
+    if not int4 and d.dtype not in (jnp.bfloat16, jnp.int8, jnp.uint8):
         d = d.astype(jnp.float32)   # low-precision modes stay as stored
-    if d.dtype == jnp.int8 and scales is None:
+    if (int4 or d.dtype == jnp.int8) and scales is None:
         # without the per-row dequant factors the raw quantized dot mixes
         # value spaces with the dequantized norms — plausibly-shaped,
         # silently wrong neighbors; fail the contract loudly instead
-        from ..core.errors import expects
-
-        expects(False, "int8 datasets require per-row dequant scales "
-                       "(see brute_force.quantize_rows)")
+        expects(False, "int8/int4 datasets require per-row dequant scales "
+                       "(see ops.quant.quantize_rows)")
     m, dim = q.shape
     n = d.shape[0]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    dim_p = round_up_to(dim, 128)
-    tm, tn = _pick_tiles(dim_p, k, d.dtype.itemsize)
+    if int4:
+        # the packed corpus fixes the geometry: the query widens to the
+        # (low, high) double-half width the split dot contracts against
+        expects(d.shape[1] * 2 >= dim,
+                "int4 corpus width %d cannot hold dim %d", d.shape[1], dim)
+        dim_p = 2 * d.shape[1]
+        tm, tn = _pick_tiles(dim_p, k, 1)
+    else:
+        dim_p = round_up_to(dim, 128)
+        tm, tn = _pick_tiles(dim_p, k, d.dtype.itemsize)
     m_pad = round_up_to(m, min(tm, round_up_to(m, 8)))
     n_pad = round_up_to(n, min(tn, round_up_to(n, 128)))
     if (m_pad, dim_p) != (m, dim):
@@ -402,12 +434,19 @@ def fused_knn(
     # the dataset pad keys on the DATASET's own shape (a prepare_fused
     # corpus arrives already (n_pad, dim_p) while queries are unpadded —
     # comparing against the query dim would re-pad it every call)
-    if (n_pad, dim_p) != d.shape:
-        d = jnp.pad(d, ((0, n_pad - n), (0, dim_p - d.shape[1])))
+    d_w = d.shape[1] if int4 else dim_p
+    if (n_pad, d_w) != d.shape:
+        d = jnp.pad(d, ((0, n_pad - n), (0, d_w - d.shape[1])))
 
     if metric in ("l2", "cos"):
         if data_norms is None:
-            dn = jnp.sum(d.astype(jnp.float32) ** 2, axis=1)
+            if int4:
+                from .quant import int4_nibbles
+
+                low, high = int4_nibbles(d.astype(jnp.int32))
+                dn = jnp.sum(low * low + high * high, axis=1)
+            else:
+                dn = jnp.sum(d.astype(jnp.float32) ** 2, axis=1)
             if scales is not None:
                 dn = dn * jnp.pad(jnp.asarray(scales, jnp.float32),
                                   (0, n_pad - n)) ** 2
@@ -430,5 +469,6 @@ def fused_knn(
 
     vals, idxs = _fused_knn_padded(q, d, dn.reshape(1, -1),
                                    pen.reshape(1, -1), sc, k, metric,
-                                   interpret, precision, (tm, tn))
+                                   interpret, precision, (tm, tn),
+                                   int4=int4)
     return vals[:m], idxs[:m]

@@ -42,7 +42,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..utils import round_up_to
 
-__all__ = ["graph_expand"]
+__all__ = ["graph_expand", "edge_tile_widen", "score_dim"]
 
 _INT_BIG = 2**30
 
@@ -56,9 +56,68 @@ def _pick_pq(width: int) -> int:
     return max(1, min(8, 16 // max(width, 1)))
 
 
+def edge_tile_widen(V, q_rows, mode: str, cb_ref=None, cbscl_ref=None):
+    """Edge tile (P, deg_p, W) in storage form → per-edge f32 query
+    cross-products ``(P, deg_p)``. The ONE scoring expression both the
+    per-hop kernel here and the fused megakernel (ops/cagra_fused.py)
+    call, so the engines stay bit-identical by construction across every
+    storage rung:
+
+    * ``dense`` — int8/bf16 rows widened through f32 in-register (Mosaic
+      has no byte→bf16 cast — the ivf_scan idiom); f32 multiplies keep
+      parity with the gather path's f32-highest einsum.
+    * ``int4`` — nibble-packed rows (ops/quant.py split-half layout):
+      lane-axis shift+mask into (low, high) planes and a split
+      broadcast-mul/lane-reduce against the query's column halves.
+    * ``pq`` — PQ codes decoded in-VMEM by a one-hot GEMM against the
+      subspace-major decode table (``ops.quant.pq_decode_table``); the
+      int8 table mode (the fp8-LUT role) accumulates exactly in int32
+      and rescales per output column. The one-hot builds from plain
+      per-subspace equality compares (NOT ``pltpu.repeat``, whose
+      interpret semantics diverge from the tiling its other user
+      assumes), and only major axes are ever reshaped — the
+      (P·deg_p, pqb) flatten never touches the minor dim.
+    """
+    P, deg_p = V.shape[0], V.shape[1]
+    if mode == "int4":
+        from .quant import int4_nibbles
+
+        half = V.shape[2]
+        low, high = int4_nibbles(V.astype(jnp.int32))
+        return jnp.sum(q_rows[:, None, :half] * low
+                       + q_rows[:, None, half:] * high, axis=2)
+    if mode == "pq":
+        dim_p = cb_ref.shape[1]
+        pq_dim = V.shape[2]
+        book = cb_ref.shape[0] // pq_dim
+        codes2 = V.reshape(P * deg_p, pq_dim).astype(jnp.int32)
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (P * deg_p, book), 1)
+        oh = jnp.concatenate(
+            [codes2[:, s:s + 1] == iota_b for s in range(pq_dim)],
+            axis=1).astype(cb_ref.dtype)                 # (P·deg_p, pqb)
+        if cb_ref.dtype == jnp.int8:
+            dec = jax.lax.dot_general(
+                oh, cb_ref[:], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32).astype(jnp.float32)
+            dec = dec * cbscl_ref[:]                     # (1, dim_p)
+        else:
+            dec = jax.lax.dot_general(
+                oh, cb_ref[:], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        Vw = dec.reshape(P, deg_p, dim_p)
+        return jnp.sum(q_rows[:, None, :] * Vw, axis=2)
+    Vw = (V.astype(jnp.int32).astype(jnp.float32)
+          if V.dtype in (jnp.int8, jnp.uint8) else V.astype(jnp.float32))
+    return jnp.sum(q_rows[:, None, :] * Vw, axis=2)
+
+
 def _kernel(pids_ref, q_ref, vecs_hbm, aux_hbm, *rest, P: int, P_q: int,
             width: int, deg_p: int, degree: int, k_out: int, kp: int,
-            metric: str, with_pen: bool):
+            metric: str, with_pen: bool, mode: str):
+    if mode == "pq":
+        cb_ref, cbscl_ref, *rest = rest
+    else:
+        cb_ref = cbscl_ref = None
     if with_pen:
         pen_hbm, ov_ref, oi_ref, vtile, atile, ptile, sem = rest
     else:
@@ -107,12 +166,9 @@ def _kernel(pids_ref, q_ref, vecs_hbm, aux_hbm, *rest, P: int, P_q: int,
     route = (prow == qcol).astype(jnp.float32)       # (P, P_q) one-hot
     qpar = jax.lax.dot_general(route, q, (((1,), (0,)), ((), ())),
                                preferred_element_type=jnp.float32)
-    # int8/bf16 widen through f32 in-register (Mosaic has no byte→bf16
-    # cast — the ivf_scan idiom); f32 multiplies keep parity with the
-    # gather path's f32-highest einsum
-    Vw = (V.astype(jnp.int32).astype(jnp.float32)
-          if V.dtype in (jnp.int8, jnp.uint8) else V.astype(jnp.float32))
-    cross = jnp.sum(qpar[:, None, :] * Vw, axis=2)   # (P, deg_p)
+    # storage-rung widen/decode + broadcast-mul/lane-reduce scoring —
+    # shared with the fused megakernel (see edge_tile_widen)
+    cross = edge_tile_widen(V, qpar, mode, cb_ref, cbscl_ref)  # (P, deg_p)
     cross = cross * scales                           # q·(s·v) = s·(q·v)
     if metric == "l2":
         qn_p = jnp.sum(qpar * qpar, axis=1, keepdims=True)   # (P, 1)
@@ -151,19 +207,20 @@ def _kernel(pids_ref, q_ref, vecs_hbm, aux_hbm, *rest, P: int, P_q: int,
 @functools.partial(
     jax.jit,
     static_argnames=("k_out", "metric", "width", "degree", "P_q",
-                     "interpret", "with_pen"))
-def _expand_padded(pids, q, vecs, aux, pen, k_out: int, metric: str,
-                   width: int, degree: int, P_q: int, interpret: bool,
-                   with_pen: bool):
+                     "interpret", "with_pen", "mode"))
+def _expand_padded(pids, q, vecs, aux, pen, cbm, cbscl, k_out: int,
+                   metric: str, width: int, degree: int, P_q: int,
+                   interpret: bool, with_pen: bool, mode: str):
     m_pad, dim_p = q.shape
-    n, deg_p, _ = vecs.shape
+    n, deg_p, store_w = vecs.shape
     P = P_q * width
     kp = round_up_to(k_out, 128)
     grid = (m_pad // P_q,)
 
     kern = functools.partial(_kernel, P=P, P_q=P_q, width=width,
                              deg_p=deg_p, degree=degree, k_out=k_out,
-                             kp=kp, metric=metric, with_pen=with_pen)
+                             kp=kp, metric=metric, with_pen=with_pen,
+                             mode=mode)
     in_specs = [
         pl.BlockSpec((P_q, dim_p), lambda g, p: (g, 0),
                      memory_space=pltpu.VMEM),
@@ -171,11 +228,18 @@ def _expand_padded(pids, q, vecs, aux, pen, k_out: int, metric: str,
         pl.BlockSpec(memory_space=pl.ANY),       # aux (scales, norms)
     ]
     args = [q, vecs, aux]
+    if mode == "pq":
+        # the decode matrix (and its int8 per-row rescale) live whole in
+        # VMEM — a few hundred KB at pq8·book256·d128
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.VMEM))
+        args.append(cbm)
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.VMEM))
+        args.append(cbscl)
     if with_pen:
         in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
         args.append(pen)
     scratch = [
-        pltpu.VMEM((P, deg_p, dim_p), vecs.dtype),
+        pltpu.VMEM((P, deg_p, store_w), vecs.dtype),
         pltpu.VMEM((P, 2, deg_p), jnp.float32),
     ]
     if with_pen:
@@ -206,16 +270,31 @@ def _expand_padded(pids, q, vecs, aux, pen, k_out: int, metric: str,
     return vals, epos
 
 
+def score_dim(vecs: jax.Array, mode: str, cbm=None) -> int:
+    """Query width the kernel scores at for a storage mode: the store
+    minor dim ("dense"), twice the packed byte width ("int4" — the
+    (low, high) split), or the decode matrix's row space ("pq")."""
+    if mode == "int4":
+        return 2 * vecs.shape[2]
+    if mode == "pq":
+        return cbm.shape[1]       # decode-table columns = embedded dims
+    return vecs.shape[2]
+
+
 def graph_expand(
     parents: jax.Array,          # (m, width) int32 parent node ids
     queries: jax.Array,          # (m, dim) f32
-    vecs: jax.Array,             # (n, deg_p, dim_p) int8 | bf16 edge store
+    vecs: jax.Array,             # (n, deg_p, W) int8 | bf16 | u8 edge store
     aux: jax.Array,              # (n, 2, deg_p) f32: [scales, dequant norms]
     k_out: int,
     metric: str = "l2",
     degree: Optional[int] = None,
     pen: Optional[jax.Array] = None,   # (n, deg_p) f32: +inf excludes edge
     interpret: Optional[bool] = None,
+    mode: str = "dense",
+    cbm: Optional[jax.Array] = None,     # pq: (pq_dim*book, dim_p)
+    #                                      subspace-major decode table
+    cb_scale: Optional[jax.Array] = None,  # pq int8 CB: (1, dim_p) rescale
 ) -> Tuple[jax.Array, jax.Array]:
     """Score every parent's neighbor tile, return per-parent top-``k_out``.
 
@@ -226,9 +305,16 @@ def graph_expand(
     slots are ``(+inf, -1)``. ``degree``: real edge count (≤ ``deg_p``;
     pad edges are masked in-kernel). ``pen``: optional per-edge additive
     penalty in the same edge-major layout as the store (bitset filters).
+    ``mode``: storage rung of ``vecs`` — "dense" (int8/bf16 rows),
+    "int4" (nibble-packed, W = half the scored dim), or "pq" (W = codes
+    per row; ``cbm`` is the ``(pq_dim*book, dim_p)`` SUBSPACE-MAJOR
+    decode table from ``ops.quant.pq_decode_table`` — NOT
+    ``ivf_pq_scan.make_cb_matrix``'s transposed layout — with
+    ``cb_scale`` its int8-mode per-column rescale).
     """
     m, width = parents.shape
-    n, deg_p, dim_p = vecs.shape
+    n, deg_p, _ = vecs.shape
+    dim_p = score_dim(vecs, mode, cbm)
     degree = deg_p if degree is None else degree
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -243,9 +329,9 @@ def graph_expand(
     # pen operand when with_pen
     pen3 = pen.reshape(n, 1, deg_p) if pen is not None else None
 
-    vals, epos = _expand_padded(pids, q, vecs, aux, pen3, k_out, metric,
-                                width, degree, P_q, interpret,
-                                pen is not None)
+    vals, epos = _expand_padded(pids, q, vecs, aux, pen3, cbm, cb_scale,
+                                k_out, metric, width, degree, P_q,
+                                interpret, pen is not None, mode)
     vals = vals.reshape(m_pad, width, -1)[:m, :, :k_out]
     epos = epos.reshape(m_pad, width, -1)[:m, :, :k_out]
     return vals, epos

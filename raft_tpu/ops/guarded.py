@@ -112,6 +112,14 @@ POLICIES: Dict[str, BreakerPolicy] = {
     "ivf_pq.scan": DEFAULT_POLICY,
     "brute_force.fused": DEFAULT_POLICY,
     "cagra.graph_expand": DEFAULT_POLICY,
+    # the PQ edge-store rung's expand (in-kernel LUT decode) — a
+    # separate program from the dense expand, so its breaker must not
+    # couple the two rungs' demotions
+    "cagra.pq_expand": DEFAULT_POLICY,
+    # host-streamed cold IVF lists (neighbors/host_stream.py): falls
+    # back to XLA scoring of the same streamed block — correctness never
+    # depends on the scan kernel accepting a streamed chunk
+    "ivf.host_stream": DEFAULT_POLICY,
     # the one-dispatch traversal megakernel (ops/cagra_fused.py): falls
     # back to the per-hop edge engine, which carries its own breaker
     # (cagra.graph_expand) onto the XLA gather path

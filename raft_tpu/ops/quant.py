@@ -1,0 +1,283 @@
+"""Storage-ladder quantization: the ONE home for per-row corpus coding.
+
+Every rung of the storage ladder (docs/perf.md "Storage ladder") shares
+the same shape of machinery — per-row symmetric scales, a packed byte
+representation, and an exact-norm side array — and before this module
+each family grew its own copy (``brute_force.quantize_rows`` + cagra's
+``prepare_search`` int8 pass were the second; int4 would have been the
+third). The ladder now lives here:
+
+* **f32 / bf16 / uint8 / int8** — :func:`quantize_rows` /
+  :func:`dequantize_rows`, byte-for-byte the former
+  ``brute_force.quantize_rows`` semantics (brute_force re-exports them,
+  so pickled/serialized indexes and every call site are unchanged).
+* **int4** — nibble-packed rows at 2x int8's density:
+  :func:`quantize_int4` packs value ``j`` and value ``j + half`` of a
+  row into one byte (*split-half* layout, so in-kernel unpacking is a
+  lane-axis shift+mask — :func:`int4_nibbles` — and never a sub-128
+  minor-axis reshape, the Mosaic-fragile relayout). Per-row scale =
+  amax/7, values clipped to [-7, 7].
+* **PQ row codes** — :func:`train_pq_rows` / :func:`encode_pq_rows`
+  code whole rows (no coarse quantizer: the edge store codes *dataset
+  rows*, not residuals) against per-subspace codebooks, reusing the
+  ivf_pq LUT machinery (:func:`raft_tpu.ops.ivf_pq_scan.make_cb_matrix`
+  builds the block-diagonal decode matrix the expand kernels consume;
+  :func:`pq_int8_cb` applies the same per-subspace symmetric int8
+  quantization as the ivf_pq scan's fp8-LUT mode).
+
+``int8_scale_report`` (the health-report scale summary) also moved here
+from brute_force, unchanged.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import cdiv, in_jax_trace, round_up_to
+
+__all__ = ["quantize_rows", "dequantize_rows", "int8_scale_report",
+           "quantize_int4", "dequantize_int4", "int4_half_width",
+           "int4_nibbles", "train_pq_rows", "encode_pq_rows",
+           "pq_decoded_norms", "pq_int8_cb", "default_pq_dim"]
+
+
+def quantize_rows(dataset: jax.Array, dtype
+                  ) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """f32 rows → (stored rows, per-row scales|None) for a storage dtype.
+
+    ``dtype``: a jnp dtype (float32/bfloat16/int8/uint8) or the string
+    ``"int4"`` (nibble-packed — see :func:`quantize_int4`; the returned
+    rows are ``(n, half_p)`` int8 and ALWAYS carry scales)."""
+    from ..core.errors import expects
+
+    if isinstance(dtype, str) and dtype in ("int4", "i4"):
+        return quantize_int4(dataset)
+    dtype = jnp.dtype(dtype)
+    if dtype == jnp.float32:
+        return dataset, None
+    if dtype == jnp.bfloat16:
+        return dataset.astype(jnp.bfloat16), None
+    if dtype == jnp.uint8:
+        # byte corpora (SIFT/DEEP): exact for integral [0, 255] inputs,
+        # no scales (the reference's native uint8 dataset mode)
+        q = jnp.clip(jnp.round(dataset), 0, 255)
+        if not in_jax_trace():
+            # silent clamping of float data would collapse recall with no
+            # error; scaled float data belongs in int8 mode
+            expects(bool(jnp.all(jnp.abs(dataset - q) < 1e-3)),
+                    "uint8 storage expects byte-valued data (integral in "
+                    "[0, 255]); use dtype='int8' for scaled float data")
+        return q.astype(jnp.uint8), None
+    expects(dtype == jnp.int8,
+            "store dtype must be f32/bf16/int8/uint8/int4, got %s", dtype)
+    amax = jnp.max(jnp.abs(dataset), axis=1)
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(dataset / scale[:, None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_rows(rows: jax.Array,
+                    scales: Optional[jax.Array]) -> jax.Array:
+    """Stored rows (any non-packed dtype) → f32, applying int8 per-row
+    scales. int4-packed rows need :func:`dequantize_int4` (the packed
+    width is not the logical dim)."""
+    out = rows.astype(jnp.float32)
+    if scales is not None:
+        out = out * scales[..., None]
+    return out
+
+
+def int8_scale_report(scales) -> dict:
+    """Sampled per-row int8 scale stats for a health report: the f32
+    originals are not retained by int8 stores, so the report carries the
+    quantization *step bound* ``max_scale/2`` per component rather than
+    a measured reconstruction error. Shared by every family with an
+    int8 storage mode (brute_force, ivf_flat)."""
+    sc = np.asarray(scales, np.float64)
+    return {"int8": {
+        "mean_scale": round(float(sc.mean()), 6),
+        "max_scale": round(float(sc.max()), 6),
+        "max_abs_err_bound": round(float(sc.max()) / 2.0, 6)}}
+
+
+# --------------------------------------------------------------- int4 --
+
+def int4_half_width(dim: int) -> int:
+    """Packed byte width for a ``dim``-wide int4 row: ``ceil(dim/2)``
+    rounded to the 64-byte sublane-pair multiple, so a query split into
+    its (low, high) halves is ``2*half_p`` wide — a 128-lane multiple —
+    and the packed corpus block keeps a power-of-two minor dim."""
+    return round_up_to(cdiv(dim, 2), 64)
+
+
+def quantize_int4(dataset: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """f32 rows → (packed (n, half_p) int8, per-row scales (n,) f32).
+
+    Split-half layout: byte ``j`` of a row holds component ``j`` in its
+    low nibble and component ``j + half_p`` in its high nibble (missing
+    tail components are zero). Unpacking is therefore two lane-axis
+    shift+mask passes over the SAME byte tile (:func:`int4_nibbles`) and
+    the dot against a query splits into two half-width GEMMs — no
+    nibble interleaving, no sub-128 reshapes anywhere."""
+    dataset = jnp.asarray(dataset, jnp.float32)
+    n, dim = dataset.shape
+    half = int4_half_width(dim)
+    amax = jnp.max(jnp.abs(dataset), axis=1)
+    scale = jnp.maximum(amax, 1e-30) / 7.0
+    q = jnp.clip(jnp.round(dataset / scale[:, None]), -7, 7)
+    q = jnp.pad(q, ((0, 0), (0, 2 * half - dim))).astype(jnp.int32)
+    lo = q[:, :half] & 0xF
+    hi = q[:, half:] & 0xF
+    packed = (lo | (hi << 4)).astype(jnp.uint8).astype(jnp.int8)
+    return packed, scale
+
+
+def int4_nibbles(packed_i32: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Packed int4 bytes (already widened to int32) → (low, high) f32
+    nibble planes with sign extension — the in-kernel unpack every
+    consumer (fused_knn / graph_expand / cagra_fused) shares, so the
+    arithmetic cannot drift between kernels. Pure lane-local shift+mask:
+    ``low = (w << 28) >> 28`` (arithmetic), ``high = (w << 24) >> 28``."""
+    w = packed_i32
+    low = ((w << 28) >> 28).astype(jnp.float32)
+    high = ((w << 24) >> 28).astype(jnp.float32)
+    return low, high
+
+
+def dequantize_int4(packed: jax.Array, scales: jax.Array,
+                    dim: int) -> jax.Array:
+    """Packed (n, half_p) int8 rows → (n, dim) f32 (the XLA-side decode
+    the resident fallback engines use; bit-for-bit the kernels' nibble
+    arithmetic)."""
+    half = packed.shape[-1]
+    low, high = int4_nibbles(packed.astype(jnp.int32))
+    full = jnp.concatenate([low, high], axis=-1)[..., :dim]
+    return full * scales[..., None]
+
+
+# ----------------------------------------------------------------- PQ --
+
+def default_pq_dim(dim: int) -> int:
+    """Edge-store PQ sub-quantizer count: ~8 components per subspace
+    (16 codes/row at d128 — an 8x byte cut vs the int8 edge rows that
+    keeps refined recall within a few points of int8; halving it again
+    with ``pq_dim=dim_p//16`` trades ~0.1 refined recall for the
+    ISSUE's 0.6 GB/1M·deg64 point). Floored at 4, capped at 64."""
+    dim_p = round_up_to(dim, 128)
+    return max(4, min(64, dim_p // 8))
+
+
+def train_pq_rows(dataset, pq_dim: int, book: int = 256,
+                  iters: int = 20, seed: int = 0,
+                  train_rows: int = 65536) -> jax.Array:
+    """Per-subspace codebooks (pq_dim, book, pq_len) trained on WHOLE
+    rows (zero-padded to the 128-multiple dim the expand kernels score
+    in), reusing ivf_pq's vmapped fixed-iteration Lloyd. No coarse
+    quantizer / residuals: the edge store codes dataset rows directly,
+    and the decode matrix lives in the padded dim space so decoded
+    vectors drop into the kernels' existing scoring unchanged."""
+    from ..neighbors.ivf_pq import _kmeans_fixed
+
+    dataset = jnp.asarray(dataset, jnp.float32)
+    n, dim = dataset.shape
+    dim_p = round_up_to(dim, 128)
+    pq_len = dim_p // pq_dim
+    if n > train_rows:
+        stride = max(1, n // train_rows)
+        dataset = dataset[::stride]
+    x = jnp.pad(dataset, ((0, 0), (0, dim_p - dim)))
+    slices = jnp.transpose(
+        x.reshape(x.shape[0], pq_dim, pq_len), (1, 0, 2))
+    keys = jax.random.split(jax.random.key(seed), pq_dim)
+    book = min(book, x.shape[0])
+    return jax.vmap(_kmeans_fixed, in_axes=(0, None, None, 0))(
+        slices, book, iters, keys)
+
+
+def encode_pq_rows(dataset, codebooks: jax.Array,
+                   chunk: int = 1 << 16) -> jax.Array:
+    """Rows → (n, pq_dim) uint8 codes (per-subspace argmin), in bounded
+    chunks — the unbounded (n, pq_dim, book) argmin plane is the same
+    HBM hazard ``ivf_pq_scan.pq_chunk_rows`` bounds."""
+    from .ivf_pq_scan import pq_chunk_rows
+
+    dataset = jnp.asarray(dataset, jnp.float32)
+    n, dim = dataset.shape
+    pq_dim, book, pq_len = codebooks.shape
+    dim_p = pq_dim * pq_len
+    chunk = min(chunk, pq_chunk_rows(pq_dim, book))
+
+    @jax.jit
+    def _enc(xb):
+        xb = jnp.pad(xb, ((0, 0), (0, dim_p - dim)))
+        s = xb.reshape(xb.shape[0], pq_dim, pq_len)
+        d2 = (jnp.sum(s * s, axis=2)[:, :, None]
+              - 2.0 * jnp.einsum("nsl,sbl->nsb", s, codebooks,
+                                 precision="highest")
+              + jnp.sum(codebooks * codebooks, axis=2)[None, :, :])
+        return jnp.argmin(d2, axis=2).astype(jnp.uint8)
+
+    if n <= chunk:
+        return _enc(dataset)
+    parts = []
+    for b0 in range(0, n, chunk):
+        sel = jnp.asarray((np.arange(b0, b0 + chunk) % n).astype(np.int32))
+        parts.append(_enc(jnp.take(dataset, sel, axis=0))
+                     [: min(chunk, n - b0)])
+    return jnp.concatenate(parts)
+
+
+def pq_decoded_norms(codes: jax.Array, codebooks: jax.Array) -> jax.Array:
+    """(n,) ||decode(codes)||² — subspaces are disjoint coordinate
+    blocks, so the norm is the sum of per-subspace codeword norms (one
+    small gather, no decode materialization)."""
+    pq_dim, book, pq_len = codebooks.shape
+    cb2 = jnp.sum(codebooks * codebooks, axis=2)          # (s, b)
+    c = jnp.asarray(codes, jnp.int32)
+    return jnp.sum(cb2[jnp.arange(pq_dim)[None, :], c], axis=1)
+
+
+def pq_decode_table(codebooks: jax.Array) -> jax.Array:
+    """(pq_dim, book, pq_len) codebooks → the SUBSPACE-MAJOR decode
+    table (pq_dim*book, dim_p): row ``s*book + b`` is codeword ``b`` of
+    subspace ``s`` embedded at dims ``[s*pq_len, (s+1)*pq_len)``, zeros
+    elsewhere. A one-hot row block per subspace times this table IS the
+    decoded vector — and the one-hot builds from plain per-subspace
+    equality compares, deliberately avoiding ``pltpu.repeat`` (whose
+    interpret-mode semantics are element-wise where the ivf_pq scan's
+    comment assumes tiling — the documented interpret/TPU quirk behind
+    that module's xfailed pq_bits=4 int8-LUT test)."""
+    pq_dim, book, pq_len = codebooks.shape
+    dim_p = pq_dim * pq_len
+    tbl = jnp.zeros((pq_dim * book, dim_p), jnp.float32)
+    cbj = jnp.asarray(codebooks, jnp.float32)
+    for s in range(pq_dim):
+        tbl = tbl.at[s * book:(s + 1) * book,
+                     s * pq_len:(s + 1) * pq_len].set(cbj[s])
+    return tbl
+
+
+def pq_int8_cb(table: jax.Array, pq_dim: int, book: int
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Subspace-major decode table → (int8 table, (1, dim_p) f32
+    per-column rescale) — the ivf_pq scan's fp8-LUT-role quantization:
+    per-subspace symmetric quantize (the table is block-diagonal, so
+    each output column belongs to exactly one subspace and the
+    per-column rescale round-trips exactly up to the int8 rounding
+    itself). The int8 one-hot GEMM then accumulates exactly in int32 at
+    the MXU's double byte rate."""
+    dim_p = table.shape[1]
+    pq_len = dim_p // pq_dim
+    absmax = jnp.max(jnp.abs(table).reshape(pq_dim, book * dim_p), axis=1)
+    scales = jnp.maximum(absmax, 1e-12) / 127.0
+    t_i8 = jnp.clip(
+        jnp.round(table.reshape(pq_dim, book, dim_p)
+                  / scales[:, None, None]), -127, 127
+    ).astype(jnp.int8).reshape(pq_dim * book, dim_p)
+    scale_row = jnp.repeat(scales, pq_len)[None, :]
+    return t_i8, scale_row

@@ -159,6 +159,12 @@ def snapshot(batcher=None, registry=None, events_n: int = 50,
             out["quality"] = q["sentinels"]
         if q["health"]:
             out["health"] = q["health"]
+        # memz: per-watched-index device bytes by component +
+        # bytes_per_vector — the storage ladder's capacity claims,
+        # inspectable in prod (docs/perf.md "Storage ladder")
+        mz = _quality.memz_snapshot()
+        if mz:
+            out["memz"] = mz
     except Exception:  # noqa: BLE001 - surface must render without quality
         pass
     if slo_report is not None:
@@ -277,6 +283,26 @@ def render_text(batcher=None, registry=None, events_n: int = 20,
                 f"  {fam}: recall={est if est is not None else '-'} "
                 f"(n={ent['samples']})"
                 + (" BELOW FLOOR" if ent.get("below_floor") else ""))
+    if s.get("memz"):
+        lines += ["", "-- memz (device bytes) --"]
+        for name, rep in sorted(s["memz"].items()):
+            if "error" in rep:
+                lines.append(f"  {name}: error {rep['error']}")
+                continue
+            parts = " ".join(f"{c}={v}" for c, v in
+                             sorted((rep.get("components") or {}).items()))
+            bpv = rep.get("bytes_per_vector")
+            lines.append(
+                f"  {name}: {rep.get('family', '?')} "
+                f"total={rep.get('total_device_bytes', 0)}B "
+                f"b/vec={bpv if bpv is not None else '-'} {parts}")
+            hsn = rep.get("host_stream")
+            if hsn:
+                lines.append(
+                    f"    host tier: {hsn['cold_lists']} cold lists "
+                    f"{hsn['host_bytes']}B host, saved "
+                    f"{hsn['device_bytes_saved']}B device, streamed "
+                    f"{hsn['streamed_chunks']} chunks")
     if s.get("health"):
         lines += ["", "-- index health --"]
         for name, rep in sorted(s["health"].items()):

@@ -44,7 +44,7 @@ from ..core import events, tracing
 
 __all__ = ["RecallSentinel", "make_reference", "health", "watch_index",
            "unwatch_index", "health_snapshot", "export_health_jsonl",
-           "ops_snapshot"]
+           "ops_snapshot", "device_bytes", "memz_snapshot"]
 
 # live sentinels (weak, like sharded_ann._LIVE): debugz reports every
 # sentinel the process is running without explicit plumbing
@@ -390,6 +390,126 @@ def health_snapshot(sample: int = 256) -> dict:
             continue
         try:
             out[name] = health(idx, sample=sample)
+        except Exception as e:  # noqa: BLE001
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def _nbytes(a) -> int:
+    """Size of a device/host array leaf; 0 for None/non-arrays."""
+    try:
+        return int(a.size) * int(a.dtype.itemsize)
+    except AttributeError:
+        return 0
+
+
+def device_bytes(index) -> dict:
+    """Per-component DEVICE byte decomposition of one index — the memz
+    half of the ops surface (debugz ``memz``): where the ladder rung's
+    capacity actually went. Components: ``dataset`` (the primary row
+    store + norms/ids/scales), ``edge_store`` / ``pq_codes`` (the cagra
+    traversal store, keyed by its rung), ``score_cache`` (cagra's
+    candidate-dtype copies), ``fused_cache`` (brute_force's tile-aligned
+    corpus), ``scan_cache`` (the IVF aligned-DMA copies), ``delta_tier``
+    (a mutable index's un-merged tier; host-resident numpy, reported so
+    the serving footprint is honest). ``bytes_per_vector`` divides the
+    device total by ALL rows the index answers for — host-streamed cold
+    rows included — so a rung's capacity claim is inspectable in prod;
+    an attached host tier reports its own ``host_stream`` block."""
+    from ..neighbors import brute_force, cagra, ivf_flat, ivf_pq, mutable
+
+    comp: dict = {}
+    n = 0
+    family = type(index).__module__.rsplit(".", 1)[-1]
+    if isinstance(index, mutable.MutableIndex):
+        rep = {"family": "mutable"}
+        if index._sealed is not None:
+            rep["sealed"] = device_bytes(index._sealed)
+        # the delta tier (brute-force fan-out rows + ids + alive bits)
+        # lives in host numpy until merged; its cached device view is
+        # the bucketed copy the fan-out searches
+        delta = (_nbytes(index._d_vecs) + _nbytes(index._d_ids)
+                 + _nbytes(index._d_alive))
+        cache = index._delta_cache
+        if cache is not None:
+            delta += sum(_nbytes(leaf) for leaf in cache
+                         if leaf is not None and hasattr(leaf, "dtype"))
+        rep["components"] = {"delta_tier": delta}
+        rep["total_device_bytes"] = (
+            rep.get("sealed", {}).get("total_device_bytes", 0) + delta)
+        rep["n"] = int(index.size) if hasattr(index, "size") else 0
+        return rep
+    if isinstance(index, cagra.Index):
+        family = "cagra"
+        n = int(index.size)
+        comp["dataset"] = _nbytes(index.dataset) + _nbytes(index.graph)
+        es = getattr(index, "_edge_store", None)
+        if es is not None:
+            store = sum(_nbytes(x) for x in es[1:4])
+            if len(es) > 4 and es[4] is not None:
+                store += sum(_nbytes(x) for x in es[4])
+            comp["pq_codes" if es[0][0] == "pq" else "edge_store"] = store
+        sc = (_nbytes(getattr(index, "_score_bf16", None))
+              + sum(_nbytes(x) for x in
+                    (getattr(index, "_score_i8", None) or ())))
+        if sc:
+            comp["score_cache"] = sc
+    elif isinstance(index, brute_force.Index):
+        family = "brute_force"
+        n = int(index.size)
+        comp["dataset"] = (_nbytes(index.dataset) + _nbytes(index.norms)
+                           + _nbytes(index.scales))
+        fp = getattr(index, "_fused_pad", None)
+        if fp is not None:
+            comp["fused_cache"] = sum(_nbytes(x) for x in fp[1:])
+    elif isinstance(index, ivf_flat.Index):
+        family = "ivf_flat"
+        n = int(index.size)
+        comp["dataset"] = (_nbytes(index.data) + _nbytes(index.data_norms)
+                           + _nbytes(index.source_ids)
+                           + _nbytes(index.scales))
+        sp = getattr(index, "_scan_pad", None)
+        if sp is not None:
+            comp["scan_cache"] = sum(_nbytes(x) for x in sp[1:])
+    elif isinstance(index, ivf_pq.Index):
+        family = "ivf_pq"
+        n = int(index.size)
+        comp["pq_codes"] = _nbytes(index.codes)
+        comp["dataset"] = (_nbytes(index.source_ids)
+                           + _nbytes(index.centers_rot)
+                           + _nbytes(index.codebooks)
+                           + _nbytes(index.rotation))
+        sc = getattr(index, "_scan_cache", None)
+        if sc is not None:
+            comp["scan_cache"] = sum(
+                _nbytes(v) for v in sc.values() if hasattr(v, "dtype"))
+    else:
+        raise TypeError(
+            f"no memz report for index type {type(index).__name__}")
+    total = int(sum(comp.values()))
+    rep = {"family": family, "n": n, "components": comp,
+           "total_device_bytes": total}
+    tier = getattr(index, "_host_tier", None)
+    if tier is not None:
+        rep["host_stream"] = tier.snapshot()
+        n += int(tier.cold_rows)
+        rep["n_total"] = n
+    rep["bytes_per_vector"] = round(total / n, 2) if n else None
+    return rep
+
+
+def memz_snapshot() -> dict:
+    """Device-memory decomposition for every live watched index (debugz
+    ``memz`` section; strict-JSON). A failing report becomes an
+    ``{"error": ...}`` entry."""
+    out: dict = {}
+    for name, ref in list(_WATCHED.items()):
+        idx = ref()
+        if idx is None:
+            _WATCHED.pop(name, None)
+            continue
+        try:
+            out[name] = device_bytes(idx)
         except Exception as e:  # noqa: BLE001
             out[name] = {"error": f"{type(e).__name__}: {e}"}
     return out

@@ -167,11 +167,13 @@ def warmup(search_fn, ladder, dim: int, dtype=np.float32, registry=None,
 
     ``prepare``: optional zero-arg callable run BEFORE the sweep for
     index-side cache builds that must not land on the first unlucky
-    request — e.g. ``lambda: brute_force.prepare_fused(index)`` or
-    ``lambda: cagra.prepare_traversal(index)`` (the edge-resident
-    candidate store is seconds of gather+pack at corpus scale, and the
-    jitted ladder shapes can only reuse it if it exists before their
-    first trace).
+    request — e.g. ``lambda: brute_force.prepare_fused(index)``,
+    ``lambda: cagra.prepare_traversal(index, "pq")`` (an edge store is
+    seconds of gather+pack — and the PQ rung minutes of codebook
+    training — at corpus scale, and the jitted ladder shapes can only
+    reuse it if it exists before their first trace), or
+    ``lambda: ivf_flat.prepare_host_stream(index)`` (restructuring the
+    resident layout mid-traffic would recompile every bucket).
 
     ``engines``: optional ``{engine_name: search_fn}`` mapping — every
     engine closure is swept across the FULL ladder (``search_fn`` may

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Tier-1 test-duration guard: flag wall regressions BEFORE the 870s gate.
+
+The tier-1 suite runs under a hard 870s cap with ~35s of margin
+(ROADMAP.md), so a PR that slows pre-existing tests must surface that
+cost in review — not be discovered as a gate timeout. This script
+compares a pytest ``--durations`` tail (the tier-1 command already
+emits one into ``/tmp/_t1.log``) against the checked-in per-test
+baseline and fails on UNTOUCHED tests that grew more than the
+threshold.
+
+Usage::
+
+    # after a tier-1 run (ROADMAP command tees /tmp/_t1.log):
+    python scratch/check_tier1_durations.py              # compare
+    python scratch/check_tier1_durations.py --update     # rebaseline
+
+Only ``call`` phases are compared (setup/teardown are fixture noise).
+Tests whose FILE is touched in the working tree / staged diff (``git
+diff --name-only HEAD``) are exempt — a PR is allowed to make the tests
+it edits slower on purpose; the guard exists for collateral damage
+(import-time costs, fixture contention, accidental de-caching) to
+everyone else's tests. Regressions must clear BOTH the relative
+threshold (default +20%) and an absolute floor (default 1.0s growth) —
+host noise on sub-second tests routinely exceeds 20% (CHANGES.md
+records ±45% swings), and a flag that cries wolf gets ignored.
+New tests (absent from the baseline) are reported informationally.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_BASELINE = os.path.join(HERE, "tier1_durations_baseline.json")
+_DUR_RE = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)")
+
+
+def parse_durations(log_path: str) -> dict:
+    """pytest ``--durations`` lines → {test_id: call seconds}."""
+    out: dict = {}
+    with open(log_path, errors="replace") as f:
+        for line in f:
+            m = _DUR_RE.match(line)
+            if m and m.group(2) == "call":
+                out[m.group(3)] = float(m.group(1))
+    return out
+
+
+def touched_files(git_base: str = "HEAD") -> set:
+    """Files changed in the working tree + index vs ``git_base`` —
+    their tests are exempt (the PR owns their cost)."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", git_base],
+            capture_output=True, text=True, cwd=os.path.dirname(HERE),
+            timeout=30, check=False).stdout
+        return {ln.strip() for ln in diff.splitlines() if ln.strip()}
+    except Exception:  # noqa: BLE001 - no git → guard everything
+        return set()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--log", default="/tmp/_t1.log",
+                    help="pytest log carrying the --durations tail")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the baseline from --log and exit")
+    ap.add_argument("--threshold", type=float, default=1.2,
+                    help="relative growth bar on untouched tests")
+    ap.add_argument("--min-growth-s", type=float, default=1.0,
+                    help="absolute growth floor (noise gate)")
+    ap.add_argument("--git-base", default="HEAD",
+                    help="diff base for the touched-test exemption")
+    ap.add_argument("--no-git", action="store_true",
+                    help="treat every test as untouched")
+    args = ap.parse_args(argv)
+
+    cur = parse_durations(args.log)
+    if not cur:
+        print(f"no --durations entries found in {args.log}; run the "
+              "ROADMAP tier-1 command first", file=sys.stderr)
+        return 2
+
+    if args.update:
+        with open(args.baseline, "w") as f:
+            json.dump(dict(sorted(cur.items())), f, indent=1)
+            f.write("\n")
+        print(f"baseline updated: {len(cur)} tests -> {args.baseline}")
+        return 0
+
+    if not os.path.exists(args.baseline):
+        print(f"no baseline at {args.baseline}; run with --update once",
+              file=sys.stderr)
+        return 2
+    with open(args.baseline) as f:
+        base = json.load(f)
+
+    touched = set() if args.no_git else touched_files(args.git_base)
+
+    def is_touched(test_id: str) -> bool:
+        path = test_id.split("::", 1)[0]
+        return any(t.endswith(path) or path.endswith(t) for t in touched)
+
+    flagged, grew, fresh = [], [], []
+    for tid, secs in sorted(cur.items()):
+        if tid not in base:
+            fresh.append((tid, secs))
+            continue
+        b = base[tid]
+        if secs > b * args.threshold and secs - b >= args.min_growth_s:
+            (grew if is_touched(tid) else flagged).append((tid, b, secs))
+
+    for tid, secs in fresh:
+        print(f"NEW       {secs:7.2f}s  {tid}")
+    for tid, b, secs in grew:
+        print(f"TOUCHED   {b:6.2f}s -> {secs:6.2f}s  {tid}")
+    for tid, b, secs in flagged:
+        print(f"REGRESSED {b:6.2f}s -> {secs:6.2f}s "
+              f"(+{(secs / b - 1) * 100:.0f}%)  {tid}")
+    tot_b = sum(base.values())
+    tot_c = sum(v for t, v in cur.items() if t in base)
+    print(f"# shared-test wall: baseline {tot_b:.1f}s vs current "
+          f"{tot_c:.1f}s; {len(fresh)} new, {len(flagged)} regressed "
+          f"(threshold x{args.threshold}, floor "
+          f"+{args.min_growth_s:g}s)")
+    if flagged:
+        print("FAIL: untouched tests regressed — demote to the slow "
+              "lane or pay for the growth (see the tier-1 wall policy "
+              "in CHANGES.md)", file=sys.stderr)
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

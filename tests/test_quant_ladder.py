@@ -1,0 +1,411 @@
+"""Storage-ladder rung tests (ISSUE 13): int4 + PQ coding, the cagra
+edge-store rungs' recall contract, host-streamed cold IVF lists, the
+memz ops surface, and the tier-1 durations guard.
+
+The acceptance bar lives in TestRungRecall.test_low_rungs_track_int8:
+int4 and PQ edge-store searches hit >= 0.95 of the int8 rung's recall
+at fixed k after the exact refine pass, with the guarded fallbacks
+serving the resident paths bit-identically (TestGuardedFallbacks).
+"""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core import faults
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+from raft_tpu.neighbors import refine as refine_mod
+from raft_tpu.ops import guarded, quant
+
+from ann_utils import calc_recall, naive_knn
+
+
+# ---------------------------------------------------------------- quant --
+class TestInt4Coding:
+    def test_roundtrip_error_bound(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(300, 100)).astype(np.float32)
+        packed, scales = quant.quantize_int4(jnp.asarray(x))
+        assert packed.shape == (300, quant.int4_half_width(100))
+        assert packed.dtype == jnp.int8
+        deq = np.asarray(quant.dequantize_int4(packed, scales, 100))
+        # symmetric rounding: per-component error <= the row's step/2
+        bound = np.asarray(scales)[:, None] / 2 + 1e-6
+        assert (np.abs(deq - x) <= bound).all()
+
+    def test_nibbles_exact_for_representable(self):
+        # integer values in [-7, 7] survive the pack/unpack bit-exactly
+        rng = np.random.default_rng(1)
+        v = rng.integers(-7, 8, size=(64, 96)).astype(np.float32)
+        packed, scales = quant.quantize_int4(jnp.asarray(v * 0.5))
+        deq = np.asarray(quant.dequantize_int4(packed, scales, 96))
+        np.testing.assert_allclose(deq, v * 0.5, rtol=0, atol=1e-6)
+
+    def test_int4_brute_force_engines_agree(self):
+        """Fused-kernel int4 (in-kernel nibble unpack) vs the XLA
+        split-dot fallback: same ids, matching values."""
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(600, 100)).astype(np.float32)
+        q = rng.normal(size=(9, 100)).astype(np.float32)
+        ix = brute_force.build(x, "sqeuclidean", dtype="int4")
+        assert ix.store_name == "int4" and ix.dim == 100
+        dm, im = brute_force.search(ix, q, 7, algo="matmul")
+        dp, ip_ = brute_force.search(ix, q, 7, algo="pallas")
+        np.testing.assert_array_equal(np.asarray(im), np.asarray(ip_))
+        np.testing.assert_allclose(np.asarray(dm), np.asarray(dp),
+                                   rtol=1e-5, atol=1e-4)
+        rep = brute_force.health(ix)
+        assert rep["store_dtype"] == "int4" and "int4" in rep["quant"]
+
+    def test_int4_save_load(self, tmp_path):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(200, 40)).astype(np.float32)
+        ix = brute_force.build(x, "sqeuclidean", dtype="int4")
+        brute_force.save(ix, tmp_path / "i4.bin")
+        ld = brute_force.load(tmp_path / "i4.bin")
+        assert ld.dim == 40 and ld.store_name == "int4"
+        np.testing.assert_array_equal(np.asarray(ld.dataset),
+                                      np.asarray(ix.dataset))
+
+
+class TestPqCoding:
+    def test_exact_when_book_covers_corpus(self):
+        """book >= n: every row gets its own codeword chain — decode is
+        exact, so the coding pipeline itself adds no error."""
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(100, 64)).astype(np.float32)
+        cb = quant.train_pq_rows(x, 8)
+        codes = quant.encode_pq_rows(x, cb)
+        cbn, cn = np.asarray(cb), np.asarray(codes)
+        dec = np.concatenate([cbn[s][cn[:, s]] for s in range(8)],
+                             axis=1)[:, :64]
+        assert np.abs(dec - x).max() < 1e-4
+        en = np.asarray(quant.pq_decoded_norms(codes, cb))
+        want = (np.concatenate([cbn[s][cn[:, s]] for s in range(8)],
+                               axis=1) ** 2).sum(1)
+        np.testing.assert_allclose(en, want, rtol=1e-4)
+
+    def test_decode_table_int8_roundtrip(self):
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(200, 64)).astype(np.float32)
+        cb = quant.train_pq_rows(x, 8)
+        tbl = quant.pq_decode_table(cb)
+        t8, srow = quant.pq_int8_cb(tbl, 8, cb.shape[1])
+        back = np.asarray(t8, np.float32) * np.asarray(srow)
+        # per-subspace symmetric quantization: table error bounded by
+        # half a step per element
+        step = np.asarray(srow)[0]
+        assert (np.abs(back - np.asarray(tbl)) <= step / 2 + 1e-7).all()
+
+
+# ------------------------------------------------- cagra edge-store rungs
+@pytest.fixture(scope="module")
+def rung_setup():
+    rng = np.random.default_rng(7)
+    cent = rng.normal(size=(12, 64)).astype(np.float32) * 3
+    x = (cent[rng.integers(0, 12, 1600)]
+         + rng.normal(size=(1600, 64))).astype(np.float32)
+    q = (cent[rng.integers(0, 12, 32)]
+         + rng.normal(size=(32, 64))).astype(np.float32)
+    k = 8
+    _, gt = naive_knn(x, q, k)
+    ix = cagra.build(x, cagra.IndexParams(graph_degree=24,
+                                          intermediate_graph_degree=36))
+    return ix, x, q, k, gt
+
+
+def _refined_recall(ix, x, q, k, gt, engine, kc=96,
+                    sp=None) -> float:
+    """The ladder's serving recipe: traverse at the rung's precision,
+    exact-refine the WHOLE itopk candidate buffer down to k — the
+    wider-refine operating point the low-bit rungs want (docs/perf.md
+    "Storage ladder"; ISSUE 13 acceptance shape)."""
+    sp = sp or cagra.SearchParams(itopk_size=96, search_width=2,
+                                  max_iterations=10)
+    _, cand = cagra.search(ix, q, kc, sp, engine=engine)
+    _, ids = refine_mod.refine(jnp.asarray(x), jnp.asarray(q), cand, k,
+                               "sqeuclidean")
+    return calc_recall(np.asarray(ids), gt)
+
+
+class TestRungRecall:
+    def test_low_rungs_track_int8(self, rung_setup):
+        """ISSUE 13 acceptance: int4 and PQ edge-store searches >= 0.95
+        of the int8 rung's recall at fixed k after exact refine."""
+        ix, x, q, k, gt = rung_setup
+        recalls = {}
+        for rung in ("int8", "int4", "pq"):
+            ix.__dict__.pop("_edge_store", None)
+            cagra.prepare_traversal(ix, rung)
+            assert ix._edge_store[0][0] == rung
+            recalls[rung] = _refined_recall(ix, x, q, k, gt, "edge")
+        assert recalls["int8"] >= 0.9, recalls
+        assert recalls["int4"] >= 0.95 * recalls["int8"], recalls
+        assert recalls["pq"] >= 0.95 * recalls["int8"], recalls
+
+    def test_store_bytes_ladder(self, rung_setup):
+        """Each rung's edge store shrinks as promised: bf16 > int8 >
+        int4 >= pq codes (the pq rung's CODE store is >= 4x under
+        int8's rows — the capacity claim the bench lane records)."""
+        ix, *_ = rung_setup
+        nbytes = {}
+        for rung in ("bfloat16", "int8", "int4", "pq"):
+            ix.__dict__.pop("_edge_store", None)
+            cagra.prepare_traversal(ix, rung)
+            ev = ix._edge_store[1]
+            nbytes[rung] = ev.size * ev.dtype.itemsize
+        assert nbytes["bfloat16"] == 2 * nbytes["int8"]
+        # d=64 packs to the 64-byte sublane-pair floor (no win below
+        # d128); the pq rung's cut is the load-bearing one
+        assert nbytes["int4"] <= nbytes["int8"]
+        assert nbytes["pq"] * 4 <= nbytes["int8"], nbytes
+
+    @pytest.mark.slow
+    def test_monotone_rung_chain(self, rung_setup):
+        """f32(gather) >= bf16 >= int8 >= int4 >= pq refined recall
+        (small tolerance: rung noise on a 48-query sample)."""
+        ix, x, q, k, gt = rung_setup
+        chain = [("f32", "gather", None)] + [
+            (r, "edge", r) for r in ("bfloat16", "int8", "int4", "pq")]
+        got = []
+        for name, eng, rung in chain:
+            if rung is not None:
+                ix.__dict__.pop("_edge_store", None)
+                cagra.prepare_traversal(ix, rung)
+            got.append((name, _refined_recall(ix, x, q, k, gt, eng)))
+        for (na, ra), (nb, rb) in zip(got, got[1:]):
+            assert rb <= ra + 0.02, (f"rung {nb} above {na}", got)
+
+    @pytest.mark.slow
+    def test_int4_fused_megakernel_parity(self):
+        """The one-dispatch megakernel scores int4 stores bit-identically
+        to the per-hop edge engine (shared edge_tile_widen)."""
+        rng = np.random.default_rng(8)
+        x = rng.normal(size=(700, 64)).astype(np.float32)
+        q = rng.normal(size=(16, 64)).astype(np.float32)
+        ix = cagra.build(x, cagra.IndexParams(
+            graph_degree=16, intermediate_graph_degree=24))
+        cagra.prepare_traversal(ix, "int4")
+        sp = cagra.SearchParams(itopk_size=16, search_width=1,
+                                max_iterations=4)
+        de, ie = cagra.search(ix, q, 8, sp, engine="edge")
+        df, if_ = cagra.search(ix, q, 8, sp, engine="fused")
+        np.testing.assert_array_equal(np.asarray(ie), np.asarray(if_))
+        np.testing.assert_array_equal(np.asarray(de), np.asarray(df))
+
+
+@pytest.mark.faults
+class TestGuardedFallbacks:
+    def test_pq_expand_demotes_to_gather(self, rung_setup):
+        """A PQ-expand kernel failure serves the resident gather path
+        bit-identically (the ISSUE 13 fallback contract) under its OWN
+        breaker site."""
+        if any(f.kind in ("kernel_compile", "kernel_fault")
+               for f in faults.active()):
+            pytest.skip("ambient kernel faults change demotion counts")
+        ix, x, q, k, gt = rung_setup
+        ix.__dict__.pop("_edge_store", None)
+        cagra.prepare_traversal(ix, "pq")
+        sp = cagra.SearchParams(itopk_size=32, search_width=1,
+                                max_iterations=5)
+        guarded.reset()
+        try:
+            with faults.inject("kernel_fault", "cagra.pq_expand"):
+                dd, di = cagra.search(ix, q, k, sp, engine="edge")
+            assert "cagra.pq_expand" in guarded.demoted_sites()
+            assert "cagra.graph_expand" not in guarded.demoted_sites()
+        finally:
+            guarded.reset()
+        dg, ig = cagra.search(ix, q, k, sp, engine="gather")
+        np.testing.assert_array_equal(np.asarray(di), np.asarray(ig))
+        np.testing.assert_array_equal(np.asarray(dd), np.asarray(dg))
+
+
+# ------------------------------------------------ host-streamed IVF lists
+class TestHostStream:
+    def test_flat_bit_identity(self):
+        """Host-streamed vs HBM-resident ivf_flat: bit-identical results
+        on a distinct-valued corpus (same kernel, per-list row order
+        preserved), across multiple double-buffered chunks."""
+        rng = np.random.default_rng(10)
+        x = rng.normal(size=(1500, 48)).astype(np.float32)
+        q = rng.normal(size=(24, 48)).astype(np.float32)
+        ix = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=16))
+        sp = ivf_flat.SearchParams(n_probes=5)
+        d0, i0 = ivf_flat.search(ix, q, 9, sp, algo="pallas")
+        ivf_flat.prepare_host_stream(ix, budget_gb=90e3 / (1 << 30),
+                                     sample_queries=q[:8], chunk_mb=0.06)
+        tier = ix._host_tier
+        assert tier.n_cold_lists > 0 and len(tier.chunks) >= 2
+        d1, i1 = ivf_flat.search(ix, q, 9, sp, algo="pallas")
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        assert tier.streamed_chunks > 0
+
+    @pytest.mark.slow
+    def test_pq_bit_identity(self):
+        # slow lane (tier-1 wall policy): the flat bit-identity test
+        # pins the shared tier machinery (planner/chunking/merge) in
+        # tier-1; this adds the pq-family kernel call on top
+        rng = np.random.default_rng(11)
+        x = rng.normal(size=(1500, 32)).astype(np.float32)
+        q = rng.normal(size=(16, 32)).astype(np.float32)
+        ix = ivf_pq.build(x, ivf_pq.IndexParams(n_lists=16, pq_dim=8))
+        sp = ivf_pq.SearchParams(n_probes=5)
+        d0, i0 = ivf_pq.search(ix, q, 9, sp, algo="pallas")
+        ivf_pq.prepare_host_stream(ix, budget_gb=20e3 / (1 << 30),
+                                   chunk_mb=0.02)
+        assert ix._host_tier.n_cold_lists > 0
+        d1, i1 = ivf_pq.search(ix, q, 9, sp, algo="pallas")
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    def test_budget_fits_is_noop(self):
+        rng = np.random.default_rng(12)
+        x = rng.normal(size=(200, 32)).astype(np.float32)
+        ix = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=4))
+        ivf_flat.prepare_host_stream(ix, budget_gb=1.0)
+        assert getattr(ix, "_host_tier", None) is None
+
+    def test_streamed_index_refuses_save_and_jit(self, tmp_path):
+        """A host-streamed index fails LOUDLY where it cannot serve the
+        full corpus: save() would drop cold rows; a traced search would
+        skip them."""
+        from raft_tpu.core.errors import RaftError
+
+        rng = np.random.default_rng(16)
+        x = rng.normal(size=(400, 32)).astype(np.float32)
+        ix = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=8))
+        ivf_flat.prepare_host_stream(ix, budget_gb=20e3 / (1 << 30))
+        assert ix._host_tier is not None
+        with pytest.raises(RaftError, match="host-streamed"):
+            ivf_flat.save(ix, tmp_path / "hs.bin")
+        with pytest.raises(RaftError, match="eagerly"):
+            jax.jit(lambda q: ivf_flat.search(ix, q, 5))(
+                jnp.asarray(x[:4]))
+
+    @pytest.mark.slow
+    def test_flat_int8_filter_bit_identity(self):
+        from raft_tpu.core.bitset import Bitset
+
+        rng = np.random.default_rng(13)
+        x = rng.normal(size=(2500, 48)).astype(np.float32)
+        q = rng.normal(size=(16, 48)).astype(np.float32)
+        mask = np.ones(2500, bool)
+        mask[::3] = False
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        ix = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=24,
+                                                    dtype="int8"))
+        sp = ivf_flat.SearchParams(n_probes=6)
+        d0, i0 = ivf_flat.search(ix, q, 9, sp, algo="pallas", filter=bs)
+        ivf_flat.prepare_host_stream(ix, budget_gb=100e3 / (1 << 30),
+                                     chunk_mb=0.1)
+        d1, i1 = ivf_flat.search(ix, q, 9, sp, algo="pallas", filter=bs)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    @pytest.mark.slow
+    @pytest.mark.faults
+    def test_guarded_fallback_serves(self):
+        # slow lane: the generic drift-guard drill (tests/test_quality)
+        # already exercises the ivf.host_stream breaker arc in tier-1;
+        # this adds the end-to-end served-results check
+        """An ivf.host_stream kernel failure falls back to the XLA
+        rescore of the same streamed chunk: same neighbor sets."""
+        if any(f.kind in ("kernel_compile", "kernel_fault")
+               for f in faults.active()):
+            pytest.skip("ambient kernel faults change demotion counts")
+        rng = np.random.default_rng(14)
+        x = rng.normal(size=(1500, 32)).astype(np.float32)
+        q = rng.normal(size=(12, 32)).astype(np.float32)
+        ix = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=16))
+        sp = ivf_flat.SearchParams(n_probes=5)
+        d0, i0 = ivf_flat.search(ix, q, 8, sp, algo="pallas")
+        ivf_flat.prepare_host_stream(ix, budget_gb=80e3 / (1 << 30),
+                                     chunk_mb=0.1)
+        guarded.reset()
+        try:
+            with faults.inject("kernel_fault", "ivf.host_stream"):
+                d1, i1 = ivf_flat.search(ix, q, 8, sp, algo="pallas")
+            assert "ivf.host_stream" in guarded.demoted_sites()
+        finally:
+            guarded.reset()
+        # the fallback's arithmetic differs from the kernel's; the
+        # neighbor SETS must not (distinct-valued corpus)
+        for a, b in zip(np.asarray(i0), np.asarray(i1)):
+            assert set(a.tolist()) == set(b.tolist())
+
+
+# ------------------------------------------------------------ ops surface
+class TestMemz:
+    def test_memz_components_and_strict_json(self):
+        from raft_tpu.serve import debugz, quality
+
+        rng = np.random.default_rng(15)
+        x = rng.normal(size=(400, 64)).astype(np.float32)
+        ci = cagra.build(x, cagra.IndexParams(
+            graph_degree=12, intermediate_graph_degree=16))
+        cagra.prepare_traversal(ci, "pq")
+        fi = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=8))
+        ivf_flat.prepare_host_stream(fi, budget_gb=30e3 / (1 << 30))
+        quality.watch_index("memz_cagra", ci)
+        quality.watch_index("memz_flat", fi)
+        try:
+            snap = debugz.snapshot()
+            mz = snap["memz"]
+            assert mz["memz_cagra"]["components"]["pq_codes"] > 0
+            assert mz["memz_cagra"]["bytes_per_vector"] > 0
+            assert mz["memz_flat"]["host_stream"]["cold_lists"] > 0
+            # host-streamed bytes_per_vector divides by ALL answered
+            # rows, cold included
+            assert mz["memz_flat"]["n_total"] == 400
+            json.loads(json.dumps(snap, allow_nan=False))
+            text = debugz.render_text()
+            assert "-- memz (device bytes) --" in text
+            assert "host tier" in text
+        finally:
+            quality.unwatch_index("memz_cagra")
+            quality.unwatch_index("memz_flat")
+
+
+# -------------------------------------------------------- durations guard
+class TestDurationsGuard:
+    def _write_log(self, path, entries):
+        lines = ["== slowest durations ==\n"]
+        for secs, phase, tid in entries:
+            lines.append(f"{secs:.2f}s {phase:<8} {tid}\n")
+        path.write_text("".join(lines))
+
+    def test_flags_untouched_regressions_only(self, tmp_path):
+        import sys
+        sys.path.insert(0, "scratch")
+        try:
+            import check_tier1_durations as guard
+        finally:
+            sys.path.pop(0)
+        log = tmp_path / "t1.log"
+        base = tmp_path / "base.json"
+        self._write_log(log, [(10.0, "call", "tests/test_a.py::t1"),
+                              (2.0, "call", "tests/test_b.py::t2"),
+                              (5.0, "setup", "tests/test_a.py::t1")])
+        assert guard.main(["--log", str(log), "--baseline", str(base),
+                           "--update"]) == 0
+        saved = json.loads(base.read_text())
+        assert saved == {"tests/test_a.py::t1": 10.0,
+                         "tests/test_b.py::t2": 2.0}   # call phases only
+        # same durations: OK
+        assert guard.main(["--log", str(log), "--baseline", str(base),
+                           "--no-git"]) == 0
+        # +30% and +3s on an untouched test: flagged
+        self._write_log(log, [(13.0, "call", "tests/test_a.py::t1"),
+                              (2.0, "call", "tests/test_b.py::t2")])
+        assert guard.main(["--log", str(log), "--baseline", str(base),
+                           "--no-git"]) == 1
+        # +30% but under the absolute floor: noise, not a flag
+        self._write_log(log, [(10.0, "call", "tests/test_a.py::t1"),
+                              (2.6, "call", "tests/test_b.py::t2")])
+        assert guard.main(["--log", str(log), "--baseline", str(base),
+                           "--no-git"]) == 0
