@@ -1,0 +1,224 @@
+"""Machine-checked TPU invariants: the static-analysis gate
+(docs/analysis.md).
+
+Every flagship kernel in this tree — the ring merge, the CAGRA
+megakernel, the int4/pq edge stores, the host-stream overlap — is
+interpret-validated only and has never compiled on a real TPU
+(ROADMAP "Hardware-gated verdicts"), while the Mosaic rules that decide
+whether they WILL compile lived only in code comments and reviewer
+memory; meanwhile four of the last six PRs shipped review-caught lock
+races in ``serve/``. This package encodes those invariants as three
+static passes that fail the suite (``tests/test_analysis.py``):
+
+* :mod:`~raft_tpu.analysis.kernel_audit` — a registry of every
+  ``pallas_call`` site with jaxpr-structural checks: VMEM footprint vs
+  a per-generation budget, tiling/lane alignment, fragile primitives
+  (``pltpu.repeat``, sub-128-lane reshapes), DMA/semaphore pairing.
+* :mod:`~raft_tpu.analysis.hotpath_audit` — serving hot-path audits:
+  no host callbacks in a searcher jaxpr, no unconditional
+  ``block_until_ready``/``device_get`` outside sampled probes, and a
+  recompile-hazard lint over ``jax.jit`` statics.
+* :mod:`~raft_tpu.analysis.lock_lint` — lock discipline over ``serve/``,
+  ``neighbors/mutable.py`` and ``ops/guarded.py``: infer each class's
+  lock-guarded attribute set and flag accesses outside a lock hold.
+
+All passes are AST/jaxpr only — tracing, never compiling or running
+device code — so the whole suite stays tier-1 cheap. Known findings
+live in the checked-in ``baseline.json`` (zero-NEW-findings policy);
+intentional patterns carry an inline escape hatch::
+
+    some_racy_read  # lint: waive(unlocked-attr): GIL-atomic int, hot path
+
+A waiver must name the rule and a reason; it covers its own line and
+the line below (waiver-above-statement style).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["Finding", "run_all", "load_baseline", "compare",
+           "baseline_path", "repo_root", "apply_waivers", "waivers_in",
+           "KNOWN_RULES"]
+
+_WAIVE_RE = re.compile(r"#\s*lint:\s*waive\(([\w.-]+)\)\s*:\s*\S")
+
+# every rule id, by the pass that emits it — the waiver sweep in
+# tests/test_analysis.py rejects waivers naming anything else (a typo'd
+# waiver that never fires is worse than no waiver), and partial CLI runs
+# compare only against the selected passes' slice of the baseline
+PASS_RULES = {
+    "kernel": frozenset({
+        "vmem-budget", "lane-misaligned", "sublane-misaligned",
+        "fragile-repeat", "fragile-reshape", "dma-unwaited",
+        "sem-unpaired", "trace-failed"}),
+    "hotpath": frozenset({
+        "hotpath-sync", "hotpath-callback", "jit-static-float",
+        "jit-static-missing"}),
+    "lock": frozenset({"unlocked-attr"}),
+}
+KNOWN_RULES = frozenset().union(*PASS_RULES.values())
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation. ``key`` (rule::path::symbol) is the stable
+    identity the baseline stores — line numbers drift, symbols don't."""
+
+    rule: str       # e.g. "vmem-budget", "unlocked-attr"
+    path: str       # repo-relative source path
+    symbol: str     # stable anchor: site/variant, Class.attr, func name
+    message: str    # human-facing: what is wrong and why it matters
+    line: int = 0   # best-effort source line (0 = site-level)
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}::{self.path}::{self.symbol}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"[{self.rule}] {loc} ({self.symbol}): {self.message}"
+
+
+def repo_root() -> str:
+    """The directory holding the ``raft_tpu`` package."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def iter_module_paths(root: str, entries: Iterable[str]) -> List[str]:
+    """Repo-relative ``.py`` paths for a tuple of module/directory
+    entries; directories are scanned RECURSIVELY (a future subpackage
+    under serve/ must not silently drop out of a pass)."""
+    out: List[str] = []
+    for entry in entries:
+        full = os.path.join(root, entry)
+        if os.path.isdir(full):
+            for dirpath, _dirs, files in os.walk(full):
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.relpath(
+                            os.path.join(dirpath, f), root))
+        elif os.path.exists(full):
+            out.append(entry)
+    return out
+
+
+def waivers_in(src: str) -> Dict[int, set]:
+    """``# lint: waive(<rule>): <reason>`` comments → {line: {rules}}.
+    A waiver covers its own line and the next line (comment-above)."""
+    out: Dict[int, set] = {}
+    for i, text in enumerate(src.splitlines(), start=1):
+        for m in _WAIVE_RE.finditer(text):
+            out.setdefault(i, set()).add(m.group(1))
+    return out
+
+
+def apply_waivers(findings: Iterable[Finding],
+                  root: Optional[str] = None) -> List[Finding]:
+    """Drop findings whose (rule, line) is covered by an inline waiver
+    in their source file. Site-level findings (line 0) cannot be waived
+    inline — baseline them instead."""
+    root = root or repo_root()
+    cache: Dict[str, Dict[int, set]] = {}
+    kept = []
+    for f in findings:
+        if f.line:
+            if f.path not in cache:
+                try:
+                    with open(os.path.join(root, f.path)) as fh:
+                        cache[f.path] = waivers_in(fh.read())
+                except OSError:
+                    cache[f.path] = {}
+            w = cache[f.path]
+            if (f.rule in w.get(f.line, ()) or
+                    f.rule in w.get(f.line - 1, ())):
+                continue
+        kept.append(f)
+    return kept
+
+
+def _dedupe(findings: Iterable[Finding]) -> List[Finding]:
+    seen, out = set(), []
+    for f in findings:
+        if f.key not in seen:
+            seen.add(f.key)
+            out.append(f)
+    return out
+
+
+def run_all(root: Optional[str] = None,
+            passes: Tuple[str, ...] = ("kernel", "hotpath", "lock"),
+            kernel_reports: Optional[list] = None) -> List[Finding]:
+    """Run the selected passes and return the de-duplicated, waiver-
+    filtered findings, sorted by key (the suite's input).
+    ``kernel_reports``: optional list the kernel pass appends its
+    per-variant :class:`~.kernel_audit.SiteReport` structures to (the
+    CLI's --json payload)."""
+    from . import hotpath_audit, kernel_audit, lock_lint
+
+    root = root or repo_root()
+    findings: List[Finding] = []
+    if "kernel" in passes:
+        findings += kernel_audit.run(root, collect_reports=kernel_reports)
+    if "hotpath" in passes:
+        findings += hotpath_audit.run(root)
+    if "lock" in passes:
+        findings += lock_lint.run(root)
+    return sorted(_dedupe(apply_waivers(findings, root)),
+                  key=lambda f: f.key)
+
+
+def merged_baseline_keys(findings: Iterable[Finding],
+                         passes: Optional[Tuple[str, ...]] = None
+                         ) -> List[str]:
+    """Baseline keys for a rebaseline: this run's findings, PLUS — when
+    only a subset of passes ran — the existing baseline entries owned by
+    the passes that did NOT run (a lock-only rebaseline must not wipe
+    the kernel audit's entries)."""
+    keys = {f.key for f in findings}
+    if passes is not None:
+        selected = frozenset().union(
+            *(PASS_RULES[p] for p in passes if p in PASS_RULES))
+        keys |= {k for k in load_baseline()
+                 if k.split("::", 1)[0] not in selected}
+    return sorted(keys)
+
+
+def load_baseline(path: Optional[str] = None) -> List[str]:
+    path = path or baseline_path()
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    return list(data.get("findings", []))
+
+
+def compare(findings: Iterable[Finding],
+            baseline: Optional[Iterable[str]] = None,
+            passes: Optional[Tuple[str, ...]] = None) -> dict:
+    """Zero-new-findings verdict: ``new`` must be empty for the suite to
+    pass; ``stale`` (baselined but no longer firing) is the prune list —
+    shrink the baseline whenever a fix lands. ``passes``: when only a
+    subset ran, compare against that subset's slice of the baseline
+    (other passes' entries are neither stale nor matched)."""
+    base = set(load_baseline() if baseline is None else baseline)
+    if passes is not None:
+        rules = frozenset().union(
+            *(PASS_RULES[p] for p in passes if p in PASS_RULES))
+        base = {k for k in base if k.split("::", 1)[0] in rules}
+    cur = {f.key: f for f in findings}
+    return {
+        "new": sorted(k for k in cur if k not in base),
+        "stale": sorted(k for k in base if k not in cur),
+        "baselined": sorted(k for k in cur if k in base),
+        "count": len(cur),
+    }

@@ -1,0 +1,368 @@
+"""Pass 3: lock discipline over the threaded serving state.
+
+Four of the last six PRs shipped review-caught races of exactly one
+shape: a class serializes its mutations under ``self._lock``, then some
+other method touches the same attribute lock-free (the SLOEngine
+double-fire, the breaker probing flag, the mutable ``_merging`` clear,
+``ops_snapshot`` copies). This pass mechanizes the reviewer: for every
+class in the scanned modules it **infers the lock-guarded attribute
+set** — attributes *written* under a ``with self.<lock>:`` hold (or
+inside a ``*_locked``-suffixed method, the tree's caller-holds-the-lock
+convention) in any method other than ``__init__`` — and flags any read
+or write of those attributes outside a lock hold (rule
+``unlocked-attr``).
+
+Module-level state gets the same treatment: globals *mutated* under a
+``with <module lock>:`` hold (assignment, subscript store, or a
+mutating method call — ``append``/``clear``/``pop``/...) are guarded,
+and any access outside a hold in the same module is flagged.
+
+Scope and conventions:
+
+* ``__init__`` is exempt (construction is single-threaded by contract),
+  as are the lock attributes themselves.
+* A ``*_locked``-suffixed method asserts "caller holds the lock": its
+  body counts as locked. The flip side is NOT yet linted (calling a
+  ``_locked`` helper without the lock) — keep the suffix honest.
+* Nested ``def``/``lambda`` bodies reset to unlocked (they run later,
+  when the ``with`` has exited).
+* Deliberate lock-free reads (GIL-atomic scalar peeks on hot paths)
+  carry an inline ``# lint: waive(unlocked-attr): <reason>`` with the
+  justification — the waiver is the documentation.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding
+
+__all__ = ["run", "lint_source", "LOCK_MODULES"]
+
+LOCK_MODULES = (
+    "raft_tpu/serve",
+    "raft_tpu/neighbors/mutable.py",
+    "raft_tpu/ops/guarded.py",
+)
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_MUTATORS = {"append", "appendleft", "add", "clear", "pop", "popleft",
+             "remove", "discard", "update", "setdefault", "extend",
+             "insert", "rotate"}
+
+
+def _lock_ctor(call: ast.AST) -> bool:
+    return (isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr in _LOCK_CTORS
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id == "threading")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+# access record: (attr, line, is_write, locked, method)
+_Access = Tuple[str, int, bool, bool, str]
+
+
+class _ClassScan:
+    """Accesses to ``self.<attr>`` across one class, lock-hold aware."""
+
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        self.lock_attrs: Set[str] = set()
+        self.accesses: List[_Access] = []
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and _lock_ctor(node.value):
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr:
+                        self.lock_attrs.add(attr)
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_method(item)
+
+    def _is_lock_with(self, item: ast.withitem) -> bool:
+        attr = _self_attr(item.context_expr)
+        return attr is not None and attr in self.lock_attrs
+
+    def _scan_method(self, fn: ast.FunctionDef) -> None:
+        base_locked = fn.name.endswith("_locked")
+        self._scan_node(fn.body, base_locked, fn.name)
+
+    def _scan_node(self, body, locked: bool, method: str) -> None:
+        for node in body:
+            self._scan_stmt(node, locked, method)
+
+    def _scan_stmt(self, node: ast.AST, locked: bool,
+                   method: str) -> None:
+        if isinstance(node, ast.With):
+            holds = any(self._is_lock_with(i) for i in node.items)
+            for i in node.items:
+                self._scan_expr(i.context_expr, locked, method)
+            self._scan_node(node.body, locked or holds, method)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def runs later, outside the hold
+            self._scan_node(node.body, False, f"{method}.{node.name}")
+            return
+        if isinstance(node, ast.Lambda):
+            self._scan_expr(node.body, False, method)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                self._record_target(tgt, locked, method)
+            value = node.value
+            if value is not None:
+                self._scan_expr(value, locked, method)
+            if isinstance(node, ast.AugAssign):
+                attr = _self_attr(node.target)
+                if attr:
+                    self.accesses.append((attr, node.lineno, True,
+                                          locked, method))
+            return
+        if isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                self._record_target(tgt, locked, method)
+            return
+        # generic: recurse into child statements/expressions
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._scan_stmt(child, locked, method)
+            elif isinstance(child, ast.expr):
+                self._scan_expr(child, locked, method)
+
+    def _record_target(self, tgt: ast.AST, locked: bool,
+                       method: str) -> None:
+        attr = _self_attr(tgt)
+        if attr:
+            self.accesses.append((attr, tgt.lineno, True, locked, method))
+            return
+        if isinstance(tgt, (ast.Subscript, ast.Attribute)):
+            attr = _self_attr(tgt.value) if isinstance(
+                tgt, ast.Subscript) else None
+            if attr:
+                # self._x[k] = v mutates self._x
+                self.accesses.append((attr, tgt.lineno, True, locked,
+                                      method))
+                return
+        for child in ast.iter_child_nodes(tgt):
+            if isinstance(child, ast.expr):
+                self._record_target(child, locked, method)
+
+    def _scan_expr(self, node: ast.AST, locked: bool,
+                   method: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._scan_node(node.body, False, method)
+            return
+        if isinstance(node, ast.Lambda):
+            self._scan_expr(node.body, False, method)
+            return
+        if isinstance(node, ast.Call):
+            # self._x.append(v): a mutation of self._x
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                attr = _self_attr(f.value)
+                if attr:
+                    self.accesses.append((attr, node.lineno, True,
+                                          locked, method))
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self._scan_expr(child, locked, method)
+            return
+        attr = _self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):
+            self.accesses.append((attr, node.lineno, False, locked,
+                                  method))
+            # do not also record `self` below
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, locked, method)
+            elif isinstance(child, ast.stmt):
+                self._scan_stmt(child, locked, method)
+
+    # ---- verdicts -------------------------------------------------------
+    def guarded_attrs(self) -> Set[str]:
+        return {a for a, _ln, w, locked, m in self.accesses
+                if w and locked and m != "__init__"} - self.lock_attrs
+
+    def violations(self, waived=None) -> List[Tuple[str, int, bool, str]]:
+        """``waived``: optional ``line -> {rules}`` map (``waivers_in``)
+        applied BEFORE deduplication — a waived first access must not
+        suppress a later unwaived access to the same attribute."""
+        guarded = self.guarded_attrs()
+        out = []
+        seen = set()
+        for attr, line, write, locked, method in self.accesses:
+            if attr not in guarded or locked:
+                continue
+            # a *_locked method's direct body is recorded locked=True
+            # already; anything here with locked=False inside one is a
+            # nested def/lambda that runs later, OFF the lock — flag it
+            if method.split(".")[0] == "__init__":
+                continue
+            if waived is not None and _is_waived(waived, line):
+                continue
+            key = (method, attr, write)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append((attr, line, write, method))
+        return out
+
+
+class _ModuleScan:
+    """Module-global form: locks at module scope, guarded globals."""
+
+    def __init__(self, tree: ast.Module):
+        self.lock_names: Set[str] = set()
+        self.module_names: Set[str] = set()
+        self.accesses: List[_Access] = []
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                if _lock_ctor(node.value):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.lock_names.add(tgt.id)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.module_names.add(tgt.id)
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                    node.target, ast.Name):
+                self.module_names.add(node.target.id)
+        if not self.lock_names:
+            return
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_node(node.body,
+                                node.name.endswith("_locked"), node.name)
+
+    def _is_lock_with(self, item: ast.withitem) -> bool:
+        c = item.context_expr
+        return isinstance(c, ast.Name) and c.id in self.lock_names
+
+    def _scan_node(self, body, locked: bool, fn: str) -> None:
+        for node in body:
+            self._scan_stmt(node, locked, fn)
+
+    def _scan_stmt(self, node, locked: bool, fn: str) -> None:
+        if isinstance(node, ast.With):
+            holds = any(self._is_lock_with(i) for i in node.items)
+            self._scan_node(node.body, locked or holds, fn)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._scan_node(node.body, False, f"{fn}.{node.name}")
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                base = tgt.value if isinstance(tgt, ast.Subscript) else tgt
+                if isinstance(base, ast.Name) \
+                        and base.id in self.module_names:
+                    self.accesses.append((base.id, node.lineno, True,
+                                          locked, fn))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._scan_stmt(child, locked, fn)
+            elif isinstance(child, ast.expr):
+                self._scan_expr(child, locked, fn)
+
+    def _scan_expr(self, node, locked: bool, fn: str) -> None:
+        if isinstance(node, ast.Lambda):
+            self._scan_expr(node.body, False, fn)
+            return
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr in _MUTATORS
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in self.module_names):
+                self.accesses.append((f.value.id, node.lineno, True,
+                                      locked, fn))
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in self.module_names:
+            self.accesses.append((node.id, node.lineno, False, locked, fn))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, locked, fn)
+
+    def guarded_names(self) -> Set[str]:
+        return {a for a, _ln, w, locked, _f in self.accesses
+                if w and locked} - self.lock_names
+
+    def violations(self, waived=None) -> List[Tuple[str, int, bool, str]]:
+        guarded = self.guarded_names()
+        out, seen = [], set()
+        for name, line, write, locked, fn in self.accesses:
+            if name not in guarded or locked:
+                continue
+            if waived is not None and _is_waived(waived, line):
+                continue
+            key = (fn, name, write)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append((name, line, write, fn))
+        return out
+
+
+def _is_waived(waived: dict, line: int) -> bool:
+    return ("unlocked-attr" in waived.get(line, ())
+            or "unlocked-attr" in waived.get(line - 1, ()))
+
+
+def lint_source(src: str, rel_path: str) -> List[Finding]:
+    """Lint one module's source. Waivers are honoured access-by-access
+    BEFORE the per-(method, attr) dedupe, so a waived peek cannot
+    shadow a later unwaived access. Exposed for the injected-violation
+    fixture tests."""
+    from . import waivers_in
+
+    waived = waivers_in(src)
+    tree = ast.parse(src)
+    findings: List[Finding] = []
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        scan = _ClassScan(node)
+        if not scan.lock_attrs:
+            continue
+        for attr, line, write, method in scan.violations(waived):
+            kind = "write" if write else "read"
+            findings.append(Finding(
+                "unlocked-attr", rel_path,
+                f"{node.name}.{method}.{attr}",
+                f"{kind} of lock-guarded attribute '{attr}' outside a "
+                f"'with self._lock' hold in {node.name}.{method}() — "
+                "the bug class behind the SLO double-fire / breaker "
+                "probing / _merging races", line))
+    mod = _ModuleScan(tree)
+    if mod.lock_names:
+        for name, line, write, fn in mod.violations(waived):
+            kind = "write" if write else "read"
+            findings.append(Finding(
+                "unlocked-attr", rel_path, f"module.{fn}.{name}",
+                f"{kind} of lock-guarded module global '{name}' outside "
+                f"a lock hold in {fn}()", line))
+    return findings
+
+
+def run(root: str) -> List[Finding]:
+    from . import iter_module_paths
+
+    findings: List[Finding] = []
+    for rel in iter_module_paths(root, LOCK_MODULES):
+        with open(os.path.join(root, rel)) as f:
+            src = f.read()
+        findings += lint_source(src, rel.replace(os.sep, "/"))
+    return findings

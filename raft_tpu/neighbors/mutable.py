@@ -235,19 +235,23 @@ class MutableIndex:
             expects(np.unique(sids).size == n, "ids must be unique")
         expects(n == 0 or (sids.min() >= 0 and sids.max() < 2 ** 31),
                 "external ids must fit int32")
-        self._install_sealed(
-            self._build_segment(vecs) if n else None, sids, vecs)
-        self._next_id = int(sids.max()) + 1 if n else 0
-        self._gen = 1
-        self._epoch = 1
-        if self._sealed is not None:
-            self._save_segment(self._gen)
-        self._save_snapshot(self._gen)
-        w = wal_mod.WriteAheadLog.create(
-            os.path.join(self.path, self._wal_name(self._epoch)))
-        self._wal = w
-        self._wal_names = [self._wal_name(self._epoch)]
-        self._save_manifest()
+        # construction is single-threaded, but the helpers below follow
+        # the *_locked caller-holds-the-lock convention — hold the
+        # (reentrant) serve lock so the discipline is uniform
+        with self._lock:
+            self._install_sealed_locked(
+                self._build_segment(vecs) if n else None, sids, vecs)
+            self._next_id = int(sids.max()) + 1 if n else 0
+            self._gen = 1
+            self._epoch = 1
+            if self._sealed is not None:
+                self._save_segment_locked(self._gen)
+            self._save_snapshot(self._gen)
+            w = wal_mod.WriteAheadLog.create(
+                os.path.join(self.path, self._wal_name(self._epoch)))
+            self._wal = w
+            self._wal_names = [self._wal_name(self._epoch)]
+            self._save_manifest_locked()
         return self
 
     @classmethod
@@ -267,49 +271,55 @@ class MutableIndex:
         fparams = json.loads(meta.get("family_params", "{}"))
         self = cls(path, meta["family"], DistanceType(meta["metric"]),
                    meta["dim"], fparams)
-        self._gen = int(meta["generation"])
-        self._epoch = int(meta["epoch"])
-        self._next_id = int(meta["next_id"])
-        # snapshot: the merge-source corpus + external ids
-        _, _, smeta, arrs = load_arrays(
-            os.path.join(path, meta["snapshot"]), "mutable_snapshot")
-        vecs = np.asarray(arrs["corpus"], np.float32).reshape(-1, self.dim)
-        sids = np.asarray(arrs["ids"], np.int64)
-        sealed = None
-        rebuilt = False
-        if meta["segment"]:
-            try:
-                sealed = self._load_segment(meta["segment"])
-            except CorruptIndexError:
-                # the segment is derived state — the snapshot corpus is
-                # the durable source of truth, so rebuild instead of
-                # refusing to serve
-                sealed = self._build_segment(vecs) if len(vecs) else None
-                rebuilt = True
-        self._install_sealed(sealed, sids, vecs)
-        # WAL chain: every log the manifest references, oldest first;
-        # only the LAST may carry a torn in-flight append
-        self._wal_names = json.loads(meta["wals"])
-        replayed = 0
-        truncated = 0
-        for i, wname in enumerate(self._wal_names):
-            last = i == len(self._wal_names) - 1
-            records, cut = wal_mod.replay(
-                os.path.join(path, wname), repair=last,
-                allow_torn_tail=last)
-            truncated += cut
-            for kind, rids, rvecs in records:
-                if kind == "upsert":
-                    self._apply_upsert(rids, rvecs)
-                else:
-                    self._apply_delete(rids)
-                replayed += 1
-        self._wal = wal_mod.WriteAheadLog.open(
-            os.path.join(path, self._wal_names[-1]))
-        if rebuilt and self._sealed is not None:
-            self._save_segment(self._gen)
+        # single-threaded construction, but the *_locked helpers assert
+        # caller-holds-the-lock — hold the reentrant serve lock
+        with self._lock:
+            self._gen = int(meta["generation"])
+            self._epoch = int(meta["epoch"])
+            self._next_id = int(meta["next_id"])
+            # snapshot: the merge-source corpus + external ids
+            _, _, smeta, arrs = load_arrays(
+                os.path.join(path, meta["snapshot"]), "mutable_snapshot")
+            vecs = np.asarray(arrs["corpus"],
+                              np.float32).reshape(-1, self.dim)
+            sids = np.asarray(arrs["ids"], np.int64)
+            sealed = None
+            rebuilt = False
+            if meta["segment"]:
+                try:
+                    sealed = self._load_segment(meta["segment"])
+                except CorruptIndexError:
+                    # the segment is derived state — the snapshot corpus
+                    # is the durable source of truth, so rebuild instead
+                    # of refusing to serve
+                    sealed = (self._build_segment(vecs) if len(vecs)
+                              else None)
+                    rebuilt = True
+            self._install_sealed_locked(sealed, sids, vecs)
+            # WAL chain: every log the manifest references, oldest
+            # first; only the LAST may carry a torn in-flight append
+            self._wal_names = json.loads(meta["wals"])
+            replayed = 0
+            truncated = 0
+            for i, wname in enumerate(self._wal_names):
+                last = i == len(self._wal_names) - 1
+                records, cut = wal_mod.replay(
+                    os.path.join(path, wname), repair=last,
+                    allow_torn_tail=last)
+                truncated += cut
+                for kind, rids, rvecs in records:
+                    if kind == "upsert":
+                        self._apply_upsert_locked(rids, rvecs)
+                    else:
+                        self._apply_delete_locked(rids)
+                    replayed += 1
+            self._wal = wal_mod.WriteAheadLog.open(
+                os.path.join(path, self._wal_names[-1]))
+            if rebuilt and self._sealed is not None:
+                self._save_segment_locked(self._gen)
+            gen = self._gen
         self._housekeep(meta)
-        self._event("wal_recovered", generation=self._gen,
+        self._event("wal_recovered", generation=gen,
                     records=replayed, truncated_bytes=truncated,
                     segment_rebuilt=rebuilt)
         self._count("mutable.recoveries")
@@ -325,7 +335,7 @@ class MutableIndex:
     def _snap_name(self, gen: int) -> str:
         return f"snapshot-{gen:06d}.idx"
 
-    def _save_segment(self, gen: int) -> None:
+    def _save_segment_locked(self, gen: int) -> None:
         self._mod.save(self._sealed, os.path.join(self.path,
                                                   self._seg_name(gen)))
 
@@ -336,13 +346,17 @@ class MutableIndex:
         return self._mod.load(os.path.join(self.path, name))
 
     def _save_snapshot(self, gen: int, vecs=None, sids=None) -> None:
+        if vecs is None:
+            # defaulted only from locked/construction callers; the
+            # off-lock merge path always passes its snapshot explicitly
+            # lint: waive(unlocked-attr): locked/construction callers only
+            vecs, sids = self._sealed_vecs, self._sealed_ids
         save_arrays(
             os.path.join(self.path, self._snap_name(gen)),
             "mutable_snapshot", _SERIAL_VERSION, {"generation": gen},
-            {"corpus": (self._sealed_vecs if vecs is None else vecs),
-             "ids": (self._sealed_ids if sids is None else sids)})
+            {"corpus": vecs, "ids": sids})
 
-    def _save_manifest(self, gen: Optional[int] = None) -> None:
+    def _save_manifest_locked(self, gen: Optional[int] = None) -> None:
         g = self._gen if gen is None else gen
         save_arrays(
             os.path.join(self.path, _MANIFEST), "mutable_manifest",
@@ -421,19 +435,23 @@ class MutableIndex:
                                self.metric, seeds)
         return mod.build(vecs, p)
 
-    def _warm_graph(self, new_ids: np.ndarray) -> Optional[np.ndarray]:
+    def _warm_graph(self, new_ids: np.ndarray, sealed,
+                    sealed_ids: np.ndarray) -> Optional[np.ndarray]:
         """Old sealed CAGRA graph remapped into new-slot space for the
         nn_descent warm start: surviving neighbors keep their edges,
-        dead/unknown targets fall back to uniform random new slots."""
-        if self.family != "cagra" or self._sealed is None:
+        dead/unknown targets fall back to uniform random new slots.
+        ``sealed``/``sealed_ids`` are the caller's under-lock snapshot —
+        this runs off-lock on the merge thread and must not re-read the
+        live attributes."""
+        if self.family != "cagra" or sealed is None:
             return None
-        g = np.asarray(self._sealed.graph)
+        g = np.asarray(sealed.graph)
         n_new = len(new_ids)
         if n_new < 2:
             return None
         slot2 = {int(e): s for s, e in enumerate(new_ids)}
-        old2new = np.full(len(self._sealed_ids), -1, np.int32)
-        for old_slot, ext in enumerate(self._sealed_ids):
+        old2new = np.full(len(sealed_ids), -1, np.int32)
+        for old_slot, ext in enumerate(sealed_ids):
             s = slot2.get(int(ext))
             if s is not None:
                 old2new[old_slot] = s
@@ -447,7 +465,7 @@ class MutableIndex:
         # self-edges are dropped by the builder; good enough as seeds
         return warm
 
-    def _install_sealed(self, sealed, sids: np.ndarray,
+    def _install_sealed_locked(self, sealed, sids: np.ndarray,
                         vecs: np.ndarray) -> None:
         self._sealed = sealed
         self._sealed_ids = np.asarray(sids, np.int64)
@@ -459,7 +477,7 @@ class MutableIndex:
         self._sealed_cache = None
 
     # -- in-memory mutation application (shared by live path + replay) ----
-    def _ensure_delta_cap(self, need: int) -> None:
+    def _ensure_delta_cap_locked(self, need: int) -> None:
         cap = len(self._d_ids)
         if need <= cap:
             return
@@ -474,10 +492,10 @@ class MutableIndex:
         a[:self._d_n] = self._d_alive[:self._d_n]
         self._d_vecs, self._d_ids, self._d_alive = v, i, a
 
-    def _apply_upsert(self, ids: np.ndarray, vecs: np.ndarray) -> None:
+    def _apply_upsert_locked(self, ids: np.ndarray, vecs: np.ndarray) -> None:
         ids = np.asarray(ids, np.int64)
         vecs = np.asarray(vecs, np.float32)
-        self._ensure_delta_cap(self._d_n + len(ids))
+        self._ensure_delta_cap_locked(self._d_n + len(ids))
         for j, ext in enumerate(ids):
             ext = int(ext)
             slot = self._slot_of.get(ext)
@@ -501,7 +519,7 @@ class MutableIndex:
         self._sealed_cache = None
         self._delta_cache = None
 
-    def _apply_delete(self, ids: np.ndarray) -> int:
+    def _apply_delete_locked(self, ids: np.ndarray) -> int:
         ids = np.asarray(ids, np.int64)
         found = 0
         for ext in ids:
@@ -551,7 +569,7 @@ class MutableIndex:
                         or (ids.min() >= 0 and ids.max() < 2 ** 31),
                         "external ids must fit int32")
             self._wal.append("upsert", ids, vecs)   # durable before ack
-            self._apply_upsert(ids, vecs)
+            self._apply_upsert_locked(ids, vecs)
         self._event("upsert", rows=int(len(ids)),
                     delta_rows=self.delta_rows)
         self._count("mutable.upserts", int(len(ids)))
@@ -566,28 +584,37 @@ class MutableIndex:
         ids = np.atleast_1d(np.asarray(ids, np.int64))
         with self._lock:
             self._wal.append("delete", ids)
-            found = self._apply_delete(ids)
+            found = self._apply_delete_locked(ids)
         self._event("delete", rows=int(len(ids)), found=found,
                     tombstones=self.tombstones)
         self._count("mutable.deletes", int(len(ids)))
         return found
 
     # -- introspection ----------------------------------------------------
+    # The properties below are GIL-atomic single-reference/int peeks for
+    # the ops surfaces (events, debugz, should_merge's own locked read);
+    # a one-mutation-stale value is as good as a fresh one there, and
+    # they must stay callable from telemetry paths that already hold (or
+    # must never wait on) the serve lock.
     @property
     def sealed_index(self):
         """The live sealed family index (None before the first seal)."""
+        # lint: waive(unlocked-attr): atomic reference peek, ops surface
         return self._sealed
 
     @property
     def sealed_rows(self) -> int:
+        # lint: waive(unlocked-attr): atomic counter peek, ops surface
         return len(self._alive) - self._n_tomb
 
     @property
     def delta_rows(self) -> int:
+        # lint: waive(unlocked-attr): atomic counter peek, ops surface
         return self._d_live
 
     @property
     def tombstones(self) -> int:
+        # lint: waive(unlocked-attr): atomic counter peek, ops surface
         return self._n_tomb
 
     @property
@@ -597,6 +624,7 @@ class MutableIndex:
 
     @property
     def generation(self) -> int:
+        # lint: waive(unlocked-attr): atomic counter peek, ops surface
         return self._gen
 
     def wal_bytes(self) -> int:
@@ -604,7 +632,7 @@ class MutableIndex:
             return self._wal.size_bytes() if self._wal else 0
 
     # -- search -----------------------------------------------------------
-    def _sealed_view(self):
+    def _sealed_view_locked(self):
         """(index, filter bitset|None, ids_dev) under the lock; cached
         until a mutation or flip invalidates it."""
         if self._sealed is None or self.sealed_rows == 0:
@@ -616,7 +644,7 @@ class MutableIndex:
             self._sealed_cache = (self._sealed, filt, ids_dev)
         return self._sealed_cache
 
-    def _delta_view(self):
+    def _delta_view_locked(self):
         """(brute index over the capacity-padded delta, alive bitset,
         ids_dev, cap) — rebuilt only after a mutation, and shaped by the
         power-of-two capacity so repeated searches hit the same
@@ -660,8 +688,8 @@ class MutableIndex:
         expects(q.ndim == 2 and q.shape[1] == self.dim,
                 "queries must be (m, %d), got %s", self.dim, q.shape)
         with self._lock:
-            sview = self._sealed_view()
-            dview = self._delta_view()
+            sview = self._sealed_view_locked()
+            dview = self._delta_view_locked()
             # what a post-flip request will look like: _prewarm compiles
             # THIS executable (shape + params + engine opts) against the
             # replacement segment, so the flip costs zero compiles for
@@ -782,11 +810,15 @@ class MutableIndex:
         filter can appear — ``search`` rejects user filters, and a
         fresh merge has no tombstones, so the immediate post-flip trace
         carries filter=None exactly like this warmup."""
-        if self._last_shape is None:
+        with self._lock:
+            # one hold for BOTH: a racing request could otherwise leave
+            # a shape from one request paired with another's params
+            shape, request = self._last_shape, self._last_request
+        if shape is None:
             return
-        m, k = self._last_shape
+        m, k = shape
         k = min(k, max(1, index.size))
-        params, opts = self._last_request
+        params, opts = request
         opts = {kk: v for kk, v in opts.items() if kk != "res"}
         out = self._search_sealed(
             index, jnp.zeros((m, self.dim), jnp.float32), k, params,
@@ -857,7 +889,7 @@ class MutableIndex:
                     new_wal = wal_mod.WriteAheadLog.create(
                         os.path.join(self.path, new_wal_name))
                     self._wal_names = self._wal_names + [new_wal_name]
-                    self._save_manifest()        # still the OLD generation
+                    self._save_manifest_locked()        # still the OLD generation
                 except BaseException:
                     # rotation failed mid-way: roll the in-memory view
                     # back to what the on-disk manifest references
@@ -883,6 +915,9 @@ class MutableIndex:
                 ids = np.concatenate(
                     [self._sealed_ids[sa], self._d_ids[:watermark][da]])
                 gen0, gen2 = self._gen, self._gen + 1
+                # off-lock phases below must not re-read live sealed
+                # state: snapshot what the warm start needs here
+                sealed0, sealed_ids0 = self._sealed, self._sealed_ids
             self._event("merge_started", generation=gen0,
                         rows=int(len(ids)), delta_rows=int(da.sum()),
                         tombstones=self.tombstones)
@@ -890,7 +925,7 @@ class MutableIndex:
             if hook is not None:
                 hook()                    # test seam: mutate mid-merge
             faults.crash("mutable.merge.build")
-            warm = self._warm_graph(ids)
+            warm = self._warm_graph(ids, sealed0, sealed_ids0)
             new_sealed = (self._build_segment(vecs, warm=warm)
                           if len(vecs) else None)
             self._check_deadline(t0, deadline_s, "build")
@@ -911,12 +946,12 @@ class MutableIndex:
                              self._seg_name(gen0), self._snap_name(gen0))
                 self._wal_names = self._wal_names[-1:]
                 self._gen = gen2
-                self._save_manifest()
+                self._save_manifest_locked()
             faults.crash("mutable.merge.post_flip")
             with self._lock:            # in-memory flip, under serve lock
                 during = self._during
                 self._during = []
-                self._install_sealed(new_sealed, ids, vecs)
+                self._install_sealed_locked(new_sealed, ids, vecs)
                 # re-apply mutations that raced the build: any touched
                 # id's new sealed slot is stale (delta has newer or it
                 # was deleted) — identical to what a WAL replay does
@@ -939,7 +974,7 @@ class MutableIndex:
                 self._d_row_of = {}
                 self._delta_cache = None
                 if len(tail_i):
-                    self._ensure_delta_cap(len(tail_i))
+                    self._ensure_delta_cap_locked(len(tail_i))
                     self._d_vecs[:len(tail_i)] = tail_v
                     self._d_ids[:len(tail_i)] = tail_i
                     self._d_alive[:len(tail_i)] = tail_a
@@ -977,6 +1012,7 @@ class MutableIndex:
             with self._lock:
                 self._merging = False
                 self._during = []
+                gen_now = self._gen
             if old_wal is not None:
                 # the rotated-out log stays ON DISK (the manifest still
                 # references it); only the handle closes — nothing will
@@ -988,7 +1024,7 @@ class MutableIndex:
             self._last_merge = {"verdict": "abandoned",
                                 "reason": f"{type(e).__name__}: {e}",
                                 "dur_s": round(self._clock() - t0, 3)}
-            self._event("merge_abandoned", generation=self._gen,
+            self._event("merge_abandoned", generation=gen_now,
                         error=e)
             self._count("mutable.merges.abandoned")
             if isinstance(e, faults.InjectedFault):

@@ -383,33 +383,12 @@ def one_dispatch_stats(fn, *args) -> dict:
     ``one_dispatch`` is True when no such loop remains — the whole
     search then lowers to one straight-line XLA executable, dispatched
     once per call (the bench serving lane and the one-dispatch test
-    read this)."""
-    jaxpr = jax.make_jaxpr(fn)(*args)
+    read this).
 
-    counts = {"pallas_calls": 0, "while_loops": 0, "scans": 0}
+    Since ISSUE 14 this is the thin public alias of the generalized
+    serving audit (:func:`raft_tpu.analysis.hotpath_audit.jaxpr_stats`),
+    which additionally reports host-callback primitives — one walker,
+    one definition of "a dispatch"."""
+    from ..analysis.hotpath_audit import jaxpr_stats
 
-    def _subjaxprs(params):
-        for v in params.values():
-            vals = v if isinstance(v, (tuple, list)) else (v,)
-            for x in vals:
-                if isinstance(x, jax.core.ClosedJaxpr):
-                    yield x.jaxpr
-                elif isinstance(x, jax.core.Jaxpr):
-                    yield x
-
-    def walk(jx):
-        for eqn in jx.eqns:
-            nm = eqn.primitive.name
-            if nm == "pallas_call":
-                counts["pallas_calls"] += 1
-                continue           # hop loops INSIDE a kernel are free
-            if nm == "while":
-                counts["while_loops"] += 1
-            elif nm == "scan":
-                counts["scans"] += 1
-            for sub in _subjaxprs(eqn.params):
-                walk(sub)
-
-    walk(jaxpr.jaxpr)
-    counts["one_dispatch"] = counts["while_loops"] == 0
-    return counts
+    return jaxpr_stats(fn, *args)

@@ -39,9 +39,24 @@ def make_cb_matrix(codebooks: jax.Array) -> jax.Array:
     """(pq_dim, book, pq_len) PER_SUBSPACE codebooks → block-structured
     (rot_dim_pad, pq_dim*book) matrix CB with
     CB[s*pq_len + l, b*pq_dim + s] = cb[s, b, l], so q_rot @ CB yields the
-    flat per-query LUT in one GEMM. The column layout matches
-    `pltpu.repeat`'s tiling (codes_rep[row, b*pq_dim + s] = codes[row, s]),
-    so no sub-lane reshapes or gathers happen in-kernel."""
+    flat per-query LUT in one GEMM — no sub-lane reshapes or gathers
+    in-kernel.
+
+    CAVEAT (the documented ``pltpu.repeat`` quirk): the kernel's one-hot
+    decode REQUIRES tiling semantics for the code expansion
+    (codes_rep[row, b*pq_dim + s] = codes[row, s], i.e. ``np.tile``) —
+    that is the layout this column order pairs with. On jax 0.4.37 the
+    CPU interpreter's ``pltpu.repeat`` is ELEMENT-wise instead
+    (``np.repeat``: codes_rep[row, i] = codes[row, i // book]), which
+    scrambles the one-hot for EVERY lut_mode — the real cause behind the
+    xfailed interpret-mode pallas/XLA parity tests (historically
+    mislabelled an "int8-LUT quirk"). The Mosaic lowering is believed to
+    tile but has never been validated on real TPU here; the first pod
+    session must pin which semantics hardware implements (the
+    analysis suite's ``fragile-repeat`` finding tracks this). The PQ
+    edge-store rung avoids the question entirely via the repeat-free
+    subspace-major one-hot (``ops.quant.pq_decode_table`` +
+    ``graph_expand.edge_tile_widen``)."""
     pq_dim, book, pq_len = codebooks.shape
     rot_dim = pq_dim * pq_len
     rot_pad = round_up_to(rot_dim, 128)
@@ -171,7 +186,11 @@ def _kernel_body(off, size, qb_ref, qn_ref, dn_ref, pen_ref,
     for c0 in range(0, lmax, chunk):
         cw = min(chunk, lmax - c0)
         codes_c = codes_vmem[c0 : c0 + cw, :pq_dim].astype(jnp.int32)
-        # pltpu.repeat tiles copies: codes_rep[r, b*pq_dim+s] = codes[r, s]
+        # ASSUMES tiling semantics (codes_rep[r, b*pq_dim+s] = codes[r, s])
+        # to pair with make_cb_matrix's column order. Interpret-mode
+        # repeat is element-wise on this jax, which breaks the one-hot
+        # below for every lut_mode (the xfailed interpret parity tests);
+        # unvalidated on real TPU — see the make_cb_matrix caveat.
         codes_rep = pltpu.repeat(codes_c, book, axis=1)  # (cw, pqb)
         j = jax.lax.broadcasted_iota(jnp.int32, (cw, pqb), 1)
         oh = (codes_rep == j // pq_dim).astype(lut_t)

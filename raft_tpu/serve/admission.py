@@ -135,10 +135,12 @@ class AdmissionQueue:
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        with self._lock:
+            return self._closed
 
     def __len__(self) -> int:
-        return len(self._items)
+        with self._lock:
+            return len(self._items)
 
     def submit(self, req: Request) -> None:
         """Enqueue or raise :class:`QueueFullError` (backpressure)."""

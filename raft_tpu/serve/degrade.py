@@ -113,8 +113,15 @@ class BrownoutController:
         self._transitions: collections.deque = collections.deque(maxlen=64)
 
     # -- hot-path reads ---------------------------------------------------
+    # These three run per request/batch on the serving path and read the
+    # current level lock-free by design: ``_level`` is a GIL-atomic int,
+    # ``_ladder`` is frozen after __init__, and a one-step-stale level is
+    # exactly as correct as a fresh one (the controller's own dwell is
+    # seconds). Taking the lock here would serialize every dispatch
+    # against the control loop for nothing.
     @property
     def level(self) -> int:
+        # lint: waive(unlocked-attr): GIL-atomic int peek, hot path
         return self._level
 
     def params(self, base):
@@ -122,6 +129,7 @@ class BrownoutController:
         dataclass (fields the class doesn't have are ignored — one
         ladder can serve several families). Returns ``base`` unchanged
         at level 0."""
+        # lint: waive(unlocked-attr): GIL-atomic int peek, hot path
         lv = self._ladder[self._level]
         if not lv or base is None:
             return base
@@ -133,6 +141,7 @@ class BrownoutController:
         """Batch max-wait multiplier at the current level (>= 1.0):
         under brownout the batcher coalesces harder — bigger batches,
         fewer dispatches — at the cost of queue wait."""
+        # lint: waive(unlocked-attr): GIL-atomic int peek, hot path
         return float(self._ladder[self._level].get("max_wait_scale", 1.0))
 
     # -- control loop -----------------------------------------------------
@@ -140,10 +149,12 @@ class BrownoutController:
         """Evaluate the attached SLO engine and act on its verdicts.
         Returns the engine report with ``brownout_level`` attached."""
         if self._slo is None:
-            return {"brownout_level": self._level}
+            with self._lock:
+                return {"brownout_level": self._level}
         report = self._slo.evaluate()
-        self.on_report(report)
-        report["brownout_level"] = self._level
+        # on_report returns the post-step level from under its own lock
+        # hold — re-reading self._level here could see a racing step
+        report["brownout_level"] = self.on_report(report)
         return report
 
     def on_report(self, report: dict) -> int:

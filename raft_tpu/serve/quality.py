@@ -135,7 +135,8 @@ class RecallSentinel:
 
     def start(self) -> "RecallSentinel":
         if self._thread is None and self._every:
-            self._stop = False
+            with self._cond:
+                self._stop = False
             self._thread = threading.Thread(
                 target=self._run, name=f"{self._name}-recall-sentinel",
                 daemon=True)
@@ -172,13 +173,17 @@ class RecallSentinel:
         self._tick += 1
         if (self._tick - 1) % self._every:
             return False
+        # GIL-atomic flag peek on the serving hot path; the locked
+        # re-check below stays authoritative.
+        # lint: waive(unlocked-attr): hot-path peek, locked re-check below
         if self._stop:
             return False
+        # pre-copy check: when the queue is already saturated, the
+        # dispatch thread must not pay the host copies just to drop
+        # them (the locked re-check below stays authoritative — this
+        # unlocked read only saves work, never admits past the bound)
+        # lint: waive(unlocked-attr): hot-path peek, locked re-check below
         if len(self._pending) >= self.max_pending:
-            # pre-copy check: when the queue is already saturated, the
-            # dispatch thread must not pay the host copies just to drop
-            # them (the locked re-check below stays authoritative — this
-            # unlocked read only saves work, never admits past the bound)
             self._dropped.inc()
             return False
         try:

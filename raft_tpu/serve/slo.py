@@ -136,14 +136,14 @@ class SLOEngine:
         loop can tick finer than it alerts)."""
         s = self._sample()
         with self._lock:
-            self._push(s)
+            self._push_locked(s)
 
-    def _push(self, s: dict) -> None:
+    def _push_locked(self, s: dict) -> None:
         self._history.append(s)
         if len(self._history) > self._history_cap:
             del self._history[: len(self._history) - self._history_cap]
 
-    def _baseline(self, now: float, window_s: float) -> dict:
+    def _baseline_locked(self, now: float, window_s: float) -> dict:
         """Latest sample at least ``window_s`` old; oldest sample when
         history is younger than the window."""
         base = self._history[0]
@@ -195,10 +195,10 @@ class SLOEngine:
             return self._evaluate_locked(cur)
 
     def _evaluate_locked(self, cur: dict) -> dict:
-        self._push(cur)
+        self._push_locked(cur)
         now = cur["t"]
-        fast = self._baseline(now, self.fast_window_s)
-        slow = self._baseline(now, self.slow_window_s)
+        fast = self._baseline_locked(now, self.fast_window_s)
+        slow = self._baseline_locked(now, self.slow_window_s)
         t = self.targets
         out: dict = {}
         if t.p99_latency_s is not None:

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Standalone runner for the raft_tpu static-analysis gate.
+
+The same three passes ``tests/test_analysis.py`` gates on —
+kernel_audit / hotpath_audit / lock_lint (docs/analysis.md) — runnable
+outside pytest so the pod session and CI can export findings and
+rebaseline without a test run (the scratch/check_tier1_durations.py
+pattern).
+
+Usage::
+
+    python scratch/run_analysis.py                    # human report
+    python scratch/run_analysis.py --json out.jsonl   # findings JSONL
+    python scratch/run_analysis.py --update-baseline  # rebaseline
+    python scratch/run_analysis.py --passes kernel    # one pass only
+
+Exit codes: 0 clean vs baseline, 1 new (or stale-baselined) findings,
+2 usage/environment error.
+
+``--json`` writes one JSON object per line: every finding (rule, path,
+symbol, line, message, baselined flag) followed by one ``kind:
+"kernel_report"`` line per audited kernel variant (VMEM footprint,
+grid, DMA counts) — the pod session diffs these against the
+interpret-trace expectations after the first real-TPU compile.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+# mirror tests/conftest.py: the ring-kernel variant traces under
+# shard_map on the virtual multi-device CPU mesh
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", metavar="PATH",
+                    help="export findings + kernel reports as JSONL")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite analysis/baseline.json from this run")
+    ap.add_argument("--passes", default="kernel,hotpath,lock",
+                    help="comma-separated subset of kernel,hotpath,lock")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")   # trace-only; never TPU
+
+    from raft_tpu import analysis
+
+    passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
+    bad = set(passes) - {"kernel", "hotpath", "lock"}
+    if bad:
+        print(f"unknown passes: {sorted(bad)}", file=sys.stderr)
+        return 2
+
+    reports: list = []
+    findings = analysis.run_all(passes=passes, kernel_reports=reports)
+    verdict = analysis.compare(findings, passes=passes)
+    base = set(analysis.load_baseline())
+
+    if args.json:
+        with open(args.json, "w") as f:
+            for fd in findings:
+                f.write(json.dumps({
+                    "kind": "finding", "rule": fd.rule, "path": fd.path,
+                    "symbol": fd.symbol, "line": fd.line,
+                    "message": fd.message,
+                    "baselined": fd.key in base}) + "\n")
+            for r in reports:
+                f.write(json.dumps(
+                    {"kind": "kernel_report",
+                     **dataclasses.asdict(r)}) + "\n")
+        print(f"wrote {len(findings)} findings + {len(reports)} kernel "
+              f"reports -> {args.json}")
+
+    if args.update_baseline:
+        # partial runs merge into (never wipe) the other passes' slice
+        keys = analysis.merged_baseline_keys(findings, passes)
+        with open(analysis.baseline_path(), "w") as f:
+            json.dump({
+                "findings": keys,
+                "policy": "zero NEW findings; prune stale keys when "
+                          "fixes land",
+                "note": "kernel-audit entries are pre-hardware warnings "
+                        "on interpret-only kernels; re-judge each on the "
+                        "first real-TPU session (ROADMAP 'Hardware-gated "
+                        "verdicts')"}, f, indent=1)
+            f.write("\n")
+        print(f"baseline updated: {len(keys)} findings -> "
+              f"{analysis.baseline_path()}")
+        return 0
+
+    by_key = {fd.key: fd for fd in findings}
+    for key in verdict["baselined"]:
+        print(f"BASELINED {by_key[key].render()}")
+    for key in verdict["new"]:
+        print(f"NEW       {by_key[key].render()}")
+    for key in verdict["stale"]:
+        print(f"STALE     {key}")
+    print(f"# {verdict['count']} findings over {len(reports)} audited "
+          f"kernel configs: {len(verdict['new'])} new, "
+          f"{len(verdict['baselined'])} baselined, "
+          f"{len(verdict['stale'])} stale baseline entries")
+    if verdict["new"] or verdict["stale"]:
+        print("FAIL: fix, waive with '# lint: waive(<rule>): <reason>', "
+              "or rerun with --update-baseline (see docs/analysis.md)",
+              file=sys.stderr)
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

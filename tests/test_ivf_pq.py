@@ -120,12 +120,14 @@ class TestIvfPq:
 
     @pytest.mark.xfail(
         strict=False, run=False,
-        reason="known pre-existing jax-0.4.37 failure (interpret-mode "
-               "int8-LUT quirk): the int8 LUT composed with pq_bits=4 "
-               "codebooks collapses recall to ~0 under the Pallas CPU "
-               "interpreter; passes on a real TPU lowering. run=False: "
-               "the failure is environment-pinned and the ~20s run only "
-               "burns the tight tier-1 budget")
+        reason="known jax-0.4.37 interpret divergence: pltpu.repeat is "
+               "ELEMENT-wise (np.repeat) under the CPU interpreter while "
+               "the ivf_pq one-hot decode requires tiling semantics "
+               "(see ivf_pq_scan.make_cb_matrix) — recall collapses for "
+               "every interpret lut_mode, most visibly here; expected to "
+               "pass on the Mosaic lowering (tiling), pending first "
+               "real-TPU validation. run=False: environment-pinned and "
+               "the ~20s run only burns the tight tier-1 budget")
     def test_int8_lut_pq_bits_4(self, dataset, queries):
         """int8 LUT composes with the 16-entry (pq_bits=4) codebooks."""
         index = ivf_pq.build(dataset, ivf_pq.IndexParams(

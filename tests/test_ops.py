@@ -144,11 +144,14 @@ class TestIvfScanParity:
 
     @pytest.mark.xfail(
         strict=False, run=False,
-        reason="known pre-existing jax-0.4.37 failure (interpret-mode "
-               "int8-LUT quirk): the pallas ivf_pq scan diverges from "
-               "the XLA twin under the CPU interpreter on this jax; "
-               "passes on a real TPU lowering. run=False: environment-"
-               "pinned, and the run only burns the tight tier-1 budget")
+        reason="known jax-0.4.37 interpret divergence: pltpu.repeat is "
+               "ELEMENT-wise (np.repeat) under the CPU interpreter while "
+               "the ivf_pq one-hot decode requires tiling semantics "
+               "(see ivf_pq_scan.make_cb_matrix), scrambling the decode "
+               "for every lut_mode; expected to pass on the Mosaic "
+               "lowering (tiling), pending first real-TPU validation. "
+               "run=False: environment-pinned, and the run only burns "
+               "the tight tier-1 budget")
     def test_ivf_pq_pallas_matches_xla(self):
         import jax.numpy as jnp
 
@@ -193,11 +196,14 @@ class TestIvfScanParity:
 
     @pytest.mark.xfail(
         strict=False, run=False,
-        reason="known pre-existing jax-0.4.37 failure (interpret-mode "
-               "int8-LUT quirk): the pallas ivf_pq scan diverges from "
-               "the XLA twin under the CPU interpreter on this jax; "
-               "passes on a real TPU lowering. run=False: environment-"
-               "pinned, and the run only burns the tight tier-1 budget")
+        reason="known jax-0.4.37 interpret divergence: pltpu.repeat is "
+               "ELEMENT-wise (np.repeat) under the CPU interpreter while "
+               "the ivf_pq one-hot decode requires tiling semantics "
+               "(see ivf_pq_scan.make_cb_matrix), scrambling the decode "
+               "for every lut_mode; expected to pass on the Mosaic "
+               "lowering (tiling), pending first real-TPU validation. "
+               "run=False: environment-pinned, and the run only burns "
+               "the tight tier-1 budget")
     def test_ivf_pq_pallas_filter_excludes(self):
         import jax.numpy as jnp
 
