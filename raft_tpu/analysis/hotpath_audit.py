@@ -46,8 +46,11 @@ CALLBACK_PRIMS = frozenset({
 })
 
 # the serving-reachable modules the sync lint scans: everything under
-# serve/ plus every module that defines a make_searcher closure (or is
-# dispatched from one)
+# serve/ — including tenancy.py (the fabric worker's dispatch/demux is
+# a serving hot path) and qcache.py (a cache hit runs on the submit
+# thread; test_analysis pins both into the scanned set) — plus every
+# module that defines a make_searcher closure (or is dispatched from
+# one)
 HOTPATH_MODULES = (
     "raft_tpu/serve",
     "raft_tpu/neighbors/brute_force.py",

@@ -41,6 +41,11 @@ from . import Finding
 __all__ = ["run", "lint_source", "LOCK_MODULES"]
 
 LOCK_MODULES = (
+    # the whole serve/ tree — including tenancy.py (the fabric's
+    # weighted drain + swap flip are exactly this lint's bug class)
+    # and qcache.py (LRU map under one lock); test_analysis pins both
+    # files into the scanned set so a future restructure can't
+    # silently drop them
     "raft_tpu/serve",
     "raft_tpu/neighbors/mutable.py",
     "raft_tpu/ops/guarded.py",

@@ -24,20 +24,29 @@ Event shape: ``{"seq", "ts", "kind", "site", "trace_id", ...details}``.
 ``trace_id`` is a string when exactly one trace was bound, a list when
 a multi-request batch was in flight, None outside any binding.
 
-Well-known kinds (open set — emitters define meaning):
-``guarded_demotion``, ``fault_injected``, ``deadline_shed``,
-``deadline_exceeded``, ``dispatch_error``, ``shard_marked``,
-``autotune_verdict``, ``xla_compile``, ``corrupt_index``,
-``recall_regression``, ``slo_breach`` — and the self-healing set
-(docs/robustness.md): ``breaker_open`` / ``breaker_probe`` /
-``breaker_close`` (ops/guarded circuit breakers), ``shard_restored``
-(sharded_ann.probe_shards), ``brownout`` (serve/degrade ladder moves),
-``fault_scenario`` (timed chaos-drill stage transitions) — and the
-mutable-tier set (docs/mutation.md, neighbors/mutable.py): ``upsert`` /
-``delete`` (one per mutation call, trace-stamped like every serving
-event), ``merge_started`` / ``merge_committed`` / ``merge_abandoned``
-(the background-merge state machine), ``wal_recovered`` (a
-``recover()`` replay, with record/truncation counts).
+Well-known kinds (the :data:`WELL_KNOWN_KINDS` registry below — an
+open set, emitters define meaning; the telemetry drift guard in
+tests/test_telemetry.py holds every literal ``record()`` call in the
+tree to it): ``guarded_demotion``, ``fault_injected``,
+``deadline_shed``, ``deadline_exceeded``, ``dispatch_error``,
+``shard_marked``, ``autotune_verdict``, ``xla_compile``,
+``corrupt_index``, ``recall_regression``, ``slo_breach`` — the
+self-healing set (docs/robustness.md): ``breaker_open`` /
+``breaker_probe`` / ``breaker_close`` (ops/guarded circuit breakers),
+``shard_restored`` (sharded_ann.probe_shards), ``brownout``
+(serve/degrade ladder moves), ``fault_scenario`` (timed chaos-drill
+stage transitions) — the mutable-tier set (docs/mutation.md,
+neighbors/mutable.py): ``upsert`` / ``delete`` (one per mutation call,
+trace-stamped like every serving event), ``merge_started`` /
+``merge_committed`` / ``merge_abandoned`` (the background-merge state
+machine), ``wal_recovered`` (a ``recover()`` replay, with
+record/truncation counts) — and the multi-tenant set
+(docs/serving.md "Multi-tenant fabric", serve/tenancy.py):
+``tenant_shed`` (a token-bucket self-shed at admission, stamped with
+the rejected request's trace ID), ``tenant_swap`` (one per
+zero-downtime index flip, with the new generation and warmed shapes),
+``qcache_stale`` (the recall sentinel caught the query cache serving a
+provably-degraded hit; stamped with the crossing sample's trace ID).
 
 Details are scrubbed JSON-safe at record time: non-finite floats become
 None, numpy scalars/arrays become python values/lists (large arrays a
@@ -56,9 +65,30 @@ import time
 from typing import List, Optional
 
 __all__ = ["record", "recent", "counts", "to_jsonl", "export_jsonl",
-           "set_capacity", "clear", "DEFAULT_CAPACITY"]
+           "set_capacity", "clear", "DEFAULT_CAPACITY",
+           "WELL_KNOWN_KINDS"]
 
 DEFAULT_CAPACITY = 512
+
+# the registered event vocabulary (module docstring, same order). An
+# open set at runtime — record() accepts any kind — but every LITERAL
+# kind in the library itself must be registered here, or the telemetry
+# drift guard fails the suite: an operator greps dashboards by kind,
+# so a new emitter must announce its vocabulary.
+WELL_KNOWN_KINDS = frozenset({
+    "guarded_demotion", "fault_injected", "deadline_shed",
+    "deadline_exceeded", "dispatch_error", "shard_marked",
+    "autotune_verdict", "xla_compile", "corrupt_index",
+    "recall_regression", "slo_breach",
+    # self-healing (docs/robustness.md)
+    "breaker_open", "breaker_probe", "breaker_close", "shard_restored",
+    "brownout", "fault_scenario",
+    # mutable tier (docs/mutation.md)
+    "upsert", "delete", "merge_started", "merge_committed",
+    "merge_abandoned", "wal_recovered",
+    # multi-tenant fabric (docs/serving.md "Multi-tenant fabric")
+    "tenant_shed", "tenant_swap", "qcache_stale",
+})
 
 # arrays above this many elements are summarized, not inlined — one
 # stray (10k, 128) distance matrix must not bloat the ring

@@ -24,6 +24,12 @@ operational metrics.
                 (burn-rate windows, slo_breach events)
 - ``degrade``   SLO-driven brownout controller: adaptive degradation
                 ladder with hysteresis (docs/robustness.md)
+- ``tenancy``   multi-tenant serving fabric: per-tenant queues +
+                SLO/brownout, weighted-fair drain, token-bucket
+                isolation, zero-downtime swap (docs/serving.md
+                "Multi-tenant fabric")
+- ``qcache``    exact-match bounded-LRU result cache for repeat
+                traffic, generation-keyed invalidation
 
 Submodules import lazily, so telemetry-only consumers (ops/guarded
 demotion events, core/tracing span timing) pull in none of the
@@ -35,7 +41,7 @@ import importlib
 from typing import Any
 
 _SUBMODULES = ("admission", "batcher", "debugz", "degrade", "metrics",
-               "quality", "slo", "warmup")
+               "qcache", "quality", "slo", "tenancy", "warmup")
 _EXPORTS = {
     "MicroBatcher": "batcher",
     "BucketLadder": "batcher",
@@ -49,6 +55,10 @@ _EXPORTS = {
     "SLOEngine": "slo",
     "Targets": "slo",
     "BrownoutController": "degrade",
+    "ServeFabric": "tenancy",
+    "Tenant": "tenancy",
+    "RateLimitedError": "tenancy",
+    "QueryCache": "qcache",
 }
 
 __all__ = list(_SUBMODULES) + list(_EXPORTS)

@@ -27,7 +27,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Callable, List, NamedTuple, Optional
+from typing import Callable, List, NamedTuple, Optional, Tuple
 
 from ..core import events, tracing
 from ..core.deadline import Deadline, DeadlineExceeded
@@ -174,6 +174,33 @@ class AdmissionQueue:
             f"raft_tpu serve: request shed (deadline of {spent:.4g}s "
             "spent before dispatch); partial results empty", partial=None))
 
+    def _drain_locked(self, batch: List[Request], rows: int,
+                      max_requests: int,
+                      max_rows: Optional[int]) -> Tuple[int, bool]:
+        """Caller holds the lock: pop admissible requests into
+        ``batch`` (shedding expired ones) until the request/row caps;
+        the first request always pops regardless of ``max_rows``.
+        Returns ``(rows, rows_full)`` — ONE admissibility loop shared
+        by the blocking coalescing pop and the fabric's non-blocking
+        drain, so shed semantics and the row-cap boundary can never
+        diverge between them."""
+        rows_full = False
+        while self._items and len(batch) < max_requests:
+            nxt = self._items[0]
+            if nxt.deadline is not None and nxt.deadline.expired():
+                self._items.popleft()
+                self.shed(nxt)
+                continue
+            if (max_rows is not None and batch
+                    and rows + nxt.rows > max_rows):
+                rows_full = True
+                break
+            self._items.popleft()
+            batch.append(nxt)
+            rows += nxt.rows
+        self._depth.set(len(self._items))
+        return rows, rows_full
+
     def pop_batch(self, max_requests: int, max_wait_s: float,
                   max_rows: Optional[int] = None) -> List[Request]:
         """Blocking coalescing pop (see module docstring). Returns [] only
@@ -184,23 +211,10 @@ class AdmissionQueue:
         window_end = None     # clock() bound set by the first pop
         with self._cond:
             while True:
-                rows_full = False
-                while self._items and len(batch) < max_requests:
-                    nxt = self._items[0]
-                    if nxt.deadline is not None and nxt.deadline.expired():
-                        self._items.popleft()
-                        self.shed(nxt)
-                        continue
-                    if (max_rows is not None and batch
-                            and rows + nxt.rows > max_rows):
-                        rows_full = True
-                        break
-                    self._items.popleft()
-                    batch.append(nxt)
-                    rows += nxt.rows
-                    if window_end is None:
-                        window_end = self._clock() + max_wait_s
-                self._depth.set(len(self._items))
+                rows, rows_full = self._drain_locked(
+                    batch, rows, max_requests, max_rows)
+                if batch and window_end is None:
+                    window_end = self._clock() + max_wait_s
                 if batch and (self._closed or rows_full
                               or len(batch) >= max_requests
                               or self._clock() >= window_end):
@@ -210,6 +224,18 @@ class AdmissionQueue:
                 remaining = (self._WAIT_SLICE_S if window_end is None
                              else max(0.0, window_end - self._clock()))
                 self._cond.wait(min(remaining, self._WAIT_SLICE_S))
+
+    def pop_nowait(self, max_requests: int,
+                   max_rows: Optional[int] = None) -> List[Request]:
+        """Non-blocking drain: whatever is admissible right now, up to
+        the request/row caps, shedding expired requests on the way —
+        the multi-tenant fabric's weighted-round-robin primitive
+        (:mod:`raft_tpu.serve.tenancy` visits many queues per round and
+        must never park on an empty one)."""
+        batch: List[Request] = []
+        with self._cond:
+            self._drain_locked(batch, 0, max_requests, max_rows)
+        return batch
 
     def close(self) -> None:
         """Stop admitting; pop_batch drains what is queued, then returns

@@ -80,10 +80,63 @@ from ..core.errors import expects
 from . import warmup as _warmup
 from .admission import AdmissionQueue, Request, SearchResult
 
-__all__ = ["BucketLadder", "MicroBatcher"]
+__all__ = ["BucketLadder", "MicroBatcher", "coalesce_block"]
 
 # the five per-request stages (docs/observability.md)
 STAGES = ("queue_wait", "bucket_pad", "dispatch", "device", "demux")
+
+
+def triage_partial(live: Sequence, offs: Sequence[int],
+                   e: DeadlineExceeded):
+    """Classify every request of a mid-batch deadline expiry (pure —
+    callers credit their own counters/events): returns
+    ``(served, expired, retry)`` where ``served`` is
+    ``[(request, SearchResult)]`` for rows fully inside the delivered
+    partial, ``expired`` is ``[(request, covered_rows, own_partial)]``
+    for requests whose OWN deadline is spent (``own_partial`` their
+    slice or None), and ``retry`` the collateral co-batched requests to
+    re-dispatch. Shared by :class:`MicroBatcher` and the multi-tenant
+    fabric so the slicing boundary math and the termination argument
+    (every recursion drops the expired owners, so the retried group's
+    tightest deadline is strictly looser) live in exactly one place."""
+    from .admission import SearchResult
+
+    if e.partial is not None:
+        pd, pi = np.asarray(e.partial[0]), np.asarray(e.partial[1])
+        done = pd.shape[0]
+    else:
+        pd = pi = None
+        done = 0
+    served, expired, retry = [], [], []
+    for r, o in zip(live, offs):
+        if o + r.rows <= done:
+            served.append((r, SearchResult(pd[o:o + r.rows, :r.k],
+                                           pi[o:o + r.rows, :r.k], None)))
+            continue
+        if r.deadline is None or not r.deadline.expired():
+            retry.append(r)
+            continue
+        own = None
+        if done > o:
+            own = (pd[o:done, :r.k], pi[o:done, :r.k])
+        expired.append((r, max(0, done - o), own))
+    return served, expired, retry
+
+
+def coalesce_block(live: Sequence, mb: int, dim: int):
+    """Concatenate the live requests' query rows into one zero-padded
+    (mb, dim) f32 block; returns ``(block, offsets)`` with each
+    request's row offset. Shared by :class:`MicroBatcher` and the
+    multi-tenant fabric (:mod:`raft_tpu.serve.tenancy`) so co-batched
+    dispatch and demux slicing agree on one layout."""
+    block = np.zeros((mb, dim), np.float32)
+    offs: List[int] = []
+    off = 0
+    for r in live:
+        block[off:off + r.rows] = r.queries
+        offs.append(off)
+        off += r.rows
+    return block, offs
 
 
 class BucketLadder:
@@ -393,13 +446,7 @@ class MicroBatcher:
         rows = sum(r.rows for r in live)
         mb = self.ladder.bucket_queries(rows)
         t_pad = self._clock() if probe else 0.0
-        block = np.zeros((mb, self._dim), np.float32)
-        offs: List[int] = []
-        off = 0
-        for r in live:
-            block[off:off + r.rows] = r.queries
-            offs.append(off)
-            off += r.rows
+        block, offs = coalesce_block(live, mb, self._dim)
         pad_dt = self._clock() - t_pad if probe else 0.0
         t0 = self._clock()
         try:
@@ -504,28 +551,13 @@ class MicroBatcher:
         never fail on someone else's. Terminates: every recursion drops
         the expired-deadline owners, so the retried group carries a
         strictly looser tightest deadline."""
-        if e.partial is not None:
-            pd, pi = np.asarray(e.partial[0]), np.asarray(e.partial[1])
-            done = pd.shape[0]
-        else:
-            pd = pi = None
-            done = 0
+        served, expired, retry = triage_partial(live, offs, e)
         now = self._clock()
-        retry: List[Request] = []
-        for r, o in zip(live, offs):
-            if o + r.rows <= done:
-                r.set_result(SearchResult(pd[o:o + r.rows, :r.k],
-                                          pi[o:o + r.rows, :r.k], None))
-                self._latency.observe(now - r.enqueued_at)
-                self._served.inc()
-                continue
-            if r.deadline is None or not r.deadline.expired():
-                retry.append(r)
-                continue
-            own = None
-            if done > o:
-                own = (pd[o:done, :r.k], pi[o:done, :r.k])
-            covered = max(0, done - o)
+        for r, res_r in served:
+            r.set_result(res_r)
+            self._latency.observe(now - r.enqueued_at)
+            self._served.inc()
+        for r, covered, own in expired:
             self._dlx.inc()
             try:
                 events.record("deadline_exceeded", f"{self._name}.dispatch",

@@ -61,7 +61,7 @@ def _json_safe(obj):
 
 
 def snapshot(batcher=None, registry=None, events_n: int = 50,
-             spans_n: int = 20, slo=None) -> dict:
+             spans_n: int = 20, slo=None, fabric=None) -> dict:
     """Point-in-time ops snapshot (strict-JSON-safe: no NaN/Inf leaves).
 
     ``batcher``: include its bucket-ladder occupancy and queue state.
@@ -77,6 +77,10 @@ def snapshot(batcher=None, registry=None, events_n: int = 50,
     live :class:`~raft_tpu.serve.quality.RecallSentinel` reports under
     ``quality`` and every ``quality.watch_index``-registered index
     under ``health``.
+    ``fabric``: a :class:`~raft_tpu.serve.tenancy.ServeFabric` for the
+    ``tenants`` section (per-tenant queue depth, weight, shed/served,
+    brownout level, SLO verdict, cache hit rate, swap generation);
+    None uses the process-installed fabric (``tenancy.install``).
     """
     from ..ops import autotune, guarded
     from . import metrics as _metrics
@@ -167,6 +171,16 @@ def snapshot(batcher=None, registry=None, events_n: int = 50,
             out["memz"] = mz
     except Exception:  # noqa: BLE001 - surface must render without quality
         pass
+    # multi-tenant fabric (serve/tenancy.py): per-tenant queue/SLO/
+    # brownout/cache state + the shared qcache counters
+    try:
+        from . import tenancy as _tenancy
+
+        fab = fabric if fabric is not None else _tenancy.installed()
+        if fab is not None:
+            out["tenants"] = fab.snapshot()
+    except Exception:  # noqa: BLE001 - surface must render without
+        pass           # the fabric
     if slo_report is not None:
         out["slo"] = slo_report
     if batcher is not None:
@@ -185,12 +199,12 @@ def _fmt_hist(name: str, h: dict) -> str:
 
 
 def render_text(batcher=None, registry=None, events_n: int = 20,
-                spans_n: int = 5, slo=None) -> str:
+                spans_n: int = 5, slo=None, fabric=None) -> str:
     """Human-readable rendering of :func:`snapshot` (the text half of the
     text/JSON ops surface; the Prometheus export stays
     ``metrics.render_text``)."""
     s = snapshot(batcher, registry, events_n=events_n, spans_n=spans_n,
-                 slo=slo)
+                 slo=slo, fabric=fabric)
     lines = [f"== raft_tpu debugz @ {time.strftime('%Y-%m-%dT%H:%M:%S')} =="]
     if "ladder" in s:
         lad = s["ladder"]
@@ -221,6 +235,37 @@ def render_text(batcher=None, registry=None, events_n: int = 20,
             lines.append(
                 f"  {site}: {b['state'].upper()} opens={b['opens']} "
                 f"probes={b['probes']} closes={b['closes']}" + extra)
+    if s.get("tenants"):
+        fb = s["tenants"]
+        qc = fb.get("qcache") or {}
+        lines += ["", f"-- tenants (fabric {fb.get('name', '?')}"
+                  f"{' CLOSED' if fb.get('closed') else ''}) --"]
+        if qc:
+            hr = qc.get("hit_rate")
+            lines.append(
+                f"  qcache: {qc.get('entries', 0)}/{qc.get('capacity', 0)}"
+                f" entries hit_rate="
+                f"{'-' if hr is None else f'{hr:.2%}'}"
+                f" hits={qc.get('hits', 0)} misses={qc.get('misses', 0)}"
+                f" bypass={qc.get('bypass', 0)}"
+                f" invalidated={qc.get('invalidated', 0)}")
+        for tn, te in sorted((fb.get("tenants") or {}).items()):
+            if "error" in te:
+                lines.append(f"  {tn}: error {te['error']}")
+                continue
+            thr = (te.get("qcache") or {}).get("hit_rate")
+            slo_v = (te.get("slo") or {}).get("verdict", "-")
+            lines.append(
+                f"  {tn}: w={te.get('weight', 1):g} gen="
+                f"{te.get('generation', 0)} queue="
+                f"{te.get('queue_depth', 0)}/{te.get('queue_max_depth', 0)}"
+                f" served={te.get('served', 0)} shed={te.get('shed', 0)}"
+                f" slo={slo_v}"
+                + (f" brownout={te['brownout_level']}"
+                   if "brownout_level" in te else "")
+                + (f" tokens={te['tokens']:g}" if "tokens" in te else "")
+                + (f" cache_hit="
+                   f"{'-' if thr is None else f'{thr:.2%}'}"))
     if s.get("brownout"):
         bw = s["brownout"]
         lines += ["", f"-- brownout (level {bw['level']}/{bw['max_level']})"
@@ -347,9 +392,10 @@ def render_text(batcher=None, registry=None, events_n: int = 20,
     return "\n".join(lines) + "\n"
 
 
-def write_snapshot(path: str, batcher=None, registry=None, slo=None) -> dict:
+def write_snapshot(path: str, batcher=None, registry=None, slo=None,
+                   fabric=None) -> dict:
     """Write one JSON snapshot atomically (tmp + rename); returns it."""
-    s = snapshot(batcher, registry, slo=slo)
+    s = snapshot(batcher, registry, slo=slo, fabric=fabric)
     tmp = f"{path}.tmp{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(s, f, indent=1, sort_keys=True)
@@ -367,17 +413,23 @@ class SnapshotWriter:
     the serving loop's maintenance slot. The self-healing layer hangs
     its periodic work here: ``sharded_ann.probe_all`` re-probes dead
     shards, ``BrownoutController.poll`` consumes SLO verdicts
-    (docs/robustness.md) — so the snapshot that lands each tick already
-    reflects that tick's probes and ladder moves."""
+    (docs/robustness.md), and a multi-tenant fabric hangs
+    ``ServeFabric.tick`` (per-tenant SLO poll + swap retire,
+    docs/serving.md) — so the snapshot that lands each tick already
+    reflects that tick's probes, ladder moves and retires."""
 
     def __init__(self, path: str, interval_s: float = 10.0, batcher=None,
-                 registry=None, slo=None, hooks=()):
+                 registry=None, slo=None, hooks=(), fabric=None):
         self.path = path
         self.interval_s = float(interval_s)
         self._batcher = batcher
         self._registry = registry
         self._slo = slo
+        self._fabric = fabric
         self._hooks = tuple(hooks)
+        if fabric is not None:
+            # the fabric's maintenance tick rides the hook slot
+            self._hooks = self._hooks + (fabric.tick,)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -392,7 +444,7 @@ class SnapshotWriter:
 
     def write_once(self) -> dict:
         return write_snapshot(self.path, self._batcher, self._registry,
-                              slo=self._slo)
+                              slo=self._slo, fabric=self._fabric)
 
     def start(self) -> "SnapshotWriter":
         if self._thread is None:

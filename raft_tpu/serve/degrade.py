@@ -44,6 +44,12 @@ Knobs: ``RAFT_TPU_BROWNOUT_MIN_DWELL_S`` (default 5),
 ``RAFT_TPU_BROWNOUT_UP_AFTER_S`` (default 15),
 ``RAFT_TPU_BROWNOUT_MAX_LEVEL`` (cap the ladder depth; default = all
 configured levels).
+
+Like the SLO engine, the controller is a plain instance: the
+multi-tenant fabric (:mod:`raft_tpu.serve.tenancy`) runs one per tenant
+(each consuming its own tenant's SLO verdicts, so one tenant browning
+out never degrades another's params); the process-global ``install()``
+slot stays the single-tenant default.
 """
 from __future__ import annotations
 

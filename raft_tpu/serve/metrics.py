@@ -15,7 +15,12 @@ module provides the smallest registry that covers them:
   any traffic level, and percentile estimates by linear interpolation
   inside the owning bucket, clamped to the observed min/max;
 * a **default process registry** plus injectable instances so tests and
-  multi-tenant batchers can isolate their numbers.
+  multi-tenant batchers can isolate their numbers — the serving fabric
+  (:mod:`raft_tpu.serve.tenancy`) gives every tenant its own
+  ``Registry``, which is what makes per-tenant SLO engines and brownout
+  controllers possible: each one diffs only its own tenant's counters
+  (process-level signals — ``guarded.demotions``, ``serve.compiles`` —
+  stay in the default registry by design).
 
 Span timing: :func:`enable_span_metrics` installs a
 :mod:`raft_tpu.core.tracing` timer, so every ``tracing.annotate`` /

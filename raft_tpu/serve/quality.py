@@ -122,6 +122,14 @@ class RecallSentinel:
         # floor-crossing state per family: one event per crossing, not
         # one per sample below the floor; re-arms on recovery
         self._below: Dict[str, bool] = {}
+        # optional floor-crossing hook, called (guarded) AFTER the
+        # recall_regression event with (family, estimate, samples,
+        # trace_id) — the multi-tenant fabric wires it to turn a
+        # ``qcache``-family regression into a ``qcache_stale`` event +
+        # eager cache invalidation (serve/tenancy.py); settable
+        # post-construction (one consumer per sentinel, like the
+        # tracing timer slot)
+        self.on_regression: Optional[Callable] = None
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         _SENTINELS.add(self)
@@ -276,6 +284,12 @@ class RecallSentinel:
                     floor=self.floor, samples=n_samples)
             except Exception:  # noqa: BLE001 - telemetry must not kill
                 pass           # the worker
+            hook = self.on_regression
+            if hook is not None:
+                try:
+                    hook(fam, est, n_samples, trace_id)
+                except Exception:  # noqa: BLE001 - a hostile hook must
+                    pass           # not kill the scoring worker
         self._below[fam] = below
 
     # -- introspection ----------------------------------------------------

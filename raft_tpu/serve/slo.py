@@ -21,7 +21,12 @@ gates on its published sample count.
 
 ``SLOEngine.install()`` registers the engine for the debugz snapshot's
 ``slo`` section (one engine per process slot, like the tracing timer);
-``debugz.snapshot(slo=engine)`` overrides explicitly.
+``debugz.snapshot(slo=engine)`` overrides explicitly. The engine is a
+plain instance over an injectable registry, so the multi-tenant fabric
+(:mod:`raft_tpu.serve.tenancy`) runs ONE engine per tenant against that
+tenant's private registry — the process-global ``install()`` slot stays
+the single-tenant default; per-tenant verdicts land in the debugz
+``tenants`` section instead.
 """
 from __future__ import annotations
 

@@ -157,7 +157,8 @@ def count_compilations():
 
 
 def warmup(search_fn, ladder, dim: int, dtype=np.float32, registry=None,
-           name: str = "serve", prepare=None, engines=None) -> int:
+           name: str = "serve", prepare=None, engines=None,
+           shapes=None) -> int:
     """Dispatch a dummy batch through ``search_fn`` at every ladder shape
     and block on each result. Returns the number of XLA compilations the
     sweep triggered (0 when the process is already warm). Records
@@ -180,7 +181,13 @@ def warmup(search_fn, ladder, dim: int, dtype=np.float32, registry=None,
     be None then). This is how a multi-engine family pre-compiles every
     traversal engine at the serving buckets (the cagra fused megakernel
     must never be first-request compiled; the engine drift guard in
-    tests/test_quality.py holds every registered engine to it)."""
+    tests/test_quality.py holds every registered engine to it).
+
+    ``shapes``: optional explicit ``[(query_bucket, k_bucket), ...]``
+    subset to warm instead of the ladder's full cross product — a
+    tenant swap (:meth:`raft_tpu.serve.tenancy.Tenant.swap`) warms the
+    replacement index only at the shapes that tenant has actually
+    served, off the hot path."""
     from . import metrics as _metrics
 
     reg = registry or _metrics.default_registry
@@ -195,23 +202,27 @@ def warmup(search_fn, ladder, dim: int, dtype=np.float32, registry=None,
         expects(search_fn is not None,
                 "warmup needs a search_fn or an engines mapping")
         fns = {"": search_fn}
-    shapes = 0
+    if shapes is None:
+        sweep = [(mb, kb) for mb in ladder.query_buckets
+                 for kb in ladder.k_buckets]
+    else:
+        sweep = [(int(mb), int(kb)) for mb, kb in shapes]
+    n_shapes = 0
     with count_compilations() as cc:
         for eng, fn in fns.items():
             tag = f":{eng}" if eng else ""
-            for mb in ladder.query_buckets:
+            for mb, kb in sweep:
                 q = np.zeros((mb, int(dim)), dtype)
-                for kb in ladder.k_buckets:
-                    with compile_context(f"{name}:warmup{tag}:{mb}x{kb}",
-                                         warmup=True):
-                        out = fn(q, kb)
-                        # block the FULL output pytree: compiles are lazy
-                        # until the dispatch executes, and a 3-tuple
-                        # (shards_ok) or donated-closure output whose
-                        # tail leaves were never forced would leave the
-                        # first real request a residual trace to pay
-                        jax.block_until_ready(out)
-                    shapes += 1
-    reg.gauge(f"{name}.warmup.shapes").set(shapes)
+                with compile_context(f"{name}:warmup{tag}:{mb}x{kb}",
+                                     warmup=True):
+                    out = fn(q, kb)
+                    # block the FULL output pytree: compiles are lazy
+                    # until the dispatch executes, and a 3-tuple
+                    # (shards_ok) or donated-closure output whose
+                    # tail leaves were never forced would leave the
+                    # first real request a residual trace to pay
+                    jax.block_until_ready(out)
+                n_shapes += 1
+    reg.gauge(f"{name}.warmup.shapes").set(n_shapes)
     reg.counter(f"{name}.warmup.compiles").inc(cc.count)
     return cc.count

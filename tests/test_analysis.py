@@ -440,6 +440,26 @@ class TestServeTreeVerdicts:
                              "jit-static-missing")]
         assert not hot, [f.render() for f in hot]
 
+    def test_tenancy_modules_in_scan_lists(self):
+        """The multi-tenant fabric (ISSUE 15) stays under the gate:
+        serve/tenancy.py (weighted drain + swap flip — exactly the
+        lock-discipline bug class) and serve/qcache.py must resolve
+        into BOTH scan lists; a future restructure that moves them out
+        of serve/ must update LOCK_MODULES/HOTPATH_MODULES too."""
+        import os
+
+        import raft_tpu
+        from raft_tpu.analysis import iter_module_paths
+        from raft_tpu.analysis.hotpath_audit import HOTPATH_MODULES
+        from raft_tpu.analysis.lock_lint import LOCK_MODULES
+
+        root = os.path.dirname(os.path.dirname(raft_tpu.__file__))
+        for entries in (LOCK_MODULES, HOTPATH_MODULES):
+            rels = set(iter_module_paths(root, entries))
+            for mod in ("raft_tpu/serve/tenancy.py",
+                        "raft_tpu/serve/qcache.py"):
+                assert mod in rels, f"{mod} fell out of the scan list"
+
     def test_fragile_repeat_is_baselined_not_new(self, tree_run):
         """The documented ivf_pq pltpu.repeat quirk is visible to the
         gate (it must not silently disappear while the kernel still

@@ -393,6 +393,43 @@ class TestDriftGuard:
             f"public neighbors entry points missing tracing.annotate: "
             f"{missing} — wrap them (docs/observability.md drift guard)")
 
+    def test_every_literal_event_kind_is_registered(self):
+        """Every literal flight-recorder kind emitted anywhere in the
+        library must be in events.WELL_KNOWN_KINDS (operators grep
+        dashboards by kind — a new emitter must announce its
+        vocabulary), and every registered kind the docstring promises
+        must actually be registered."""
+        import os
+        import re
+
+        import raft_tpu
+
+        root = os.path.dirname(raft_tpu.__file__)
+        # events.record / _events.record / mutable's self._event helper,
+        # with a literal first argument (possibly on the next line)
+        pat = re.compile(
+            r"(?:\bevents\.record|\b_events\.record|self\._event)"
+            r"\(\s*\n?\s*\"([a-z_]+)\"")
+        found = {}
+        for dirpath, _dirs, files in os.walk(root):
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                with open(path) as f:
+                    for kind in pat.findall(f.read()):
+                        found.setdefault(kind, []).append(
+                            os.path.relpath(path, root))
+        assert found, "the kind scan found nothing — pattern rot?"
+        unregistered = {k: v for k, v in found.items()
+                        if k not in events.WELL_KNOWN_KINDS}
+        assert not unregistered, (
+            f"flight-recorder kinds not in events.WELL_KNOWN_KINDS: "
+            f"{unregistered} — register them (core/events.py docstring)")
+        # the multi-tenant vocabulary this PR registered is present
+        assert {"tenant_shed", "tenant_swap",
+                "qcache_stale"} <= events.WELL_KNOWN_KINDS
+
 
 class TestZeroOverheadWhenOff:
     def test_disabled_path_runs_no_device_probe(self, reg, monkeypatch):
