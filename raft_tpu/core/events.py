@@ -65,6 +65,7 @@ import time
 from typing import List, Optional
 
 __all__ = ["record", "recent", "counts", "to_jsonl", "export_jsonl",
+           "drain_new", "attach_sink", "detach_sink",
            "set_capacity", "clear", "DEFAULT_CAPACITY",
            "WELL_KNOWN_KINDS"]
 
@@ -88,6 +89,11 @@ WELL_KNOWN_KINDS = frozenset({
     "merge_abandoned", "wal_recovered",
     # multi-tenant fabric (docs/serving.md "Multi-tenant fabric")
     "tenant_shed", "tenant_swap", "qcache_stale",
+    # soak harness (docs/soak.md): ``hook_error`` — a SnapshotWriter
+    # maintenance hook started/stopped failing (one event per
+    # transition, not per failure); ``soak_phase`` — a SoakHarness
+    # phase boundary (warmup/steady/chaos/recovery/quiesce)
+    "hook_error", "soak_phase",
 })
 
 # arrays above this many elements are summarized, not inlined — one
@@ -97,6 +103,7 @@ _ARRAY_INLINE_MAX = 32
 _lock = threading.Lock()
 _ring: collections.deque = collections.deque(maxlen=DEFAULT_CAPACITY)
 _seq = 0
+_sink = None          # open JSONL file object (attach_sink), or None
 
 
 def _json_safe(v, depth: int = 0):
@@ -150,6 +157,12 @@ def record(kind: str, site: str, trace_id=None, **details) -> dict:
         _seq += 1
         e["seq"] = _seq
         _ring.append(e)
+        if _sink is not None:
+            try:
+                _sink.write(json.dumps(e, sort_keys=True, default=repr)
+                            + "\n")
+            except Exception:  # noqa: BLE001 - a dead sink must never
+                pass           # break the recording path
     return e
 
 
@@ -189,6 +202,56 @@ def export_jsonl(path: str, n: Optional[int] = None) -> int:
         for e in items:
             f.write(json.dumps(e, sort_keys=True, default=repr) + "\n")
     return len(items)
+
+
+def drain_new(cursor: int = 0):
+    """Incremental read: every event still in the ring with
+    ``seq > cursor``, plus the new cursor to pass next time —
+    ``events, cursor = drain_new(cursor)``. A long soak polls this
+    every tick so the 512-ring's churn never loses history. Events
+    that aged out of the ring between polls are simply gone (use
+    :func:`attach_sink` when losing any is unacceptable); the caller
+    can detect the gap because the first returned ``seq`` jumps past
+    ``cursor + 1``."""
+    cursor = int(cursor)
+    with _lock:
+        items = [e for e in _ring if e["seq"] > cursor]
+        new_cursor = _seq
+    return items, new_cursor
+
+
+def attach_sink(path: str, include_ring: bool = False) -> str:
+    """Stream every FUTURE event to ``path`` as JSON-lines (append
+    mode), in addition to the ring — the durable half of the flight
+    recorder for runs longer than the ring. ``include_ring=True`` also
+    dumps the current ring contents first (a soak that attaches late
+    keeps its prologue). Re-attaching closes the previous sink.
+    Returns ``path``."""
+    global _sink
+    f = open(path, "a", buffering=1)     # line-buffered: crash-readable
+    with _lock:
+        old, _sink = _sink, f
+        prologue = list(_ring) if include_ring else []
+        for e in prologue:
+            f.write(json.dumps(e, sort_keys=True, default=repr) + "\n")
+    if old is not None:
+        try:
+            old.close()
+        except Exception:  # noqa: BLE001
+            pass
+    return path
+
+
+def detach_sink() -> None:
+    """Stop streaming and close the sink file (no-op when detached)."""
+    global _sink
+    with _lock:
+        old, _sink = _sink, None
+    if old is not None:
+        try:
+            old.close()
+        except Exception:  # noqa: BLE001
+            pass
 
 
 def set_capacity(n: int) -> None:

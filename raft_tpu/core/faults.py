@@ -398,6 +398,17 @@ class Scenario:
         """True once every stage has been armed and cleared."""
         return all(st.done for st in self._stages)
 
+    def stages(self) -> List[dict]:
+        """JSON-safe view of the schedule (one dict per stage, in add
+        order) — a chaos plan's artifact records exactly what it armed
+        and when, and two same-seed runs must produce identical lists."""
+        return [{"kind": st.fault.kind, "pattern": st.fault.pattern,
+                 "at_s": st.at_s, "until_s": st.until_s,
+                 "count": st.fault.count, "value": st.fault.value,
+                 "armed": st.armed, "done": st.done,
+                 "fires": st.fault.fires}
+                for st in self._stages]
+
     def stop(self) -> None:
         """Clear every still-armed stage (and mark pending ones done)."""
         if self._t0 is None:

@@ -132,6 +132,11 @@ POLICIES: Dict[str, BreakerPolicy] = {
     # kernel site — the breaker keeps a repeatedly-failing merge from
     # hot-looping the maintenance tick, and a probe retries one merge
     "mutable.merge": DEFAULT_POLICY,
+    # the soak harness's hot-tenant serving wrapper (soak/harness.py):
+    # primary and fallback are the same exact search, so a kernel_fault
+    # drill exercises the full breaker arc (and the heal.mttr verdict)
+    # with zero recall impact
+    "soak.serve": DEFAULT_POLICY,
 }
 
 
@@ -334,6 +339,11 @@ def _on_probe_success(site: str) -> None:
         from ..serve import metrics as serve_metrics
 
         serve_metrics.counter(f"guarded.breaker.closes.{site}").inc()
+        # MTTR verdict (docs/soak.md): open → close wall, in recovery
+        # buckets (probation alone is 30s; latency buckets top at 10s)
+        serve_metrics.histogram(
+            f"heal.mttr.{site}",
+            serve_metrics.MTTR_BUCKETS_S).observe(down_s)
     except Exception:  # noqa: BLE001
         pass
     _emit("breaker_close", site, down_s=down_s, probes=probes)
@@ -431,14 +441,25 @@ def breaker_snapshot() -> Dict[str, dict]:
     return out
 
 
-def reset() -> None:
-    """Clear all breaker state (tests / operator re-arm after a fix)."""
+def reset(sites=None) -> None:
+    """Clear breaker state (tests / operator re-arm after a fix).
+
+    With no argument, everything resets. With an iterable of site
+    names, only those breakers re-close — the soak harness uses this to
+    re-arm exactly the sites it drills without clobbering breakers the
+    rest of the process may legitimately hold open."""
     from . import autotune
 
     with _lock:
-        sites = list(_BREAKERS)
-        _BREAKERS.clear()
-        _LOGGED.clear()
-    for site in sites:
+        if sites is None:
+            cleared = list(_BREAKERS)
+            _BREAKERS.clear()
+            _LOGGED.clear()
+        else:
+            cleared = [s for s in sites if s in _BREAKERS]
+            for s in cleared:
+                del _BREAKERS[s]
+                _LOGGED.discard(s)
+    for site in cleared:
         autotune.forget(_guard_key(site))
         _set_state_gauge(site, "closed")

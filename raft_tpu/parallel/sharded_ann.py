@@ -72,6 +72,13 @@ _ACTIVE_ENGINE = ring_topk.active_engines
 # it through the ops surface) — debugz reads per-family shards_ok here
 _LIVE = weakref.WeakSet()
 
+# shard-MTTR bookkeeping: down-transition timestamps per shard site
+# (``sharded_ann.<family>.shard<i>``), observed into the ``shard.mttr``
+# histogram on the up-transition. The clock is module-injectable so a
+# compressed-time soak (raft_tpu/soak) measures simulated MTTR.
+_clock = time.monotonic
+_downed_at: dict = {}
+
 
 def _merged_shard_search(mesh, family: str, local_fn, in_specs, arrays,
                          m: int, k: int, select_min: bool, comms,
@@ -230,12 +237,26 @@ def _mark_shard(shards_ok: np.ndarray, family: str, i: int, ok: bool) -> None:
     shards_ok[i] = ok
     if not changed:
         return
+    site = f"sharded_ann.{family}.shard{i}"
     try:
         from ..core import events as _events
 
-        _events.record("shard_marked", f"sharded_ann.{family}.shard{i}",
-                       ok=bool(ok))
+        _events.record("shard_marked", site, ok=bool(ok))
     except Exception:  # noqa: BLE001
+        pass
+    # MTTR verdict (docs/soak.md): marked-dead → restored wall
+    try:
+        if not ok:
+            _downed_at[site] = _clock()
+        else:
+            t0 = _downed_at.pop(site, None)
+            if t0 is not None:
+                from ..serve import metrics as _metrics
+
+                _metrics.histogram(
+                    "shard.mttr",
+                    _metrics.MTTR_BUCKETS_S).observe(_clock() - t0)
+    except Exception:  # noqa: BLE001 - telemetry must not undo a mark
         pass
 
 

@@ -432,15 +432,49 @@ class SnapshotWriter:
             self._hooks = self._hooks + (fabric.tick,)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # per-hook failure latches (index-aligned with _hooks): a soak
+        # must surface a dead maintenance hook, so failures are counted
+        # per hook and flight-recorded once per TRANSITION (first
+        # failure / recovery), never once per tick
+        self._hook_failing = [False] * len(self._hooks)
+
+    @staticmethod
+    def _hook_name(h) -> str:
+        name = getattr(h, "__qualname__", None) \
+            or getattr(h, "__name__", None) or repr(h)
+        return name.replace("<", "").replace(">", "")
 
     def tick(self) -> None:
         """Run the maintenance hooks once (each guarded — one failing
-        hook must not starve the rest or the write)."""
-        for h in self._hooks:
+        hook must not starve the rest or the write). Failures are
+        counted under ``debugz.hook_errors.<name>`` and recorded as one
+        ``hook_error`` event per transition."""
+        from . import metrics as _metrics
+
+        reg = self._registry or _metrics.default_registry
+        for i, h in enumerate(self._hooks):
             try:
                 h()
-            except Exception:  # noqa: BLE001 - a broken hook must not
-                pass           # kill the maintenance loop
+            except Exception as exc:  # noqa: BLE001 - a broken hook
+                # must not kill the maintenance loop
+                name = self._hook_name(h)
+                try:
+                    reg.counter(f"debugz.hook_errors.{name}").inc()
+                    if not self._hook_failing[i]:
+                        self._hook_failing[i] = True
+                        events.record("hook_error", f"debugz.{name}",
+                                      action="failed", error=exc)
+                except Exception:  # noqa: BLE001 - telemetry best-effort
+                    pass
+            else:
+                if self._hook_failing[i]:
+                    self._hook_failing[i] = False
+                    try:
+                        events.record("hook_error",
+                                      f"debugz.{self._hook_name(h)}",
+                                      action="recovered")
+                    except Exception:  # noqa: BLE001
+                        pass
 
     def write_once(self) -> dict:
         return write_snapshot(self.path, self._batcher, self._registry,

@@ -40,7 +40,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "default_registry",
     "registry", "counter", "gauge", "histogram", "snapshot",
     "render_text", "reset", "enable_span_metrics", "disable_span_metrics",
-    "LATENCY_BUCKETS_S", "RATIO_BUCKETS",
+    "LATENCY_BUCKETS_S", "RATIO_BUCKETS", "MTTR_BUCKETS_S",
 ]
 
 # Seconds-latency bounds, log-spaced from sub-ms dispatch to multi-second
@@ -51,6 +51,14 @@ LATENCY_BUCKETS_S: Tuple[float, ...] = (
 
 # Bounds for [0, 1] ratios (batch fill, padding waste).
 RATIO_BUCKETS: Tuple[float, ...] = tuple(i / 8 for i in range(1, 9))
+
+# Recovery-time bounds (``heal.mttr.<site>``, ``shard.mttr``): breaker
+# probation alone is 30s by default and backoff caps at 600s, so MTTR
+# lives in seconds-to-tens-of-minutes — far past LATENCY_BUCKETS_S'
+# 10s ceiling, which would flatten every recovery into the +inf bucket.
+MTTR_BUCKETS_S: Tuple[float, ...] = (
+    0.1, 0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+    1800.0, 3600.0)
 
 
 class Counter:

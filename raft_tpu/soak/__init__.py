@@ -1,0 +1,22 @@
+"""Production soak harness: time-compressed chaos composition with
+continuous invariants and MTTR verdicts (docs/soak.md).
+
+Everything the tree ships — the mutable tier, the serving fabric, the
+guarded breakers, shard self-healing, SLO/brownout control, the fault
+registry — composed into one deterministic, seeded run on a single
+simulated clock. ``run_soak`` is the one-call entry; the pieces
+(:mod:`workload`, :mod:`chaos`, :mod:`invariants`, :mod:`harness`)
+are importable on their own for targeted drills.
+"""
+from .chaos import ChaosAction, ChaosPlan, standard_plan
+from .harness import ARTIFACT_SCHEMA, SoakConfig, SoakHarness, run_soak
+from .invariants import InvariantSuite, Violation
+from .workload import (Mutation, QueryBatch, ShadowCorpus, SimClock,
+                       TenantLoad, WorkloadGen)
+
+__all__ = [
+    "ARTIFACT_SCHEMA", "ChaosAction", "ChaosPlan", "InvariantSuite",
+    "Mutation", "QueryBatch", "ShadowCorpus", "SimClock", "SoakConfig",
+    "SoakHarness", "TenantLoad", "Violation", "WorkloadGen",
+    "run_soak", "standard_plan",
+]

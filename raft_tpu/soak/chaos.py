@@ -1,0 +1,160 @@
+"""Seeded chaos composition for the soak harness.
+
+A :class:`ChaosPlan` is two schedules sharing one injected clock:
+
+* **fault stages** delegated to :class:`raft_tpu.core.faults.Scenario`
+  — kernel faults, WAL torn tails, crash points, shard deaths, io
+  errors, everything the fault registry can arm, with at/until windows
+  and fire budgets;
+* **harness actions** the fault registry cannot express — overload
+  bursts (extra submits past a tenant's token bucket) and scheduled
+  zero-downtime swaps — as (at_s, until_s, payload) windows the harness
+  polls each tick.
+
+Both halves serialize via :meth:`describe` into the soak artifact, so
+the verdict records exactly what was armed and when, and two same-seed
+runs must produce identical plans (the determinism test diffs them).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..core import faults
+
+__all__ = ["ChaosAction", "ChaosPlan", "standard_plan"]
+
+
+class ChaosAction:
+    """One harness-level action window. Instant actions (``until_s``
+    None) fire once when the clock passes ``at_s``; windowed actions
+    are *active* for ``at_s <= now < until_s``."""
+
+    def __init__(self, name: str, at_s: float,
+                 until_s: Optional[float] = None, **payload):
+        self.name = name
+        self.at_s = float(at_s)
+        self.until_s = None if until_s is None else float(until_s)
+        if self.until_s is not None and self.until_s < self.at_s:
+            raise ValueError(
+                f"action {name!r}: until_s {until_s} < at_s {at_s}")
+        self.payload = dict(payload)
+        self.fired = False
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "at_s": self.at_s,
+                "until_s": self.until_s, "payload": dict(self.payload),
+                "fired": self.fired}
+
+
+class ChaosPlan:
+    """Composed fault + action schedule on one injectable clock."""
+
+    def __init__(self, clock: Callable[[], float]):
+        self._clock = clock
+        self.scenario = faults.Scenario(clock=clock)
+        self.actions: List[ChaosAction] = []
+        self._started = False
+
+    # -- building ---------------------------------------------------------
+    def add_fault(self, kind: str, pattern: str = "*", *,
+                  at_s: float = 0.0, until_s: Optional[float] = None,
+                  count: Optional[int] = None, value=None) -> "ChaosPlan":
+        self.scenario.add(kind, pattern, at_s=at_s, until_s=until_s,
+                          count=count, value=value)
+        return self
+
+    def add_action(self, name: str, at_s: float,
+                   until_s: Optional[float] = None,
+                   **payload) -> "ChaosPlan":
+        self.actions.append(ChaosAction(name, at_s, until_s, **payload))
+        return self
+
+    # -- driving ----------------------------------------------------------
+    def start(self) -> None:
+        self._started = True
+        self.scenario.start()
+
+    def step(self) -> List[str]:
+        """Advance the fault schedule; returns transition strings."""
+        return self.scenario.step() if self._started else []
+
+    def stop(self) -> None:
+        if self._started:
+            self.scenario.stop()
+            self._started = False
+
+    def due_instants(self) -> List[ChaosAction]:
+        """Un-fired instant actions whose time has come (marks them
+        fired)."""
+        now = self._clock()
+        due = [a for a in self.actions
+               if a.until_s is None and not a.fired and a.at_s <= now]
+        for a in due:
+            a.fired = True
+        return due
+
+    def active(self, name: str) -> List[ChaosAction]:
+        """Windowed actions of ``name`` active right now."""
+        now = self._clock()
+        out = []
+        for a in self.actions:
+            if a.name == name and a.until_s is not None \
+                    and a.at_s <= now < a.until_s:
+                a.fired = True
+                out.append(a)
+        return out
+
+    # -- introspection ----------------------------------------------------
+    def fault_kinds(self) -> List[str]:
+        return sorted({st["kind"] for st in self.scenario.stages()})
+
+    def describe(self) -> dict:
+        return {"stages": self.scenario.stages(),
+                "actions": [a.to_dict() for a in self.actions]}
+
+
+def standard_plan(clock: Callable[[], float], *, t0: float = 30.0,
+                  window: float = 30.0, hot: str = "hot",
+                  mut: str = "mut", cold: str = "cold",
+                  guard_site: str = "soak.serve",
+                  burst: int = 30) -> ChaosPlan:
+    """The canonical compressed drill, scaled around a chaos window of
+    ``[t0, t0 + window)`` sim-seconds:
+
+    * ``kernel_fault`` on the hot tenant's guarded serving site for the
+      first half of the window — breaker opens, exact fallback serves,
+      probe re-closes after probation (→ ``heal.mttr.soak.serve``);
+    * ``io_error`` on segment saves for the first half — the mutable
+      merge abandons, its breaker opens, the post-window probe merge
+      commits (→ ``heal.mttr.mutable.merge``);
+    * one ``wal_torn_tail`` and one ``crash_point`` (pre-flip) on the
+      mutation tenant — acked-write durability through crash recovery;
+    * ``shard_dead`` on the sharded chaos target for the first half
+      (→ ``shard.mttr`` once the post-window probe restores it);
+    * an overload burst of ``burst`` extra hot-tenant requests per tick
+      for the middle third — sheds, SLO breach, brownout step;
+    * a zero-downtime swap of the cold tenant mid-window.
+    """
+    half = window / 2.0
+    plan = ChaosPlan(clock)
+    plan.add_fault("kernel_fault", guard_site, at_s=t0, until_s=t0 + half)
+    plan.add_fault("io_error", "core.serialize.*", at_s=t0,
+                   until_s=t0 + half)
+    plan.add_fault("wal_torn_tail", "core.wal.append", at_s=t0 + 2.0,
+                   until_s=t0 + window, count=1)
+    # The io_error window opens the merge breaker; no merge reaches
+    # pre_flip until its ~30 s probation elapses AND the probe merge
+    # has re-closed it (an InjectedCrash *during* the probe would
+    # re-arm the breaker for another 30 s and starve the MTTR verdict).
+    # Worst case the breaker opens a merge-cadence (~8 s) into the
+    # window, so arm the crash safely past probe time and keep it armed
+    # long enough for the next ordinary merge to walk into it.
+    crash_at = t0 + half + 34.0
+    plan.add_fault("crash_point", "mutable.merge.pre_flip",
+                   at_s=crash_at, until_s=crash_at + 40.0, count=1)
+    plan.add_fault("shard_dead", "sharded_ann.cagra.shard0",
+                   at_s=t0, until_s=t0 + half)
+    plan.add_action("overload", t0 + window / 3.0,
+                    t0 + 2.0 * window / 3.0, tenant=hot, extra=burst)
+    plan.add_action("swap", t0 + half, tenant=cold)
+    return plan

@@ -1,0 +1,584 @@
+"""The soak harness: a time-compressed production day on one clock.
+
+:class:`SoakHarness` wires everything the tree ships into one seeded,
+deterministic run:
+
+* a :class:`~raft_tpu.serve.tenancy.ServeFabric` (``autostart=False``
+  — the harness drives ``drain_once`` itself, so scheduling is a pure
+  function of the seed) serving three mutable-tier tenants, each an
+  exact ``brute_force``-family :class:`MutableIndex` shadowed by a
+  numpy :class:`~raft_tpu.soak.workload.ShadowCorpus` oracle;
+* a :class:`~raft_tpu.serve.debugz.SnapshotWriter` used hook-first
+  (its thread never starts): per-index ``maintenance`` wrappers,
+  ``sharded_ann.probe_all``, and the fabric's own tick (SLO poll,
+  brownout, swap retires) all run from ``writer.tick()`` every
+  simulated second;
+* a :class:`~raft_tpu.soak.chaos.ChaosPlan` arming kernel faults, WAL
+  torn tails, merge crash points, io errors, shard deaths, overload
+  bursts and a live swap against the same
+  :class:`~raft_tpu.soak.workload.SimClock` every other component
+  reads — a 30 s breaker probation, a 600 s backoff cap and a chaos
+  window all compress into however fast the loop can tick;
+* an :class:`~raft_tpu.soak.invariants.InvariantSuite` checked every
+  tick, not at the end.
+
+An :class:`~raft_tpu.core.faults.InjectedCrash` anywhere in the tick
+(a WAL append, a merge crash point) is handled the only honest way: the
+in-memory index object is discarded, ``mutable.recover`` replays the
+WAL chain from disk, and the recovered index is swapped into the
+serving tenant under live traffic — the durability invariant then
+states that exactly the acked writes survived.
+
+The run's verdict is a strict-JSON artifact: phase timeline, the chaos
+plan as armed, per-fault-kind MTTR (simulated seconds), the violation
+list (empty = PASS), and per-tenant serving totals. Every field is a
+pure function of the seed — the determinism test diffs two same-seed
+artifacts byte-for-byte.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import events, faults
+from ..neighbors import mutable as mutable_mod
+from ..ops import guarded
+from ..parallel import sharded_ann
+from ..serve import debugz
+from ..serve import degrade as degrade_mod
+from ..serve import metrics
+from ..serve import slo as slo_mod
+from ..serve import warmup as warmup_mod
+from ..serve.batcher import BucketLadder
+from ..serve.qcache import QueryCache
+from ..serve.tenancy import RateLimitedError, ServeFabric
+from .chaos import ChaosPlan, standard_plan
+from .invariants import InvariantSuite
+from .workload import ShadowCorpus, SimClock, TenantLoad, WorkloadGen
+
+__all__ = ["SoakConfig", "SoakHarness", "run_soak"]
+
+ARTIFACT_SCHEMA = "soak/v1"
+
+# the hot tenant's guarded serving site: primary and fallback are the
+# same exact search, so kernel_fault drills the breaker arc (and its
+# heal.mttr verdict) with zero recall impact; registered in
+# ops/guarded.POLICIES like every other guarded site
+SERVE_SITE = "soak.serve"
+
+
+@dataclasses.dataclass
+class SoakConfig:
+    """Knobs for one soak run. Defaults are the tier-1 smoke scale; the
+    full drill stretches ``duration_s`` (see ``RAFT_TPU_SOAK_SECONDS``
+    in tests/test_soak.py and scratch/run_soak.py)."""
+
+    seed: int = 0
+    duration_s: float = 120.0      # simulated seconds
+    dt: float = 1.0                # simulated seconds per tick
+    dim: int = 16
+    k: int = 8
+    initial_rows: int = 256
+    merge_rows: int = 40           # mutable delta threshold → frequent merges
+    service_dt: float = 0.01       # sim-clock cost of one drain round
+    chaos_t0: float = 30.0
+    chaos_window: float = 30.0
+    overload_extra: int = 60       # extra hot requests/tick during burst
+    crash_restart_s: float = 2.0   # simulated process-restart cost
+    recall_floor: float = 0.75
+    cold_p99_s: float = 0.25
+    hot_p99_target_s: float = 0.2
+    sample_every: int = 10         # timeline sample cadence (ticks)
+    durability_every: int = 5      # sampled id-visibility cadence (ticks)
+    recall_samples: int = 2        # served batches recall-checked per tick
+
+    @classmethod
+    def smoke(cls, seed: int = 0) -> "SoakConfig":
+        """Tier-1 scale: every chaos stage and every MTTR arc still
+        land (the plan's probe/backoff arithmetic needs ~56 sim-s after
+        chaos onset), compressed to a few wall-seconds on CPU."""
+        return cls(seed=seed, duration_s=72.0, chaos_t0=16.0,
+                   chaos_window=20.0)
+
+    def phases(self) -> List[Tuple[str, float, float]]:
+        t0, w, dur = self.chaos_t0, self.chaos_window, self.duration_s
+        warm_end = min(10.0, t0 / 2.0)
+        rec_end = min(dur - 4.0, t0 + w + 40.0)
+        return [("warmup", 0.0, warm_end),
+                ("steady", warm_end, t0),
+                ("chaos", t0, t0 + w),
+                ("recovery", t0 + w, rec_end),
+                ("steady2", rec_end, dur - 2.0),
+                ("quiesce", dur - 2.0, dur)]
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# phases in which the zero-recompile and cold-p99 invariants are armed:
+# chaos and recovery ticks may legitimately compile (crash recovery,
+# merge probes); steady traffic must not
+_STEADY_PHASES = ("steady", "steady2", "quiesce")
+
+
+class SoakHarness:
+    """One composed soak run. Build, call :meth:`run`, read the
+    artifact. Construction wires but does not serve; ``run`` owns the
+    tick loop and restores every patched global on exit."""
+
+    def __init__(self, config: SoakConfig, workdir: str,
+                 plan: Optional[ChaosPlan] = None):
+        self.cfg = config
+        self.workdir = pathlib.Path(workdir)
+        self.workdir.mkdir(parents=True, exist_ok=True)
+        self.clock = SimClock()
+        self.plan = plan if plan is not None else standard_plan(
+            self.clock, t0=config.chaos_t0, window=config.chaos_window,
+            guard_site=SERVE_SITE, burst=config.overload_extra)
+        self.suite = InvariantSuite(recall_floor=config.recall_floor,
+                                    cold_p99_s=config.cold_p99_s)
+        self.workload = WorkloadGen(
+            config.seed, config.dim,
+            [TenantLoad("hot", rows_per_request=4, requests_per_tick=6.0,
+                        upserts_per_tick=4, deletes_per_tick=1),
+             TenantLoad("mut", rows_per_request=4, requests_per_tick=3.0,
+                        upserts_per_tick=6, deletes_per_tick=2),
+             TenantLoad("cold", rows_per_request=4, requests_per_tick=2.0,
+                        query_pool=8)],
+            k=config.k)
+        self._indexes: Dict[str, mutable_mod.MutableIndex] = {}
+        self._oracles: Dict[str, ShadowCorpus] = {}
+        self._paths: Dict[str, pathlib.Path] = {}
+        self._maint_tenant: Optional[str] = None
+        self._mttr: Dict[str, List[float]] = {}
+        self._overload: Dict[str, Optional[float]] = {
+            "first": None, "last": None}
+        self._swap_count = 0
+        self.fabric: Optional[ServeFabric] = None
+        self.writer: Optional[debugz.SnapshotWriter] = None
+        self.sharded = None
+        self._cursor = 0
+        self._hist_base: Dict[str, Tuple[int, float]] = {}
+
+    # -- construction -----------------------------------------------------
+    def _make_index(self, name: str, ids, vecs) -> mutable_mod.MutableIndex:
+        path = self.workdir / f"{name}_g{self._swap_count}"
+        idx = mutable_mod.create(str(path), dataset=vecs, ids=ids,
+                                 family="brute_force")
+        idx._clock = self.clock
+        idx.merge_rows = self.cfg.merge_rows
+        self._paths[name] = path
+        self._indexes[name] = idx
+        return idx
+
+    def _hot_search_fn(self):
+        def soak_hot_search(queries, k, res=None):
+            idx = self._indexes["hot"]
+            return guarded.guarded_call(
+                "soak.serve",
+                lambda: idx.search(queries, k),
+                lambda: idx.search(queries, k))
+        return soak_hot_search
+
+    def _maintenance_hook(self, name: str):
+        def hook():
+            self._maint_tenant = name
+            self._indexes[name].maintenance()
+        hook.__name__ = hook.__qualname__ = f"soak_maintenance_{name}"
+        return hook
+
+    def _make_sharded_target(self):
+        """A handmade two-shard CAGRA as the shard-death chaos target:
+        probe_all (already on the writer's hook slot) detects the armed
+        ``shard_dead`` and later restores the shard, driving the
+        ``shard.mttr`` histogram."""
+        import jax
+        from jax.sharding import Mesh
+
+        from ..distance.distance_types import DistanceType
+
+        devs = jax.devices()
+        mesh = Mesh(np.array((devs * 2)[:2]), ("shard",))
+        rng = np.random.default_rng(self.cfg.seed + 1)
+        data = rng.standard_normal((2, 8, 4)).astype(np.float32)
+        graphs = rng.integers(0, 8, (2, 8, 2)).astype(np.int32)
+        return sharded_ann.ShardedCagra(
+            mesh, data, graphs, np.array([0, 5]), np.array([5, 3]),
+            n_total=8, metric=DistanceType.L2Expanded)
+
+    def _shard_watch_hook(self):
+        """The serving path's shard-death detection on the maintenance
+        cadence: a shard with an armed ``shard_dead``/``shard_timeout``
+        is marked failed (consuming the firing, exactly like a sharded
+        search's ``_shard_health`` would); ``probe_all`` later restores
+        it once the fault clears, closing the ``shard.mttr`` arc."""
+        def soak_shard_watch():
+            idx = self.sharded
+            ok = np.asarray(idx.shards_ok, bool)
+            for i in range(len(ok)):
+                site = f"sharded_ann.{idx.family}.shard{i}"
+                if ok[i] and (
+                        faults.fired("shard_dead", site) is not None
+                        or faults.fired("shard_timeout", site)
+                        is not None):
+                    idx.mark_shard_failed(i)
+        return soak_shard_watch
+
+    def _build(self) -> None:
+        cfg = self.cfg
+        ladder = BucketLadder((8,), (cfg.k,))
+        cache = QueryCache(capacity=256, max_rows=16)
+        self.fabric = ServeFabric(cfg.dim, ladder=ladder, name="soak",
+                                  cache=cache, clock=self.clock,
+                                  autostart=False)
+        for spec in self.workload.tenants:
+            name = spec.name
+            ids, vecs = self.workload.initial_corpus(name, cfg.initial_rows)
+            idx = self._make_index(name, ids, vecs)
+            oracle = ShadowCorpus(cfg.dim)
+            oracle.apply_upsert(ids, vecs)
+            self._oracles[name] = oracle
+            reg = metrics.Registry()
+            # the hot tenant also watches its shed rate: the overload
+            # burst drives it past the target, the SLO breach steps the
+            # brownout ladder, and recovery steps it back — the full
+            # degrade arc the invariant suite checks for legality
+            targets = slo_mod.Targets(
+                p99_latency_s=cfg.hot_p99_target_s,
+                max_shed_rate=0.3 if name == "hot" else None)
+            eng = slo_mod.SLOEngine(
+                targets, registry=reg, name=name, fast_window_s=5.0,
+                slow_window_s=15.0, clock=self.clock)
+            ctl = degrade_mod.BrownoutController(
+                [{"max_wait_scale": 2.0}], slo=eng, min_dwell_s=3.0,
+                up_after_s=10.0, registry=reg, name=name, clock=self.clock)
+            kwargs: dict = {"registry": reg, "slo": eng, "brownout": ctl}
+            if name == "hot":
+                kwargs.update(search_fn=self._hot_search_fn(),
+                              rate=12.0, burst=16.0, warm=True)
+            elif name == "cold":
+                kwargs.update(warm=True)
+            self.fabric.add_tenant(name, index=idx, **kwargs)
+        self.sharded = self._make_sharded_target()
+        hooks = [self._maintenance_hook(n) for n in self._indexes]
+        hooks.append(self._shard_watch_hook())
+        hooks.append(sharded_ann.probe_all)
+        self.writer = debugz.SnapshotWriter(
+            str(self.workdir / "debugz.json"), hooks=hooks,
+            fabric=self.fabric)
+
+    # -- crash handling ---------------------------------------------------
+    def _recover(self, name: str, kind: str) -> None:
+        """Simulated process restart for one tenant: pay the restart
+        cost on the sim clock, replay the WAL chain from disk, swap the
+        recovered index into the live tenant."""
+        t_down = self.clock.now
+        self.clock.advance(self.cfg.crash_restart_s)
+        idx = mutable_mod.recover(str(self._paths[name]))
+        idx._clock = self.clock
+        idx.merge_rows = self.cfg.merge_rows
+        self._indexes[name] = idx
+        tenant = self.fabric.tenant(name)
+        if name == "hot":
+            tenant.swap(new_index=idx, search_fn=self._hot_search_fn(),
+                        warm=True)
+        else:
+            tenant.swap(new_index=idx, warm=True)
+        self._mttr.setdefault(kind, []).append(
+            self.clock.now - t_down)
+
+    # -- chaos actions ----------------------------------------------------
+    def _apply_actions(self) -> Dict[str, int]:
+        extra: Dict[str, int] = {}
+        for act in self.plan.active("overload"):
+            extra[act.payload.get("tenant", "hot")] = \
+                int(act.payload.get("extra", self.cfg.overload_extra))
+        for act in self.plan.due_instants():
+            if act.name == "swap":
+                self._do_swap(act.payload.get("tenant", "cold"))
+        return extra
+
+    def _do_swap(self, name: str) -> None:
+        """Zero-downtime swap under live traffic: rebuild the tenant's
+        corpus from the oracle into a fresh index and flip."""
+        self._swap_count += 1
+        oracle = self._oracles[name]
+        ids = np.asarray(oracle.ids(), dtype=np.int64)
+        vecs = (np.stack([oracle.vector(int(i)) for i in ids])
+                if len(ids) else
+                np.zeros((0, self.cfg.dim), np.float32))
+        idx = self._make_index(name, ids, vecs)
+        tenant = self.fabric.tenant(name)
+        if name == "hot":
+            tenant.swap(new_index=idx, search_fn=self._hot_search_fn(),
+                        warm=True)
+        else:
+            tenant.swap(new_index=idx, warm=True)
+
+    # -- MTTR bookkeeping -------------------------------------------------
+    _HIST_KINDS = {"kernel_fault": f"heal.mttr.{SERVE_SITE}",
+                   "io_error": f"heal.mttr.{mutable_mod.MERGE_SITE}",
+                   "shard_dead": "shard.mttr"}
+
+    def _hist_baseline(self) -> None:
+        for hname in self._HIST_KINDS.values():
+            h = metrics.histogram(hname, metrics.MTTR_BUCKETS_S)
+            self._hist_base[hname] = (h.count, h.sum)
+
+    def _hist_delta(self, hname: str) -> Tuple[int, float]:
+        h = metrics.histogram(hname, metrics.MTTR_BUCKETS_S)
+        c0, s0 = self._hist_base.get(hname, (0, 0.0))
+        return h.count - c0, h.sum - s0
+
+    def _note_overload(self, sheds: int, active: bool) -> None:
+        ov = self._overload
+        if sheds > 0:
+            if ov["first"] is None:
+                ov["first"] = self.clock.now
+            ov["last"] = self.clock.now
+        elif ov["first"] is not None and ov["last"] is not None \
+                and not active and "overload" not in self._mttr:
+            self._mttr["overload"] = [
+                self.clock.now - ov["first"]]
+
+    def _mttr_verdict(self) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for kind in self.plan.fault_kinds() + ["overload"]:
+            hist = self._HIST_KINDS.get(kind)
+            if hist is not None:
+                cnt, ssum = self._hist_delta(hist)
+                mean = ssum / cnt if cnt else None
+                out[kind] = {"count": int(cnt),
+                             "mean_s": None if mean is None
+                             else round(mean, 3),
+                             "source": hist}
+            else:
+                vals = self._mttr.get(kind, [])
+                out[kind] = {"count": len(vals),
+                             "mean_s": (round(sum(vals) / len(vals), 3)
+                                        if vals else None),
+                             "source": "harness"}
+        return out
+
+    # -- the tick loop ----------------------------------------------------
+    def _phase_at(self, t: float) -> str:
+        for name, t0, t1 in self.cfg.phases():
+            if t0 <= t < t1:
+                return name
+        return "quiesce"
+
+    def run(self) -> dict:
+        cfg = self.cfg
+        saved = (guarded._clock, sharded_ann._clock)
+        guarded._clock = self.clock
+        sharded_ann._clock = self.clock
+        # re-arm exactly the breakers this soak drills: a prior run in
+        # the same process may have left them open past its own end
+        # (probation outlives short runs), which would silently skip
+        # the fault arc and break same-seed determinism
+        guarded.reset(sites=(SERVE_SITE, "mutable.merge"))
+        warmup_mod.install_recompile_watch()
+        events.attach_sink(str(self.workdir / "events.jsonl"))
+        _, self._cursor = events.drain_new(0)
+        timeline: List[dict] = []
+        phase_log: List[dict] = []
+        last_phase = None
+        try:
+            self._build()
+            self._hist_baseline()
+            self.plan.start()
+            tick = 0
+            while self.clock.now < cfg.duration_s:
+                t = self.clock.now
+                phase = self._phase_at(t)
+                if phase != last_phase:
+                    if phase_log:
+                        phase_log[-1]["t1_s"] = round(t, 3)
+                    phase_log.append({"name": phase, "t0_s": round(t, 3),
+                                      "t1_s": None})
+                    events.record("soak_phase", "soak.harness",
+                                  phase=phase, t_s=round(t, 3))
+                    last_phase = phase
+                self._tick(tick, phase, timeline)
+                tick += 1
+                self.clock.advance(cfg.dt)
+            if phase_log:
+                phase_log[-1]["t1_s"] = round(self.clock.now, 3)
+            self.plan.stop()
+            return self._artifact(tick, phase_log, timeline)
+        finally:
+            self.plan.stop()
+            try:
+                if self.fabric is not None:
+                    self.fabric.close(timeout=1.0)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+            events.detach_sink()
+            guarded._clock, sharded_ann._clock = saved
+
+    def _tick(self, tick: int, phase: str,
+              timeline: List[dict]) -> None:
+        cfg = self.cfg
+        quiesce = phase == "quiesce"
+        self.plan.step()
+        extra = self._apply_actions()
+
+        # 1. mutations: oracle applied ONLY after the index call
+        # returned — the WAL fsync'd return IS the durability ack
+        if not quiesce:
+            for mut in self.workload.mutations_for_tick(self._oracles):
+                idx = self._indexes[mut.tenant]
+                try:
+                    if mut.kind == "upsert":
+                        idx.upsert(np.asarray(mut.ids, np.int64),
+                                   mut.vectors)
+                        self._oracles[mut.tenant].apply_upsert(
+                            mut.ids, mut.vectors)
+                    else:
+                        idx.delete(np.asarray(mut.ids, np.int64))
+                        self._oracles[mut.tenant].apply_delete(mut.ids)
+                except faults.InjectedCrash as crash:
+                    self._recover(mut.tenant, crash.kind)
+
+        # 2. submits (overload extras ride the same stream)
+        submitted = []
+        sheds = 0
+        if not quiesce:
+            for qb in self.workload.queries_for_tick(extra):
+                try:
+                    req = self.fabric.submit(qb.tenant, qb.queries, cfg.k)
+                    submitted.append((qb.tenant, qb.queries, req))
+                except RateLimitedError:
+                    sheds += 1
+        self._note_overload(sheds, bool(extra))
+
+        # 3. drain: every round costs service_dt on the sim clock, so
+        # queue depth becomes real (simulated) latency — overload
+        # backlogs breach the hot SLO, cold isolation stays checkable
+        while True:
+            n = self.fabric.drain_once()
+            if n == 0:
+                break
+            self.clock.advance(cfg.service_dt)
+
+        # 4. maintenance slot: per-index merges, shard probes, fabric
+        # tick (SLO poll + brownout + swap retires). An InjectedCrash
+        # here is a merge crash point — recover the index it hit.
+        self._maint_tenant = None
+        try:
+            self.writer.tick()
+        except faults.InjectedCrash as crash:
+            self._recover(self._maint_tenant or "mut", crash.kind)
+
+        # 5. continuous invariants
+        t = self.clock.now
+        suite = self.suite
+        evts, self._cursor = events.drain_new(self._cursor)
+        suite.on_events(t, evts)
+        for name, _, req in submitted:
+            suite.expect(req.done(), t, "stranded_future", tenant=name)
+        if submitted and cfg.recall_samples:
+            k = min(len(submitted), cfg.recall_samples)
+            picks = self.workload.rng.choice(len(submitted), size=k,
+                                             replace=False)
+            for pi in sorted(int(i) for i in picks):
+                name, queries, req = submitted[pi]
+                if not req.done():
+                    continue
+                try:
+                    res = req.result(timeout=1.0)
+                except Exception:  # noqa: BLE001 - shed/err counted above
+                    continue
+                suite.check_recall(t, name, queries,
+                                   np.asarray(res.indices), cfg.k,
+                                   self._oracles[name])
+        for name, idx in self._indexes.items():
+            oracle = self._oracles[name]
+            sample_ids: tuple = ()
+            if tick % cfg.durability_every == 0 and oracle.size:
+                live = oracle.ids()
+                picks = self.workload.rng.choice(
+                    len(live), size=min(2, len(live)), replace=False)
+                sample_ids = tuple(int(live[i])
+                                   for i in sorted(int(p) for p in picks))
+            suite.check_durability(t, name, idx, oracle, sample_ids,
+                                   k=cfg.k, pad_rows=8)
+        suite.check_cold_p99(t, "cold",
+                             self.fabric.tenant("cold").registry)
+        suite.check_json_snapshot(
+            t, debugz.snapshot(registry=metrics.default_registry,
+                               fabric=self.fabric))
+        suite.on_tick_end(t, steady=phase in _STEADY_PHASES)
+
+        # 6. timeline sample
+        if tick % cfg.sample_every == 0:
+            sample = {"t_s": round(t, 3), "phase": phase,
+                      "tenants": {}}
+            for tn in self.fabric.tenants():
+                reg = tn.registry.snapshot()["counters"]
+                sample["tenants"][tn.name] = {
+                    "rows": int(self._indexes[tn.name].size),
+                    "requests": int(reg.get(f"{tn.name}.requests", 0)),
+                    "served": int(reg.get(f"{tn.name}.served", 0)),
+                    "shed": int(reg.get(f"{tn.name}.shed", 0)),
+                    "generation": int(tn.generation),
+                }
+            timeline.append(sample)
+
+    # -- verdict ----------------------------------------------------------
+    def _artifact(self, ticks: int, phase_log: List[dict],
+                  timeline: List[dict]) -> dict:
+        tenants = {}
+        for tn in self.fabric.tenants():
+            cs = tn.registry.snapshot()["counters"]
+            tenants[tn.name] = {
+                "rows": int(self._indexes[tn.name].size),
+                "requests": int(cs.get(f"{tn.name}.requests", 0)),
+                "served": int(cs.get(f"{tn.name}.served", 0)),
+                "shed": int(cs.get(f"{tn.name}.shed", 0)),
+                "generation": int(tn.generation),
+                "qcache_hits": int(cs.get(f"{tn.name}.qcache.hits", 0)),
+            }
+        violations = self.suite.to_list()
+        mttr = self._mttr_verdict()
+        art = {
+            "schema": ARTIFACT_SCHEMA,
+            "seed": int(self.cfg.seed),
+            "config": self.cfg.to_dict(),
+            "sim_duration_s": round(self.clock.now, 3),
+            "ticks": int(ticks),
+            "phases": phase_log,
+            "chaos": self.plan.describe(),
+            "tenants": tenants,
+            "mttr": mttr,
+            "violations": violations,
+            "verdict": "PASS" if not violations else "FAIL",
+        }
+        # the artifact IS the verdict — it must hold itself to the same
+        # strict-JSON bar the debugz snapshots are held to
+        json.dumps(art, allow_nan=False)
+        return art
+
+
+def run_soak(config: Optional[SoakConfig] = None,
+             workdir: Optional[str] = None,
+             plan: Optional[ChaosPlan] = None,
+             artifact_path: Optional[str] = None) -> dict:
+    """Build, run, and optionally persist one soak. The convenience
+    entry scratch/run_soak.py and the tests both come through here."""
+    import tempfile
+
+    cfg = config or SoakConfig()
+    wd = workdir or tempfile.mkdtemp(prefix="raft_tpu_soak_")
+    art = SoakHarness(cfg, wd, plan=plan).run()
+    if artifact_path:
+        p = pathlib.Path(artifact_path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        with open(p, "w") as f:
+            json.dump(art, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return art

@@ -1,0 +1,196 @@
+"""Continuous invariants for the soak harness.
+
+Violations are the soak's currency: the verdict is PASS exactly when
+this suite's list is empty at the end of the run. Every check runs
+every tick (not just at quiesce), so a transient bug — a stale cache
+hit that later self-corrects, a briefly-stranded future, a recompile
+burst that settles — is caught in the act instead of washed out by the
+final state.
+
+The suite owns every check that needs *cross-tick* state:
+
+* breaker event legality per site (open → probe → close|re-open, from
+  the drained event stream);
+* brownout ladder legality (one step at a time, continuous levels);
+* the zero-steady-state-recompile watch (``serve.recompiles`` deltas,
+  with a short grace window after merge flips / swaps / recoveries,
+  whose *first* post-change dispatch may legitimately compile a fresh
+  tombstone-filter executable);
+* acked-write durability (exact ``index.size == oracle`` row-count
+  equality plus sampled id-visibility probes);
+* strict-JSON debugz snapshots (``json.dumps(..., allow_nan=False)``).
+
+Point-in-time checks (recall vs oracle, stranded futures, cold-tenant
+p99 bounds) come in through :meth:`expect` with the harness holding
+the context.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Violation", "InvariantSuite"]
+
+# events that legitimately change a tenant's executable set: the next
+# dispatch or two may compile (new sealed row-count, first tombstone
+# filter at the new shape) without that being a steady-state recompile
+_RECOMPILE_EXEMPT_KINDS = ("merge_committed", "tenant_swap",
+                           "wal_recovered")
+
+
+@dataclasses.dataclass
+class Violation:
+    t_s: float
+    name: str
+    detail: dict
+
+    def to_dict(self) -> dict:
+        return {"t_s": round(self.t_s, 3), "name": self.name,
+                "detail": self.detail}
+
+
+class InvariantSuite:
+    def __init__(self, *, recall_floor: float = 0.75,
+                 cold_p99_s: float = 0.25, recompile_grace_ticks: int = 2,
+                 registry=None):
+        from ..serve import metrics as _metrics
+
+        self.recall_floor = float(recall_floor)
+        self.cold_p99_s = float(cold_p99_s)
+        self.violations: List[Violation] = []
+        self._reg = registry or _metrics.default_registry
+        self._breaker: Dict[str, str] = {}          # site -> state
+        self._brown: Dict[str, int] = {}            # name -> level
+        self._last_recompiles: Optional[float] = None
+        self._grace = 0
+        self._grace_ticks = int(recompile_grace_ticks)
+
+    # -- plumbing ---------------------------------------------------------
+    def fail(self, t: float, name: str, **detail) -> None:
+        self.violations.append(Violation(float(t), name, detail))
+
+    def expect(self, cond: bool, t: float, name: str, **detail) -> bool:
+        if not cond:
+            self.fail(t, name, **detail)
+        return bool(cond)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_list(self) -> List[dict]:
+        return [v.to_dict() for v in self.violations]
+
+    # -- event-stream legality (cross-tick state machines) ----------------
+    def on_events(self, t: float, evts: List[dict]) -> None:
+        """Consume this tick's drained events: breaker and brownout
+        transition legality, and recompile-grace bookkeeping."""
+        for e in evts:
+            kind, site = e.get("kind"), e.get("site", "")
+            if kind in _RECOMPILE_EXEMPT_KINDS:
+                self._grace = self._grace_ticks + 1
+            if kind == "dispatch_error":
+                # no chaos stage in the soak legitimately errors a
+                # dispatch (kernel faults fall back, crashes recover):
+                # a request-visible error is always a violation
+                self.fail(t, "dispatch_error", site=site,
+                          error=e.get("error"))
+            elif kind == "breaker_open":
+                # legal from any state: first demotion opens, a failed
+                # probe re-opens with doubled backoff
+                self._breaker[site] = "open"
+            elif kind == "breaker_probe":
+                self.expect(self._breaker.get(site) == "open", t,
+                            "breaker_probe_without_open", site=site,
+                            state=self._breaker.get(site, "closed"))
+                self._breaker[site] = "probing"
+            elif kind == "breaker_close":
+                self.expect(self._breaker.get(site) == "probing", t,
+                            "breaker_close_without_probe", site=site,
+                            state=self._breaker.get(site, "closed"))
+                self._breaker[site] = "closed"
+            elif kind == "brownout":
+                lv_from = int(e.get("level_from", -1))
+                lv_to = int(e.get("level_to", -1))
+                last = self._brown.get(site, 0)
+                self.expect(lv_from == last, t, "brownout_discontinuity",
+                            site=site, expected_from=last, got=lv_from)
+                self.expect(abs(lv_to - lv_from) == 1 and lv_to >= 0, t,
+                            "brownout_step_illegal", site=site,
+                            level_from=lv_from, level_to=lv_to)
+                self._brown[site] = lv_to
+
+    # -- steady-state recompiles ------------------------------------------
+    def on_tick_end(self, t: float, *, steady: bool) -> None:
+        """Close out one tick: diff ``serve.recompiles``. A positive
+        delta is a violation only in a steady phase outside the
+        post-flip grace window — chaos and recovery ticks may compile
+        (new generations, recovered indexes), steady traffic must
+        not."""
+        cur = self._reg.counter("serve.recompiles").value
+        prev, self._last_recompiles = self._last_recompiles, cur
+        in_grace = self._grace > 0
+        if self._grace > 0:
+            self._grace -= 1
+        if prev is None:
+            return
+        delta = cur - prev
+        if delta > 0 and steady and not in_grace:
+            self.fail(t, "steady_state_recompile", count=delta)
+
+    # -- durability -------------------------------------------------------
+    def check_durability(self, t: float, tenant: str, index,
+                         oracle, sample_ids=(), *, k: int = 8,
+                         pad_rows: int = 8) -> None:
+        """Exact live-row-count equality plus sampled acked-id
+        visibility: the stored vector's nearest neighbor must be the id
+        itself (exact tenants). The probe batch is padded to the served
+        dispatch shape ``(pad_rows, k)`` so it reuses the executable the
+        fabric already compiled — a durability check must not perturb
+        the zero-steady-state-recompile invariant it runs beside."""
+        self.expect(index.size == oracle.size, t, "durability_row_count",
+                    tenant=tenant, index_rows=int(index.size),
+                    oracle_rows=int(oracle.size))
+        if not sample_ids:
+            return
+        ids = [int(i) for i in sample_ids]
+        block = np.stack([oracle.vector(i) for i in ids])
+        reps = -(-pad_rows // len(ids))
+        block = np.tile(block, (reps, 1))[:pad_rows]
+        _, got = index.search(block, min(k, index.size))
+        got = np.asarray(got)
+        for j, row_id in enumerate(ids):
+            top1 = int(got[j, 0])
+            self.expect(top1 == row_id, t, "acked_write_invisible",
+                        tenant=tenant, row_id=row_id, got=top1)
+
+    # -- recall -----------------------------------------------------------
+    def check_recall(self, t: float, tenant: str, queries, got_ids,
+                     k: int, oracle) -> float:
+        r = oracle.recall_of(queries, np.asarray(got_ids), k)
+        self.expect(r >= self.recall_floor, t, "recall_below_floor",
+                    tenant=tenant, recall=round(float(r), 4),
+                    floor=self.recall_floor)
+        return r
+
+    # -- debugz strict JSON -----------------------------------------------
+    def check_json_snapshot(self, t: float, snap: dict) -> None:
+        try:
+            json.dumps(snap, allow_nan=False)
+        except (TypeError, ValueError) as exc:
+            self.fail(t, "debugz_snapshot_not_strict_json",
+                      error=repr(exc))
+
+    # -- latency isolation ------------------------------------------------
+    def check_cold_p99(self, t: float, tenant: str, registry) -> None:
+        h = registry.histogram(f"{tenant}.latency_s")
+        if h.count == 0:
+            return
+        p99 = h.percentile(99)
+        self.expect(math.isfinite(p99) and p99 <= self.cold_p99_s, t,
+                    "cold_tenant_p99_unbounded", tenant=tenant,
+                    p99_s=round(float(p99), 4), bound_s=self.cold_p99_s)

@@ -1,0 +1,246 @@
+"""Deterministic load generation for the soak harness: a simulated
+clock, a numpy shadow-corpus oracle, and a seeded Zipfian multi-tenant
+query + mutation stream.
+
+Three pieces, each independently testable:
+
+* :class:`SimClock` — the injectable monotonic clock every latency-,
+  backoff- and schedule-bearing component in the harness shares
+  (ServeFabric, SLOEngine, BrownoutController, faults.Scenario,
+  guarded breakers, sharded MTTR, MutableIndex merge deadlines). One
+  clock means a 30-second breaker probation elapses in one
+  ``advance(30)`` call: hours of production time compress into seconds
+  of wall time without loosening a single timeout.
+* :class:`ShadowCorpus` — a per-tenant id→vector dict mirroring every
+  *acknowledged* mutation. Because the soak serves exact brute-force
+  tenants, the oracle's top-k is the ground truth the served results
+  must match, and ``len(oracle) == index.size`` is an exact live-row
+  durability check at any instant.
+* :class:`WorkloadGen` — one ``numpy.random.default_rng(seed)`` drives
+  every draw in a fixed per-tick order (tenant choice, query noise,
+  mutation ids), so two same-seed runs submit byte-identical traffic.
+  Tenant skew is Zipfian over the declared tenant order; the "cold"
+  style tenant draws from a fixed query pool so repeats can hit the
+  fabric's query cache.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["SimClock", "ShadowCorpus", "TenantLoad", "WorkloadGen",
+           "Mutation", "QueryBatch"]
+
+
+class SimClock:
+    """Injectable monotonic clock. Calling it returns the current
+    simulated time; only :meth:`advance` moves it, and only forward —
+    every component that observes it therefore sees one coherent,
+    reproducible timeline."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def __call__(self) -> float:
+        return self._now
+
+    @property
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> float:
+        dt = float(dt)
+        if dt < 0:
+            raise ValueError(f"SimClock cannot run backwards (dt={dt})")
+        self._now += dt
+        return self._now
+
+
+class ShadowCorpus:
+    """Numpy oracle of one tenant's acknowledged rows.
+
+    The harness applies a mutation here only after the index call
+    returned (WAL fsync'd — the return *is* the ack); a mutation that
+    raised (torn WAL, injected crash) is deliberately not applied, so
+    after crash recovery ``index.size == len(oracle)`` states exactly
+    the durability contract: every acked write survived, no ghost rows
+    from un-acked writes.
+    """
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+        self._rows: Dict[int, np.ndarray] = {}
+        self._cache: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    @property
+    def size(self) -> int:
+        return len(self._rows)
+
+    def ids(self) -> List[int]:
+        return sorted(self._rows)
+
+    def vector(self, row_id: int) -> np.ndarray:
+        return self._rows[int(row_id)]
+
+    def apply_upsert(self, ids: Sequence[int], vectors: np.ndarray) -> None:
+        vectors = np.asarray(vectors, dtype=np.float32)
+        for i, row_id in enumerate(ids):
+            self._rows[int(row_id)] = vectors[i]
+        self._cache = None
+
+    def apply_delete(self, ids: Sequence[int]) -> int:
+        found = 0
+        for row_id in ids:
+            if self._rows.pop(int(row_id), None) is not None:
+                found += 1
+        if found:
+            self._cache = None
+        return found
+
+    def _matrix(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self._cache is None:
+            ids = np.asarray(sorted(self._rows), dtype=np.int64)
+            mat = (np.stack([self._rows[int(i)] for i in ids])
+                   if len(ids) else
+                   np.zeros((0, self.dim), dtype=np.float32))
+            self._cache = (ids, mat)
+        return self._cache
+
+    def true_knn(self, queries: np.ndarray, k: int) -> np.ndarray:
+        """Exact sqeuclidean top-k ids, float32 to match the index's
+        arithmetic; rows short of ``k`` pad with -1."""
+        ids, mat = self._matrix()
+        queries = np.asarray(queries, dtype=np.float32)
+        out = np.full((queries.shape[0], k), -1, dtype=np.int64)
+        if len(ids) == 0:
+            return out
+        d = ((queries[:, None, :] - mat[None, :, :]) ** 2).sum(-1)
+        kk = min(k, len(ids))
+        order = np.argsort(d, axis=1, kind="stable")[:, :kk]
+        out[:, :kk] = ids[order]
+        return out
+
+    def recall_of(self, queries: np.ndarray, got_ids: np.ndarray,
+                  k: int) -> float:
+        """Mean id-overlap@k of served neighbors vs the oracle's."""
+        truth = self.true_knn(queries, k)
+        got = np.asarray(got_ids)[:, :k]
+        hits = 0
+        denom = 0
+        for row_truth, row_got in zip(truth, got):
+            want = set(int(i) for i in row_truth if i >= 0)
+            if not want:
+                continue
+            hits += len(want & set(int(i) for i in row_got))
+            denom += len(want)
+        return hits / denom if denom else 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantLoad:
+    """One tenant's traffic shape. Zipf share comes from declaration
+    order (first tenant is the hottest); ``query_pool`` > 0 draws
+    queries from a fixed pool (byte-identical repeats → cacheable),
+    0 generates fresh queries each time."""
+
+    name: str
+    rows_per_request: int = 4
+    requests_per_tick: float = 4.0
+    upserts_per_tick: int = 0
+    deletes_per_tick: int = 0
+    query_pool: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryBatch:
+    tenant: str
+    queries: np.ndarray
+
+
+@dataclasses.dataclass(frozen=True)
+class Mutation:
+    tenant: str
+    kind: str                      # "upsert" | "delete"
+    ids: Tuple[int, ...]
+    vectors: Optional[np.ndarray]  # None for deletes
+
+
+class WorkloadGen:
+    """Seeded multi-tenant traffic source. All randomness flows through
+    one generator in a fixed per-tick order, so the full stream is a
+    pure function of (seed, tenant specs, tick index)."""
+
+    def __init__(self, seed: int, dim: int, tenants: Sequence[TenantLoad],
+                 *, zipf_s: float = 1.1, k: int = 8):
+        self.dim = int(dim)
+        self.k = int(k)
+        self.tenants = list(tenants)
+        self.rng = np.random.default_rng(int(seed))
+        shares = np.array([1.0 / (r + 1) ** zipf_s
+                           for r in range(len(self.tenants))])
+        self._shares = shares / shares.sum()
+        self._pools: Dict[str, np.ndarray] = {}
+        for t in self.tenants:
+            if t.query_pool > 0:
+                self._pools[t.name] = self.rng.standard_normal(
+                    (t.query_pool, t.rows_per_request, self.dim)
+                ).astype(np.float32)
+        self._next_id: Dict[str, int] = {}
+
+    def initial_corpus(self, tenant: str,
+                       rows: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Seed rows for one tenant; ids start at 0 and the per-tenant
+        id counter continues from there for later upserts."""
+        ids = np.arange(rows, dtype=np.int64)
+        vecs = self.rng.standard_normal((rows, self.dim)).astype(np.float32)
+        self._next_id[tenant] = rows
+        return ids, vecs
+
+    # -- per-tick streams -------------------------------------------------
+    def queries_for_tick(self, extra: Dict[str, int] = None
+                         ) -> List[QueryBatch]:
+        """This tick's query batches. ``extra`` adds requests on top of
+        a tenant's base rate (overload bursts)."""
+        out: List[QueryBatch] = []
+        for ti, spec in enumerate(self.tenants):
+            n = int(self.rng.poisson(spec.requests_per_tick))
+            n += int((extra or {}).get(spec.name, 0))
+            pool = self._pools.get(spec.name)
+            for _ in range(n):
+                if pool is not None:
+                    q = pool[int(self.rng.integers(len(pool)))]
+                else:
+                    q = self.rng.standard_normal(
+                        (spec.rows_per_request, self.dim)
+                    ).astype(np.float32)
+                out.append(QueryBatch(spec.name, q))
+        # Zipf-weighted shuffle: heavier tenants submit earlier more
+        # often, but every batch stays in the tick.
+        order = self.rng.permutation(len(out))
+        return [out[i] for i in order]
+
+    def mutations_for_tick(self, oracles: Dict[str, ShadowCorpus]
+                           ) -> List[Mutation]:
+        out: List[Mutation] = []
+        for spec in self.tenants:
+            if spec.upserts_per_tick > 0:
+                start = self._next_id.get(spec.name, 0)
+                ids = tuple(range(start, start + spec.upserts_per_tick))
+                self._next_id[spec.name] = start + spec.upserts_per_tick
+                vecs = self.rng.standard_normal(
+                    (spec.upserts_per_tick, self.dim)).astype(np.float32)
+                out.append(Mutation(spec.name, "upsert", ids, vecs))
+            if spec.deletes_per_tick > 0:
+                live = oracles[spec.name].ids()
+                if len(live) > spec.deletes_per_tick * 4:
+                    pick = self.rng.choice(len(live),
+                                           size=spec.deletes_per_tick,
+                                           replace=False)
+                    ids = tuple(int(live[i]) for i in sorted(pick))
+                    out.append(Mutation(spec.name, "delete", ids, None))
+        return out

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Soak verdict-artifact validator: machine-check ``soak_r16.json``.
+
+The soak harness self-validates while it runs (the invariant suite),
+but the ARTIFACT is what lands in review — this script re-derives the
+acceptance criteria from the file alone, so a stale, truncated, or
+hand-edited artifact fails loudly:
+
+* schema is ``soak/v1`` and the whole file is strict JSON
+  (``allow_nan=False`` round-trip);
+* the verdict is PASS and the violation list is empty (and the two
+  agree);
+* the phase timeline is contiguous (each phase ends where the next
+  begins), covers [0, sim_duration_s), and includes a chaos AND a
+  recovery phase;
+* every fault kind the chaos plan armed has a finite, positive MTTR
+  entry, and every armed stage actually fired;
+* every tenant served traffic.
+
+Usage::
+
+    python scratch/check_soak_artifact.py artifacts/soak_r16.json
+
+Exit status: 0 = valid, 1 = acceptance failure, 2 = unreadable/schema.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+REQUIRED_KEYS = ("schema", "seed", "config", "sim_duration_s", "ticks",
+                 "phases", "chaos", "tenants", "mttr", "violations",
+                 "verdict")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("artifact", help="path to a soak verdict artifact")
+    args = ap.parse_args()
+
+    try:
+        with open(args.artifact) as f:
+            art = json.load(f)
+        json.dumps(art, allow_nan=False)
+    except (OSError, ValueError) as exc:
+        print(f"SCHEMA: cannot load strict-JSON artifact: {exc}")
+        return 2
+    missing = [k for k in REQUIRED_KEYS if k not in art]
+    if missing or art.get("schema") != "soak/v1":
+        print(f"SCHEMA: schema={art.get('schema')!r} missing={missing}")
+        return 2
+
+    errs = []
+
+    # -- verdict <-> violations agreement ---------------------------------
+    if art["violations"]:
+        errs.append(f"{len(art['violations'])} invariant violations "
+                    f"(first: {art['violations'][0]})")
+    if art["verdict"] != ("PASS" if not art["violations"] else "FAIL"):
+        errs.append(f"verdict {art['verdict']!r} disagrees with the "
+                    f"violation list")
+
+    # -- phase timeline ----------------------------------------------------
+    phases = art["phases"]
+    names = [p["name"] for p in phases]
+    if not phases:
+        errs.append("empty phase timeline")
+    else:
+        if phases[0]["t0_s"] != 0.0:
+            errs.append(f"timeline starts at {phases[0]['t0_s']}, not 0")
+        for a, b in zip(phases, phases[1:]):
+            if a["t1_s"] != b["t0_s"]:
+                errs.append(f"phase gap: {a['name']} ends {a['t1_s']}, "
+                            f"{b['name']} starts {b['t0_s']}")
+        if phases[-1]["t1_s"] != art["sim_duration_s"]:
+            errs.append(f"timeline ends {phases[-1]['t1_s']} != "
+                        f"sim_duration_s {art['sim_duration_s']}")
+        for need in ("chaos", "recovery"):
+            if need not in names:
+                errs.append(f"no {need!r} phase in timeline {names}")
+
+    # -- chaos coverage and MTTR ------------------------------------------
+    stages = art["chaos"].get("stages", [])
+    armed = sorted({st["kind"] for st in stages})
+    if not armed:
+        errs.append("chaos plan armed no fault stages")
+    for st in stages:
+        if st.get("fires", 0) < 1:
+            errs.append(f"armed stage never fired: {st['kind']}@"
+                        f"{st.get('pattern')}")
+    for kind in armed:
+        m = art["mttr"].get(kind)
+        if m is None:
+            errs.append(f"no MTTR verdict for injected kind {kind!r}")
+            continue
+        if m.get("count", 0) < 1:
+            errs.append(f"MTTR for {kind!r} has zero recoveries")
+        mean = m.get("mean_s")
+        if not (isinstance(mean, (int, float)) and math.isfinite(mean)
+                and mean > 0):
+            errs.append(f"MTTR for {kind!r} not finite/positive: {mean!r}")
+
+    # -- traffic -----------------------------------------------------------
+    for name, t in sorted(art["tenants"].items()):
+        if t.get("served", 0) < 1:
+            errs.append(f"tenant {name!r} served no traffic")
+
+    if errs:
+        for e in errs:
+            print(f"FAIL: {e}")
+        return 1
+    print(f"OK: {args.artifact}: verdict PASS, "
+          f"{len(phases)} phases over {art['sim_duration_s']} sim-s, "
+          f"{len(armed)} fault kinds with finite MTTR "
+          f"({', '.join(armed)}), seed {art['seed']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

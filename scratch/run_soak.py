@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Run the time-compressed chaos soak and emit its verdict artifact.
+
+The deterministic core lives in :mod:`raft_tpu.soak` — this wrapper
+only parses knobs, times the wall clock (OUTSIDE the artifact, which
+must stay bit-identical per seed), and prints a human summary.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scratch/run_soak.py                 # full drill
+    JAX_PLATFORMS=cpu python scratch/run_soak.py --profile smoke
+    JAX_PLATFORMS=cpu python scratch/run_soak.py --seed 3 \
+        --json artifacts/soak_r16.json
+
+Exit status: 0 on a PASS verdict, 1 on FAIL (any invariant violation).
+Validate a saved artifact with ``scratch/check_soak_artifact.py``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--profile", choices=("full", "smoke"), default="full",
+                    help="full = 120 sim-s canonical drill; smoke = the "
+                         "72 sim-s tier-1 composition")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--duration", type=float, default=None,
+                    help="override sim duration_s")
+    ap.add_argument("--t0", type=float, default=None,
+                    help="override chaos window start (sim s)")
+    ap.add_argument("--window", type=float, default=None,
+                    help="override chaos window length (sim s)")
+    ap.add_argument("--json", dest="json_path", default=None,
+                    help="write the verdict artifact here")
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir for WAL/segments/events.jsonl "
+                         "(default: a fresh temp dir)")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from raft_tpu.soak import SoakConfig, run_soak
+
+    if args.profile == "smoke":
+        cfg = SoakConfig.smoke(seed=args.seed)
+    else:
+        cfg = SoakConfig(seed=args.seed)
+    overrides = {}
+    if args.duration is not None:
+        overrides["duration_s"] = args.duration
+    if args.t0 is not None:
+        overrides["chaos_t0"] = args.t0
+    if args.window is not None:
+        overrides["chaos_window"] = args.window
+    if overrides:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, **overrides)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="soak_")
+    t_wall = time.monotonic()
+    art = run_soak(cfg, workdir=workdir, artifact_path=args.json_path)
+    wall_s = time.monotonic() - t_wall
+
+    print(f"verdict    {art['verdict']}  "
+          f"({len(art['violations'])} violations)")
+    print(f"sim        {art['sim_duration_s']}s in {art['ticks']} ticks; "
+          f"wall {wall_s:.1f}s "
+          f"(compression {art['sim_duration_s'] / max(wall_s, 1e-9):.1f}x)")
+    print(f"phases     {' -> '.join(p['name'] for p in art['phases'])}")
+    for kind, v in sorted(art["mttr"].items()):
+        print(f"mttr       {kind:<14} n={v['count']} "
+              f"mean={v['mean_s']}s  ({v['source']})")
+    for t in sorted(art["tenants"]):
+        s = art["tenants"][t]
+        print(f"tenant     {t:<5} rows={s['rows']:<4} req={s['requests']:<5}"
+              f" served={s['served']:<5} shed={s['shed']:<4}"
+              f" gen={s['generation']} qcache_hits={s['qcache_hits']}")
+    for v in art["violations"][:10]:
+        print(f"VIOLATION  t={v['t_s']} {v['name']} {v['detail']}")
+    if args.json_path:
+        print(f"artifact   {args.json_path}")
+    print(f"workdir    {workdir}")
+    return 0 if art["verdict"] == "PASS" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

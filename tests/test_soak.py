@@ -1,0 +1,441 @@
+"""Soak harness tests: the time-compressed chaos drill (ISSUE 16).
+
+Four layers:
+
+* the PR's foundation satellites (events drain/sink, debugz hook-error
+  latches, MTTR histograms, Scenario.stages);
+* the soak building blocks (SimClock, ShadowCorpus oracle, seeded
+  workload, ChaosPlan);
+* the composed tier-1 smoke: every chaos stage, every MTTR arc, zero
+  invariant violations, deterministic per seed;
+* the merge-flip × Tenant.swap race (satellite: both paths bump the
+  generations the query cache keys on — no stale hit, no lost ack).
+
+The full-length drill rides the slow lane behind
+``RAFT_TPU_SOAK_SECONDS`` (simulated seconds, e.g. 600) — same
+harness, longer clock.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu.core import events, faults
+from raft_tpu.neighbors import mutable
+from raft_tpu.ops import guarded
+from raft_tpu.parallel import sharded_ann
+from raft_tpu.serve import debugz, metrics
+from raft_tpu.serve.qcache import QueryCache
+from raft_tpu.serve.tenancy import ServeFabric
+from raft_tpu.soak import (ChaosPlan, ShadowCorpus, SimClock, SoakConfig,
+                           SoakHarness, TenantLoad, WorkloadGen, run_soak,
+                           standard_plan)
+
+pytestmark = [pytest.mark.soak, pytest.mark.serve]
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    events.clear()
+    guarded.reset()
+    monkeypatch.setenv("RAFT_TPU_AUTOTUNE_CACHE", "")
+    yield
+    events.detach_sink()
+    guarded.reset()
+
+
+@pytest.fixture
+def clock():
+    return SimClock()
+
+
+# ---------------------------------------------------------------------------
+# foundation satellites
+# ---------------------------------------------------------------------------
+class TestEventsIncremental:
+    def test_drain_new_cursor(self):
+        events.record("upsert", "t.a")
+        items, cur = events.drain_new(0)
+        assert [e["site"] for e in items][-1] == "t.a"
+        again, cur2 = events.drain_new(cur)
+        assert again == [] and cur2 == cur
+        events.record("delete", "t.b")
+        fresh, cur3 = events.drain_new(cur)
+        assert [e["site"] for e in fresh] == ["t.b"] and cur3 == cur + 1
+
+    def test_attach_sink_streams_jsonl(self, tmp_path):
+        p = tmp_path / "ev.jsonl"
+        events.attach_sink(str(p))
+        events.record("upsert", "t.sink", rows=3)
+        events.detach_sink()
+        events.record("upsert", "t.after")   # must NOT land in the file
+        lines = [json.loads(ln) for ln in p.read_text().splitlines()]
+        assert [e["site"] for e in lines] == ["t.sink"]
+        assert lines[0]["rows"] == 3
+
+    def test_attach_sink_include_ring_prologue(self, tmp_path):
+        events.record("upsert", "t.before")
+        p = tmp_path / "ev.jsonl"
+        events.attach_sink(str(p), include_ring=True)
+        events.detach_sink()
+        sites = [json.loads(ln)["site"] for ln in p.read_text().splitlines()]
+        assert "t.before" in sites
+
+
+class TestHookErrorLatch:
+    def test_counts_and_transition_events(self, tmp_path):
+        reg = metrics.Registry()
+        boom = {"on": True}
+
+        def flaky_hook():
+            if boom["on"]:
+                raise RuntimeError("dead maintenance hook")
+
+        # hooks are named by __qualname__ (harness hooks set it); a
+        # test-local closure needs the same grooming
+        flaky_hook.__qualname__ = "flaky_hook"
+        w = debugz.SnapshotWriter(str(tmp_path / "z.json"), registry=reg,
+                                  hooks=[flaky_hook])
+        w.tick()
+        w.tick()
+        c = reg.counter("debugz.hook_errors.flaky_hook").value
+        assert c == 2            # counted per tick...
+        evs = [e for e in events.recent(kind="hook_error")]
+        assert len(evs) == 1     # ...flight-recorded once per transition
+        assert evs[0]["action"] == "failed"
+        boom["on"] = False
+        w.tick()
+        evs = [e for e in events.recent(kind="hook_error")]
+        assert [e["action"] for e in evs] == ["failed", "recovered"]
+
+    def test_injected_crash_propagates(self, tmp_path):
+        """InjectedCrash is process death — the latch must NOT absorb
+        it (the soak harness owns crash recovery attribution)."""
+        def dying_hook():
+            raise faults.InjectedCrash("crash_point", "t.hook")
+
+        w = debugz.SnapshotWriter(str(tmp_path / "z.json"),
+                                  registry=metrics.Registry(),
+                                  hooks=[dying_hook])
+        with pytest.raises(faults.InjectedCrash):
+            w.tick()
+
+
+class TestMttrMetrics:
+    def test_buckets_cover_recovery_scales(self):
+        assert metrics.MTTR_BUCKETS_S[-1] >= 3600.0
+        assert metrics.MTTR_BUCKETS_S[0] <= 0.5
+        assert max(metrics.LATENCY_BUCKETS_S) < 30.0  # why MTTR needs its own
+
+    def test_heal_mttr_observed_on_breaker_close(self, monkeypatch):
+        if any(f.kind in ("kernel_compile", "kernel_fault")
+               for f in faults.active()):
+            pytest.skip("ambient kernel faults keep the probe failing")
+        now = {"t": 0.0}
+        monkeypatch.setattr(guarded, "_clock", lambda: now["t"])
+        h = metrics.histogram("heal.mttr.select_k.kpass",
+                              metrics.MTTR_BUCKETS_S)
+        c0, s0 = h.count, h.sum
+
+        def boom():
+            raise RuntimeError("soak mttr drill")
+
+        assert guarded.guarded_call("select_k.kpass", boom,
+                                    lambda: "fb") == "fb"
+        now["t"] = 45.0           # past the 30s probation
+        assert guarded.guarded_call("select_k.kpass", lambda: "ok",
+                                    lambda: "fb") == "ok"
+        assert h.count == c0 + 1
+        assert abs((h.sum - s0) - 45.0) < 0.01
+
+    def test_shard_mttr_observed_on_restore(self, monkeypatch):
+        import jax
+        from jax.sharding import Mesh
+
+        now = {"t": 100.0}
+        monkeypatch.setattr(sharded_ann, "_clock", lambda: now["t"])
+        devs = jax.devices()
+        mesh = Mesh(np.array((devs * 2)[:2]), ("shard",))
+        data = np.zeros((2, 4, 4), np.float32)
+        graphs = np.zeros((2, 4, 2), np.int32)
+        idx = sharded_ann.ShardedCagra(
+            mesh, data, graphs, np.array([0, 2]), np.array([2, 2]),
+            n_total=4, metric=sharded_ann.DistanceType.L2Expanded)
+        h = metrics.histogram("shard.mttr", metrics.MTTR_BUCKETS_S)
+        c0, s0 = h.count, h.sum
+        idx.mark_shard_failed(1)
+        now["t"] = 117.5
+        idx.mark_shard_failed(1, ok=True)
+        assert h.count == c0 + 1
+        assert abs((h.sum - s0) - 17.5) < 0.01
+
+    def test_scenario_stages_json_view(self, clock):
+        sc = faults.Scenario(clock=clock)
+        sc.add("kernel_fault", "soak.serve", at_s=5.0, until_s=9.0)
+        sc.add("crash_point", "mutable.merge.pre_flip", at_s=1.0, count=1)
+        view = sc.stages()
+        assert [s["kind"] for s in view] == ["kernel_fault", "crash_point"]
+        assert view[0]["until_s"] == 9.0 and view[1]["count"] == 1
+        json.dumps(view)          # strictly serializable
+
+
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+class TestWorkload:
+    def test_sim_clock_monotonic(self, clock):
+        assert clock() == 0.0
+        clock.advance(2.5)
+        assert clock.now == 2.5
+        with pytest.raises(ValueError):
+            clock.advance(-1.0)
+
+    def test_shadow_corpus_oracle(self, rng):
+        o = ShadowCorpus(4)
+        vecs = rng.standard_normal((6, 4)).astype(np.float32)
+        o.apply_upsert(range(6), vecs)
+        assert o.size == 6
+        assert o.apply_delete([2, 99]) == 1
+        assert o.size == 5 and 2 not in o.ids()
+        # exact top-1 of a stored vector is itself
+        got = o.true_knn(vecs[3][None, :], 1)
+        assert int(got[0, 0]) == 3
+        # short-of-k pads with -1
+        got = o.true_knn(vecs[:1], 8)
+        assert (got[0, 5:] == -1).all()
+        assert o.recall_of(vecs[:2], o.true_knn(vecs[:2], 3), 3) == 1.0
+
+    def test_workload_deterministic_per_seed(self):
+        spec = [TenantLoad("a", upserts_per_tick=2, deletes_per_tick=1),
+                TenantLoad("b", query_pool=4)]
+
+        def stream(seed):
+            wl = WorkloadGen(seed, 8, spec)
+            oracles = {}
+            for t in spec:
+                ids, vecs = wl.initial_corpus(t.name, 32)
+                oracles[t.name] = ShadowCorpus(8)
+                oracles[t.name].apply_upsert(ids, vecs)
+            out = []
+            for _ in range(5):
+                out.append([(q.tenant, q.queries.tobytes())
+                            for q in wl.queries_for_tick()])
+                for m in wl.mutations_for_tick(oracles):
+                    out.append((m.tenant, m.kind, m.ids))
+                    if m.kind == "upsert":
+                        oracles[m.tenant].apply_upsert(m.ids, m.vectors)
+                    else:
+                        oracles[m.tenant].apply_delete(m.ids)
+            return out
+
+        assert stream(3) == stream(3)
+        assert stream(3) != stream(4)
+
+
+class TestChaosPlan:
+    def test_actions_fire_once_and_window(self, clock):
+        plan = ChaosPlan(clock)
+        plan.add_action("swap", 5.0, tenant="cold")
+        plan.add_action("overload", 3.0, 7.0, extra=10)
+        assert plan.due_instants() == [] and plan.active("overload") == []
+        clock.advance(4.0)
+        assert [a.payload["extra"] for a in plan.active("overload")] == [10]
+        assert plan.due_instants() == []
+        clock.advance(2.0)          # t=6: swap due, overload still active
+        assert [a.name for a in plan.due_instants()] == ["swap"]
+        assert plan.due_instants() == []      # fires once
+        clock.advance(2.0)          # t=8: window closed
+        assert plan.active("overload") == []
+
+    def test_standard_plan_composition(self, clock):
+        plan = standard_plan(clock, t0=10.0, window=10.0)
+        kinds = plan.fault_kinds()
+        assert {"kernel_fault", "io_error", "wal_torn_tail",
+                "crash_point", "shard_dead"} == set(kinds)
+        desc = plan.describe()
+        assert len(desc["actions"]) == 2
+        json.dumps(desc)
+
+    def test_describe_is_deterministic(self):
+        c1, c2 = SimClock(), SimClock()
+        assert standard_plan(c1).describe() == standard_plan(c2).describe()
+
+
+# ---------------------------------------------------------------------------
+# the composed drill
+# ---------------------------------------------------------------------------
+def _skip_under_ambient_faults():
+    if any(f.kind in ("kernel_compile", "kernel_fault")
+           for f in faults.active()):
+        pytest.skip("ambient kernel faults would double-arm the "
+                    "soak's own chaos plan")
+
+
+class TestSoakSmoke:
+    def test_smoke_composition_zero_violations(self, tmp_path):
+        """The tier-1 acceptance drill: mutation + merge + swap + shard
+        death + kernel fault + WAL tear + io errors + overload under
+        Zipfian multi-tenant load, zero invariant violations, finite
+        MTTR for every injected fault kind."""
+        _skip_under_ambient_faults()
+        art = run_soak(SoakConfig.smoke(seed=7),
+                       workdir=str(tmp_path / "soak"))
+        assert art["verdict"] == "PASS"
+        assert art["violations"] == []
+        json.dumps(art, allow_nan=False)      # the artifact is strict JSON
+        # every fault kind the plan armed recovered in finite sim time
+        for kind, rec in art["mttr"].items():
+            assert rec["count"] >= 1, f"{kind} never completed an MTTR arc"
+            assert rec["mean_s"] is not None and rec["mean_s"] > 0.0
+        # phase timeline is annotated and contiguous
+        names = [p["name"] for p in art["phases"]]
+        assert names[0] == "warmup" and names[-1] == "quiesce"
+        assert "chaos" in names and "recovery" in names
+        for a, b in zip(art["phases"], art["phases"][1:]):
+            assert a["t1_s"] == b["t0_s"]
+        # composition really happened: traffic served on every tenant,
+        # sheds only on the overloaded one, cache hits on the cold one,
+        # swaps/recoveries bumped generations
+        tn = art["tenants"]
+        assert all(v["served"] > 0 for v in tn.values())
+        assert tn["hot"]["shed"] > 0 and tn["cold"]["shed"] == 0
+        assert tn["cold"]["qcache_hits"] > 0
+        assert tn["cold"]["generation"] >= 1      # scheduled live swap
+        assert tn["hot"]["generation"] >= 1       # crash recovery swap
+        # events streamed incrementally to the sink
+        sink = (tmp_path / "soak" / "events.jsonl").read_text().splitlines()
+        kinds = {json.loads(ln)["kind"] for ln in sink}
+        assert {"soak_phase", "merge_committed", "tenant_swap",
+                "breaker_open", "breaker_close", "wal_recovered",
+                "shard_restored", "brownout"} <= kinds
+
+    def test_same_seed_same_verdict(self, tmp_path):
+        """Determinism: two same-seed runs produce the same chaos
+        schedule, timeline, and verdict — the artifact dicts are
+        equal."""
+        _skip_under_ambient_faults()
+        # a short run: the full fault arcs live in the smoke test; this
+        # one only has to prove schedule/verdict determinism cheaply
+        cfg = SoakConfig(seed=11, duration_s=24.0, chaos_t0=8.0,
+                         chaos_window=10.0)
+        a = run_soak(cfg, workdir=str(tmp_path / "a"))
+        b = run_soak(cfg, workdir=str(tmp_path / "b"))
+        assert a == b
+        # and a different seed genuinely changes the run
+        cfg2 = SoakConfig(seed=12, duration_s=24.0, chaos_t0=8.0,
+                          chaos_window=10.0)
+        c = run_soak(cfg2, workdir=str(tmp_path / "c"))
+        assert c["tenants"] != a["tenants"]
+
+    @pytest.mark.slow
+    def test_full_drill(self, tmp_path):
+        """The long soak: RAFT_TPU_SOAK_SECONDS simulated seconds
+        (default 600) of the same composed drill."""
+        _skip_under_ambient_faults()
+        sim_s = float(os.environ.get("RAFT_TPU_SOAK_SECONDS", "600"))
+        art = run_soak(SoakConfig(seed=7, duration_s=sim_s),
+                       workdir=str(tmp_path / "soak_full"))
+        assert art["verdict"] == "PASS", art["violations"][:5]
+        for kind, rec in art["mttr"].items():
+            assert rec["count"] >= 1 and rec["mean_s"] is not None
+
+
+# ---------------------------------------------------------------------------
+# merge flip × Tenant.swap race (satellite)
+# ---------------------------------------------------------------------------
+class TestMergeSwapRace:
+    """Both a mutable merge flip and a Tenant.swap bump generations the
+    query cache keys on (``sig|g<gen>|m<merge_gen>``). Racing them on
+    one tenant must never serve a stale cached block nor lose an acked
+    write — including when the merge dies at a crash point mid-race."""
+
+    def _fabric_with(self, idx, clock):
+        fab = ServeFabric(idx.dim, cache=QueryCache(capacity=64),
+                          name="race", clock=clock, autostart=False)
+        fab.add_tenant("t", index=idx)
+        return fab
+
+    def _serve(self, fab, q, k=4):
+        req = fab.submit("t", q, k)
+        while fab.drain_once():
+            pass
+        assert req.done()
+        return req.result(timeout=5)
+
+    def test_flip_racing_swap_no_stale_hit_no_lost_ack(self, tmp_path,
+                                                       rng, clock):
+        X = rng.standard_normal((96, 8)).astype(np.float32)
+        idx = mutable.create(tmp_path / "i", X)
+        idx._clock = clock
+        fab = self._fabric_with(idx, clock)
+        tenant = fab.tenant("t")
+        q = X[11:12].copy()
+        first = self._serve(fab, q)
+        assert 11 in np.asarray(first.indices)[0]
+        hit0 = tenant._hits.value
+        assert self._serve(fab, q) is not None
+        assert tenant._hits.value == hit0 + 1     # exact repeat hits
+        new_vec = rng.standard_normal((1, 8)).astype(np.float32)
+
+        def racing_swap():
+            # mid-merge (after the snapshot watermark): an acked write,
+            # a truth-changing delete, and a concurrent swap that bumps
+            # the tenant generation while the flip is in flight
+            idx.upsert(np.array([500]), new_vec)
+            idx.delete([11])
+            tenant.swap(search_fn=mutable.make_searcher(idx), warm=False)
+
+        idx._after_snapshot_hook = racing_swap
+        try:
+            assert idx.merge() == "committed"
+        finally:
+            idx._after_snapshot_hook = None
+        hits_before = tenant._hits.value
+        res = self._serve(fab, q)
+        # no stale hit: both generation bumps invalidated the entry
+        assert tenant._hits.value == hits_before
+        got = np.asarray(res.indices)[0]
+        assert 11 not in got                      # the delete serves
+        res2 = self._serve(fab, new_vec)
+        assert 500 in np.asarray(res2.indices)[0]  # the acked write serves
+
+    @pytest.mark.parametrize("crash_site", ["mutable.merge.pre_flip",
+                                            "mutable.merge.post_flip"])
+    def test_crashed_flip_racing_swap_recovers_acked_writes(
+            self, tmp_path, rng, clock, crash_site):
+        if faults.active():
+            pytest.skip("ambient faults would interleave with the "
+                        "armed crash point")
+        X = rng.standard_normal((96, 8)).astype(np.float32)
+        p = tmp_path / "i"
+        idx = mutable.create(p, X)
+        idx._clock = clock
+        fab = self._fabric_with(idx, clock)
+        tenant = fab.tenant("t")
+        q = X[11:12].copy()
+        assert 11 in np.asarray(self._serve(fab, q).indices)[0]
+        new_vec = rng.standard_normal((1, 8)).astype(np.float32)
+
+        def racing_swap():
+            idx.upsert(np.array([500]), new_vec)   # acked before the crash
+            idx.delete([11])
+            tenant.swap(search_fn=mutable.make_searcher(idx), warm=False)
+
+        idx._after_snapshot_hook = racing_swap
+        try:
+            with faults.inject("crash_point", crash_site, count=1):
+                with pytest.raises(faults.InjectedCrash):
+                    idx.merge()
+        finally:
+            idx._after_snapshot_hook = None
+        # simulated restart: recover from disk, swap into the tenant
+        rec = mutable.recover(p)
+        rec._clock = clock
+        tenant.swap(new_index=rec, warm=False)
+        hits_before = tenant._hits.value
+        res = self._serve(fab, q)
+        assert tenant._hits.value == hits_before   # no stale block served
+        assert 11 not in np.asarray(res.indices)[0]
+        res2 = self._serve(fab, new_vec)
+        assert 500 in np.asarray(res2.indices)[0]  # acked write survived
