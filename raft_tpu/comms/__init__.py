@@ -17,7 +17,8 @@ XLA analog of a color split); p2p maps to `ppermute`. Multi-host bootstrap
 ``bootstrap``.
 """
 from .comms import AxisComms, Comms
-from .bootstrap import init_comms, local_mesh
+from .bootstrap import init_comms, init_distributed, local_mesh
 from . import comms_test
 
-__all__ = ["Comms", "AxisComms", "init_comms", "local_mesh", "comms_test"]
+__all__ = ["Comms", "AxisComms", "init_comms", "init_distributed",
+           "local_mesh", "comms_test"]

@@ -46,7 +46,13 @@ record/truncation counts) — and the multi-tenant set
 the rejected request's trace ID), ``tenant_swap`` (one per
 zero-downtime index flip, with the new generation and warmed shapes),
 ``qcache_stale`` (the recall sentinel caught the query cache serving a
-provably-degraded hit; stamped with the crossing sample's trace ID).
+provably-degraded hit; stamped with the crossing sample's trace ID) —
+and the multi-host fleet set (docs/mnmg.md, parallel/fleet.py):
+``host_lost`` / ``host_restored`` (a whole host's ICI clique left or
+rejoined the serving set — the host-granular transition above the
+per-shard ``shard_marked``/``shard_restored`` pair, carrying the
+per-host health map), ``fleet_build`` (one distributed IVF-PQ build
+completed, with topology and wire-shape stats).
 
 Details are scrubbed JSON-safe at record time: non-finite floats become
 None, numpy scalars/arrays become python values/lists (large arrays a
@@ -94,6 +100,8 @@ WELL_KNOWN_KINDS = frozenset({
     # transition, not per failure); ``soak_phase`` — a SoakHarness
     # phase boundary (warmup/steady/chaos/recovery/quiesce)
     "hook_error", "soak_phase",
+    # multi-host fleet (docs/mnmg.md)
+    "host_lost", "host_restored", "fleet_build",
 })
 
 # arrays above this many elements are summarized, not inlined — one

@@ -41,13 +41,33 @@ Three engines, one contract:
   and the same lexicographic fold runs in-VMEM at each hop. Zero HBM
   round trip for the gathered buffer, zero host sync.
 
+A fourth, TOPOLOGY-AWARE composition sits above the three flat
+engines: ``hier`` (multi-host fleets, :mod:`raft_tpu.parallel.topology`)
+runs the ring within each host's ICI clique (grouped collectives over
+``host_groups()`` — the flat ring engine verbatim, just on a subgroup),
+then folds the per-host winner blocks across DCN with one grouped
+allgather + lexicographic select. Each device moves ``(H−1)·m·k``
+candidate cells over DCN instead of the flat allgather's ``(H−1)·D·m·k``
+— a reduction factor of exactly ``devs_per_host``. Bit-identity to the
+flat merge holds by a surrogate-position argument: a global top-k
+member is always inside its own host's top-k (stage 1 keeps it), stage
+1's stable sort emits each host block in ascending global-position
+order, and host blocks occupy disjoint ascending global-position ranges
+— so ranking stage-2 candidates by (±distance, host-block position
+``h·k + j``) induces the same total order as (±distance, global concat
+position), dead-shard (+inf, −1) sentinels included.
+
 Engine resolution (``resolve_engine``) prefers a measured autotune
 verdict (``tune_merge`` races the engines under a dtype/mesh-aware
 key), then ``RAFT_TPU_SHARDED_MERGE``, then a backend default: the ring
-kernel on TPU (VMEM budget permitting), allgather elsewhere. Callers
-gate the ring engines behind ``guarded_call("sharded.ring_topk")`` so a
-Mosaic failure on an unrehearsed shape demotes to the bit-identical
-allgather path instead of failing the query.
+kernel on TPU (VMEM budget permitting), allgather elsewhere. A
+multi-host topology adds a tier ABOVE the autotune bucket — ``hier``
+by default (the buckets were measured on single-host meshes and say
+nothing about DCN) — while single-host meshes take the pre-existing
+path byte-for-byte. Callers gate every non-allgather engine behind
+``guarded_call("sharded.ring_topk")`` so a compile/execution failure on
+an unrehearsed shape demotes to the bit-identical allgather path
+instead of failing the query.
 """
 from __future__ import annotations
 
@@ -62,11 +82,15 @@ from jax import lax
 from ..core.errors import expects
 
 __all__ = ["merge", "merge_step", "resolve_engine", "tune_merge",
-           "ring_capable", "ENGINES", "MERGE_SITE", "per_hop_bytes",
-           "gathered_bytes", "active_engines", "note_engine",
-           "note_fallback", "guarded_dispatch"]
+           "ring_capable", "ENGINES", "ALL_ENGINES", "MERGE_SITE",
+           "per_hop_bytes", "gathered_bytes", "active_engines",
+           "note_engine", "note_fallback", "guarded_dispatch"]
 
 ENGINES = ("allgather", "ring", "ring_pallas")
+# the flat engines plus the topology-aware multi-host composition;
+# "hier" needs a Topology at merge() time, so it lives outside ENGINES
+# (the flat autotune/race vocabulary) but inside the dispatch contract
+ALL_ENGINES = ENGINES + ("hier",)
 
 # the guarded site every ring-engine dispatch runs under (ops/guarded.py):
 # a ring compile/execution failure demotes to the allgather program
@@ -168,6 +192,47 @@ def _ring_xla(d, gid, k: int, select_min: bool, comms):
         state = _fold(state, blk, k)
         send_kd, send_gid = recv_kd, recv_gid
     return state[3], state[2]
+
+
+# --------------------------------------------------------------------------
+# hierarchical ICI/DCN engine (multi-host fleets)
+# --------------------------------------------------------------------------
+
+def _hier(d, gid, k: int, select_min: bool, axis: str, topology):
+    """Two-stage topology-aware merge, called per shard inside
+    ``shard_map`` over a host-major fleet mesh.
+
+    Stage 1 (ICI): the flat XLA ring, unchanged, over this host's
+    ``host_groups()`` clique — within-group ranks make the stamped
+    positions host-LOCAL (``l·k + slot``), which stage 2 relies on.
+    Stage 2 (DCN): grouped allgather over ``cross_groups()`` (one peer
+    per host, group rows in host order) → an (H, m, k) winner stack →
+    one (±distance, host-block position) lexicographic select over the
+    ``H·k``-wide concatenation. Surrogate positions ``h·k + j`` induce
+    the flat merge's global-position order (module docstring), so the
+    output is bit-identical to every flat engine, replica-identical on
+    all p shards. D == 1 degenerates to the pure DCN fold; H == 1 is
+    rejected by resolve_engine (single-host meshes never route here).
+    """
+    from ..comms import AxisComms
+
+    H, D = topology.n_hosts, topology.devs_per_host
+    p = topology.n_shards
+    if D > 1:
+        ici = AxisComms(axis, size=p, groups=topology.host_groups())
+        hd, hg = _ring_xla(d, gid, k, select_min, ici)
+    else:
+        hd, hg = d, gid
+    dcn = AxisComms(axis, size=p, groups=topology.cross_groups())
+    all_d = dcn.allgather(hd)                      # (H, m, k), host order
+    all_g = dcn.allgather(hg)
+    m = d.shape[0]
+    dd = jnp.transpose(all_d, (1, 0, 2)).reshape(m, H * k)
+    gg = jnp.transpose(all_g, (1, 0, 2)).reshape(m, H * k)
+    kd = dd if select_min else -dd
+    pos = jnp.broadcast_to(jnp.arange(H * k, dtype=jnp.int32), (m, H * k))
+    _, _, gid2, dd2 = _lex_topk(kd, pos, gg, dd, k)
+    return dd2, gid2
 
 
 # --------------------------------------------------------------------------
@@ -374,7 +439,8 @@ def _ring_pallas(d, gid, k: int, select_min: bool, axis: str, p: int):
 
 def merge(d: jax.Array, gid: jax.Array, k: int, select_min: bool,
           comms=None, axis: str = "shard", axis_size: Optional[int] = None,
-          engine: str = "allgather") -> Tuple[jax.Array, jax.Array]:
+          engine: str = "allgather", topology=None
+          ) -> Tuple[jax.Array, jax.Array]:
     """Cross-shard top-k merge, called per shard INSIDE ``shard_map``.
 
     ``d``/``gid``: this shard's (m, k) local candidates — distances and
@@ -383,14 +449,24 @@ def merge(d: jax.Array, gid: jax.Array, k: int, select_min: bool,
     across engines (module docstring). ``comms``: an
     :class:`~raft_tpu.comms.AxisComms`-shaped communicator; built over
     ``axis``/``axis_size`` when absent. ``ring_pallas`` ignores comms
-    subgroups and requires a plain 1-D mesh axis."""
+    subgroups and requires a plain 1-D mesh axis. ``engine="hier"``
+    requires ``topology`` (a host-major
+    :class:`~raft_tpu.parallel.topology.Topology` matching the mesh
+    axis) and builds its own grouped communicators from it."""
     from ..comms import AxisComms
 
+    expects(engine in ALL_ENGINES, "unknown sharded merge engine %r", engine)
+    if engine == "hier":
+        expects(topology is not None,
+                "engine='hier' needs a topology (parallel.topology)")
+        expects(axis_size is None or int(axis_size) == topology.n_shards,
+                "hier merge: axis_size %s != topology shards %d",
+                axis_size, topology.n_shards)
+        return _hier(d, gid, k, select_min, axis, topology)
     if comms is None:
         expects(axis_size is not None,
                 "merge needs a comms object or an explicit axis_size")
         comms = AxisComms(axis, size=axis_size)
-    expects(engine in ENGINES, "unknown sharded merge engine %r", engine)
     if engine == "ring":
         return _ring_xla(d, gid, k, select_min, comms)
     if engine == "ring_pallas":
@@ -481,7 +557,8 @@ def _bucket(m: int, k: int, p: int, dtype, mesh=None) -> str:
 
 def resolve_engine(m: int, k: int, p: int, dtype=jnp.float32,
                    override: Optional[str] = None,
-                   plain_axis: bool = True, mesh=None) -> str:
+                   plain_axis: bool = True, mesh=None,
+                   topology=None) -> str:
     """Pick the merge engine for one sharded search call.
 
     Order: explicit ``override`` (search param) → ``RAFT_TPU_SHARDED_MERGE``
@@ -493,10 +570,34 @@ def resolve_engine(m: int, k: int, p: int, dtype=jnp.float32,
     ``plain_axis=False`` (an injected communicator with subgroups)
     forces allgather: the ring engines permute over the raw mesh axis.
     ``mesh``: the mesh (or a device) the search runs on; defaults to the
-    process default device."""
+    process default device.
+
+    ``topology``: a :class:`~raft_tpu.parallel.topology.Topology` when
+    the mesh spans hosts. A MULTI-host topology adds a tier above the
+    autotune bucket: override/env still win (``ring_pallas`` demotes to
+    ``hier`` — remote-DMA ring hops must not cross DCN), otherwise
+    ``hier`` — flat-bucket verdicts were measured within one host and
+    say nothing about DCN cost. ``topology=None`` or a single-host
+    topology leaves this function's pre-existing behavior untouched
+    (the byte-for-byte single-host guarantee)."""
     platform = _mesh_device(mesh).platform
     if not plain_axis or p <= 1:
         return "allgather"
+    if topology is not None and topology.multi_host:
+        expects(p == topology.n_shards,
+                "resolve_engine: p=%d != topology shards %d", p,
+                topology.n_shards)
+        eng = override or os.environ.get("RAFT_TPU_SHARDED_MERGE") or None
+        if eng is not None:
+            eng = str(eng).lower()
+            expects(eng in ALL_ENGINES + ("auto",),
+                    "unknown sharded merge engine %r (env/param); one of %s",
+                    eng, ALL_ENGINES + ("auto",))
+            if eng == "ring_pallas":
+                return "hier"
+            if eng != "auto":
+                return eng
+        return "hier"
     eng = override or os.environ.get("RAFT_TPU_SHARDED_MERGE") or None
     if eng is not None:
         eng = str(eng).lower()

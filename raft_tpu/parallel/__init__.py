@@ -1,4 +1,14 @@
-"""Multi-chip (MNMG-analog) sharded algorithms over jax.sharding meshes."""
-from . import sharded_ann, sharded_knn
+"""Multi-chip (MNMG-analog) sharded algorithms over jax.sharding meshes.
 
-__all__ = ["sharded_ann", "sharded_knn"]
+Single-mesh layers: :mod:`sharded_ann` / :mod:`sharded_knn` (per-shard
+local search + cross-shard merge). The multi-host fleet layer composes
+them across the ICI/DCN hierarchy: :mod:`topology` (hosts × devices
+model + hierarchical merge planning) and :mod:`fleet` (distributed
+IVF-PQ build, topology-aware search, host-loss degradation).
+"""
+from . import fleet, sharded_ann, sharded_knn, topology
+from .fleet import Fleet
+from .topology import Topology
+
+__all__ = ["sharded_ann", "sharded_knn", "topology", "fleet", "Fleet",
+           "Topology"]

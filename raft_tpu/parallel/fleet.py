@@ -1,0 +1,544 @@
+"""The Fleet: topology-aware MNMG composition over ICI + DCN.
+
+ROADMAP item 1 ("build a sharded IVF-PQ index on DEEP-1B across
+v5p-32") needs three things no single-mesh module provides: a build
+protocol where every host's data shapes one shared coarse quantizer
+without the corpus ever crossing DCN, a search merge that respects the
+ICI/DCN bandwidth cliff, and host-granular failure semantics. This
+module composes the existing single-mesh machinery into exactly that:
+
+* **Fleet** owns a host-major mesh plus its
+  :class:`~raft_tpu.parallel.topology.Topology`, built three ways:
+  :meth:`Fleet.local` (one host, today's meshes), :meth:`Fleet.virtual`
+  (CPU-emulation: one process's virtual devices reshaped hosts × devs —
+  every cross-host code path runs in tier-1), and
+  :meth:`Fleet.distributed` (real ``jax.distributed`` processes via
+  :func:`raft_tpu.comms.init_distributed`).
+
+* **Distributed IVF-PQ build** (:meth:`Fleet.build_ivf_pq`): ONE coarse
+  quantizer trained data-parallel — each shard contributes its own
+  sample's centroid accumulators, allreduced across the fleet per Lloyd
+  iteration — so every host's rows shape the same list structure
+  (the single-mesh ``build_ivf_pq`` instead trains p independent
+  quantizers, one per shard). What crosses DCN per iteration is
+  ``n_lists × (dim + 1)`` floats of accumulator, never rows; list
+  packing (assign/encode/sort) runs host-local on each host's own row
+  block, and the packed device arrays are assembled from process-local
+  slabs. PQ codebooks are trained once and broadcast. The allreduce is
+  an allgather + LOCAL ordered sum, so the trained index is
+  BIT-IDENTICAL no matter how the same topology is laid out over
+  processes — a 2-process 2×2 fleet builds the same index as a
+  1-process virtual 2×2 fleet (the dryrun's acceptance gate).
+
+* **Search** (:meth:`Fleet.search`) routes through the existing
+  ``sharded_ann.search_ivf_pq`` — the index carries its topology, so
+  the merge chokepoint resolves the hierarchical ICI/DCN engine — and
+  auto-widens ``n_probes`` by ``1/served_frac`` while shards are down
+  (the ROADMAP "re-probe at a bigger radius" contract: losing 1/H of
+  the corpus costs ~1/H recall; probing proportionally more lists on
+  the survivors buys most of it back).
+
+* **Host-loss degradation**: :meth:`mark_host_failed` masks a whole
+  host's shards (sentinel rows in whichever merge engine runs,
+  ``host_lost`` flight-recorder event), :meth:`probe_hosts` canaries
+  the dead shards and emits ``host_restored`` when a host's full ICI
+  clique is healthy again. Per-host health is one
+  :meth:`host_health` call and a ``fleet`` debugz section.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+import weakref
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..comms import AxisComms
+from ..core.errors import expects
+from ..distance.distance_types import canonical_metric
+from ..neighbors import ivf_pq
+from ..utils import cdiv, hdot, shard_map_compat
+from . import sharded_ann
+from .sharded_ann import ShardedIvfPq
+from .topology import AXIS, Topology, detect, fleet_mesh, plan_merge, virtual
+
+__all__ = ["Fleet", "FleetBuildParams", "ops_snapshot"]
+
+# live fleets (weak — dropping a fleet must not leak it through debugz)
+_FLEETS = weakref.WeakSet()
+
+
+@dataclasses.dataclass
+class FleetBuildParams:
+    """Knobs of the distributed coarse trainer (the fleet analog of
+    :class:`raft_tpu.cluster.kmeans_balanced.BalancedKMeansParams` —
+    the Lloyd/balancing structure mirrored into a pure-SPMD program).
+
+    ``balancing_rounds`` re-seeds of starved lists (count below
+    mean/``balancing_pessimism``) onto perturbed copies of the heaviest
+    lists' centers, each followed by a share of the Lloyd iterations —
+    deterministic (count-driven, no RNG), so process layout can't change
+    the result."""
+
+    balancing_rounds: int = 2
+    balancing_pessimism: float = 2.5
+
+
+def _effective_nprobe(n_probes: int, served_frac: float, n_lists: int) -> int:
+    """The degradation auto-widen: probe ``n_probes / served_frac``
+    lists while part of the corpus is dark, capped at ``n_lists``. At
+    full health this is exactly ``n_probes`` — the healthy path is
+    untouched."""
+    frac = min(max(float(served_frac), 1.0 / max(n_lists, 1)), 1.0)
+    return min(int(n_lists), int(np.ceil(n_probes / frac)))
+
+
+def _host_slab(topo: Topology, host: int):
+    """Leading-axis slice of a (p, ...) stacked array owned by ``host``."""
+    s = topo.shards_of(host)
+    return slice(s.start, s.stop)
+
+
+def _fleet_put(mesh: Mesh, topo: Topology, global_np: np.ndarray, spec):
+    """Assemble a (p, ...)-stacked P(AXIS, ...) fleet array.
+
+    Single-process: a plain sharded ``device_put``. Multi-process: each
+    process provides ONLY its own host's shard slab
+    (``jax.make_array_from_process_local_data``) — this is the seam
+    that keeps packed codes off DCN: every byte of a shard's lists is
+    produced and device_put by the process that owns the shard."""
+    sh = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(jnp.asarray(global_np), sh)
+    local = np.ascontiguousarray(global_np[_host_slab(topo,
+                                                      jax.process_index())])
+    return jax.make_array_from_process_local_data(sh, local,
+                                                  global_np.shape)
+
+
+class Fleet:
+    """A host-major mesh + topology and the MNMG operations over it
+    (module docstring). Construct via :meth:`local`, :meth:`virtual`,
+    or :meth:`distributed`."""
+
+    def __init__(self, mesh: Mesh, topology: Topology):
+        expects(AXIS in mesh.shape, "fleet mesh must have a %r axis", AXIS)
+        expects(mesh.shape[AXIS] == topology.n_shards,
+                "mesh has %d shards, topology %dx%d wants %d",
+                mesh.shape[AXIS], topology.n_hosts, topology.devs_per_host,
+                topology.n_shards)
+        self.mesh = mesh
+        self.topology = topology
+        # indexes built by (or adopted into) this fleet — host-loss and
+        # probe operations apply to all of them
+        self._indexes = weakref.WeakSet()
+        # hosts currently considered lost (mark_host_failed ⇄ probe_hosts)
+        self._hosts_down: set = set()
+        self.last_probe: Optional[dict] = None
+        _FLEETS.add(self)
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def local(cls, n_devices: Optional[int] = None) -> "Fleet":
+        """Single-host fleet over the local devices (today's meshes:
+        ``Topology(1, n)`` — resolve_engine keeps the flat engines
+        byte-for-byte)."""
+        devs = jax.devices()
+        n = len(devs) if n_devices is None else int(n_devices)
+        mesh, topo = fleet_mesh(Topology(1, n), devices=devs[:n])
+        return cls(mesh, topo)
+
+    @classmethod
+    def virtual(cls, n_hosts: int, devs_per_host: int) -> "Fleet":
+        """CPU-emulation fleet: one process's (virtual) devices reshaped
+        ``hosts × devs`` so the cross-host paths run without a pod."""
+        mesh, topo = fleet_mesh(virtual(n_hosts, devs_per_host))
+        return cls(mesh, topo)
+
+    @classmethod
+    def distributed(cls, coordinator_address: Optional[str] = None,
+                    num_processes: Optional[int] = None,
+                    process_id: Optional[int] = None) -> "Fleet":
+        """Real multi-process fleet: bootstrap ``jax.distributed``
+        (:func:`raft_tpu.comms.init_distributed` — args or the
+        ``RAFT_TPU_COORDINATOR``/``_NUM_PROCESSES``/``_PROCESS_ID``
+        env), then detect the topology from the global device set."""
+        from ..comms import init_distributed
+
+        init_distributed(coordinator_address, num_processes, process_id)
+        mesh, topo = fleet_mesh(None)
+        return cls(mesh, topo)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def n_hosts(self) -> int:
+        return self.topology.n_hosts
+
+    @property
+    def n_shards(self) -> int:
+        return self.topology.n_shards
+
+    def merge_plan(self, m: int = 128, k: int = 10) -> dict:
+        """The search-merge wire math for this topology
+        (:func:`raft_tpu.parallel.topology.plan_merge`)."""
+        return plan_merge(self.topology, m, k)
+
+    def host_health(self) -> dict:
+        """Per-HOST view of the fleet's index health: for each host,
+        whether every shard of every registered index is ok; plus the
+        worst ``served_frac`` across indexes (the number the auto-widen
+        uses). Healthy-with-no-indexes reads as all-up."""
+        per_host = [True] * self.n_hosts
+        served = 1.0
+        for idx in list(self._indexes):
+            ok = np.asarray(idx.shards_ok, bool)
+            for h in range(self.n_hosts):
+                if not ok[_host_slab(self.topology, h)].all():
+                    per_host[h] = False
+            served = min(served, sharded_ann.health(idx)["served_frac"])
+        return {"topology": f"{self.n_hosts}x{self.topology.devs_per_host}",
+                "hosts_ok": per_host,
+                "hosts_down": sorted(self._hosts_down),
+                "served_frac": round(served, 4)}
+
+    # -- host-loss degradation --------------------------------------------
+    def mark_host_failed(self, host: int, ok: bool = False) -> None:
+        """Mark every shard of ``host`` across every registered index
+        (host-granular ``shards_ok``): its ICI clique contributes
+        sentinel rows to every merge until re-marked or re-probed.
+        ``ok=True`` is the manual re-admit."""
+        expects(0 <= host < self.n_hosts, "host %d out of range", host)
+        for idx in list(self._indexes):
+            for s in self.topology.shards_of(host):
+                idx.mark_shard_failed(s, ok=ok)
+        was_down = host in self._hosts_down
+        if ok:
+            self._hosts_down.discard(host)
+        else:
+            self._hosts_down.add(host)
+        if ok == was_down:      # an actual host-level transition
+            try:
+                from ..core import events as _events
+
+                _events.record("host_restored" if ok else "host_lost",
+                               f"fleet.host{host}",
+                               shards=list(self.topology.shards_of(host)),
+                               **self.host_health())
+            except Exception:  # noqa: BLE001 - telemetry must not fail ops
+                pass
+
+    def probe_hosts(self, **kw) -> dict:
+        """Canary-probe dead shards of every registered index
+        (:func:`raft_tpu.parallel.sharded_ann.probe_shards`) and
+        re-admit hosts whose whole ICI clique recovered — the
+        host-granular ``shard_restored`` loop, emitting one
+        ``host_restored`` per recovered host. Returns
+        ``{"shards": {family: {shard: ok}}, "hosts_restored": [...]}``."""
+        shard_results: dict = {}
+        for idx in list(self._indexes):
+            if not np.asarray(idx.shards_ok, bool).all():
+                shard_results.setdefault(idx.family, {}).update(
+                    sharded_ann.probe_shards(idx, **kw))
+        restored = []
+        for h in sorted(self._hosts_down):
+            up = all(np.asarray(idx.shards_ok,
+                                bool)[_host_slab(self.topology, h)].all()
+                     for idx in list(self._indexes))
+            if up:
+                self._hosts_down.discard(h)
+                restored.append(h)
+                try:
+                    from ..core import events as _events
+
+                    _events.record("host_restored", f"fleet.host{h}",
+                                   shards=list(self.topology.shards_of(h)),
+                                   **self.host_health())
+                except Exception:  # noqa: BLE001
+                    pass
+        self.last_probe = {"ts": time.time(), "shards": shard_results,
+                           "hosts_restored": restored}
+        return {"shards": shard_results, "hosts_restored": restored}
+
+    def adopt(self, index) -> None:
+        """Register an externally built sharded index (its mesh must be
+        this fleet's) for host-loss/probe management."""
+        expects(getattr(index, "mesh", None) is self.mesh,
+                "index was not built on this fleet's mesh")
+        index.topology = self.topology
+        self._indexes.add(index)
+
+    # -- distributed build -------------------------------------------------
+    def build_ivf_pq(self, dataset,
+                     params: ivf_pq.IndexParams | None = None,
+                     build_params: FleetBuildParams | None = None
+                     ) -> ShardedIvfPq:
+        """Distributed IVF-PQ build (module docstring): one allreduced
+        coarse quantizer, broadcast codebooks, host-local list packing.
+
+        ``dataset``: the (n, dim) corpus, visible to every process (from
+        shared storage — NOT shipped over DCN; each process touches only
+        its own hosts' row blocks for packing, and only the training
+        sample feeds the allreduce). Returns a
+        :class:`~raft_tpu.parallel.sharded_ann.ShardedIvfPq` whose
+        searches resolve the topology-aware merge. PER_SUBSPACE
+        codebooks only (PER_CLUSTER's trainer is host-driven and cannot
+        run SPMD)."""
+        p0 = params or ivf_pq.IndexParams()
+        bp = build_params or FleetBuildParams()
+        expects(p0.codebook_kind is ivf_pq.CodebookGen.PER_SUBSPACE,
+                "fleet build supports PER_SUBSPACE codebooks only")
+        mt = canonical_metric(p0.metric)
+        dataset = np.asarray(dataset, np.float32)
+        n, dim = dataset.shape
+        p = self.n_shards
+        L = p0.n_lists
+        expects(4 <= p0.pq_bits <= 8, "pq_bits must be in [4,8], got %d",
+                p0.pq_bits)
+        expects(L <= n, "n_lists %d > n %d", L, n)
+        pq_dim = p0.pq_dim or ivf_pq._default_pq_dim(dim)
+        pq_len = cdiv(dim, pq_dim)
+        rot_dim = pq_dim * pq_len
+        book_size = 1 << p0.pq_bits
+        t0 = time.perf_counter()
+
+        parts = sharded_ann._split_rows(n, p)
+        # equal per-shard sample size: every shard must contribute the
+        # same accumulator shapes, and the trainer needs enough rows per
+        # shard to seed its slice of the centers and fill a codebook
+        n_train = max(L, min(n, int(n * p0.kmeans_trainset_fraction)))
+        t = max(book_size, cdiv(L, p), cdiv(n_train, p))
+        samples = np.empty((p, t, dim), np.float32)
+        for s in range(p):
+            block = dataset[parts[s]]
+            stride = max(1, len(block) // t)
+            samples[s] = block[(np.arange(t) * stride) % len(block)]
+
+        key = jax.random.key(p0.seed)
+        k_rot, k_book = jax.random.split(key)
+        rotation = np.asarray(ivf_pq.make_rotation_matrix(
+            k_rot, rot_dim, dim, p0.force_random_rotation))
+
+        centers_rot, books = self._train(samples, rotation, L, pq_dim,
+                                         pq_len, book_size, p0, bp, k_book)
+
+        index = self._pack(dataset, parts, centers_rot, books, rotation,
+                           mt, p0, pq_dim)
+        self.adopt(index)
+        try:
+            from ..core import events as _events
+
+            _events.record(
+                "fleet_build", "fleet.build_ivf_pq",
+                topology=f"{self.n_hosts}x{self.topology.devs_per_host}",
+                n=n, dim=dim, n_lists=L, pq_dim=pq_dim, pq_bits=p0.pq_bits,
+                sample_rows_per_shard=t,
+                wall_s=round(time.perf_counter() - t0, 3))
+        except Exception:  # noqa: BLE001
+            pass
+        return index
+
+    def _train(self, samples: np.ndarray, rotation: np.ndarray, L: int,
+               pq_dim: int, pq_len: int, book_size: int, p0, bp, k_book):
+        """The SPMD trainer: one shard_map program over the fleet mesh.
+
+        Determinism contract: the cross-fleet allreduce is an allgather
+        (pure data movement, axis-ordered) + a LOCAL ``jnp.sum`` over
+        the gathered axis — the reduction order is fixed by the program,
+        not the wire, so 1-process virtual and N-process real layouts of
+        the same topology produce bitwise-equal centers. ``psum`` would
+        be the hardware-efficient choice on a pod, at the cost of this
+        guarantee. Codebooks are shard 0's, broadcast (masked psum:
+        ``x + 0`` — exact)."""
+        p = self.n_shards
+        t, dim = samples.shape[1:]
+        iters = max(1, int(p0.kmeans_n_iters))
+        rounds = max(0, int(bp.balancing_rounds))
+        per = max(1, iters // (rounds + 1))
+        ell = cdiv(L, p)
+        stride = max(1, t // ell)
+
+        def body(smp, rot):
+            x = smp[0]                                   # (t, dim) local
+            comms = AxisComms(AXIS, size=p)
+
+            def allreduce_sum(v):
+                # ordered: gather in axis order, reduce locally
+                return jnp.sum(comms.allgather(v), axis=0)
+
+            def lloyd(carry, n_it):
+                def step(c, _):
+                    d2 = (jnp.sum(x * x, axis=1, keepdims=True)
+                          - 2.0 * hdot(x, c.T)
+                          + jnp.sum(c * c, axis=1)[None, :])
+                    lb = jnp.argmin(d2, axis=1)
+                    sums = allreduce_sum(
+                        jax.ops.segment_sum(x, lb, num_segments=L))
+                    cnt = allreduce_sum(jax.ops.segment_sum(
+                        jnp.ones((t,), jnp.float32), lb, num_segments=L))
+                    new = jnp.where(cnt[:, None] > 0,
+                                    sums / jnp.maximum(cnt, 1.0)[:, None], c)
+                    return new, cnt
+                c, cnts = jax.lax.scan(step, carry, None, length=n_it)
+                return c, cnts[-1]
+
+            # init: every shard seeds ceil(L/p) strided sample rows;
+            # gathered in shard order, first L rows are the shared seed
+            init = x[::stride][:ell]
+            centers = comms.allgather(init).reshape(p * ell, dim)[:L]
+            centers, cnt = lloyd(centers, per)
+            for _ in range(rounds):
+                # deterministic balancing: starved lists re-seed onto
+                # perturbed copies of the heaviest lists' centers
+                order = jnp.argsort(-cnt)
+                starved = cnt < (jnp.mean(cnt) / bp.balancing_pessimism)
+                rank = jnp.cumsum(starved.astype(jnp.int32)) - 1
+                donor = centers[order[jnp.mod(rank, L)]]
+                eps = 1e-4 * (1.0 + jnp.arange(L, dtype=jnp.float32)
+                              )[:, None]
+                centers = jnp.where(starved[:, None], donor * (1.0 + eps),
+                                    centers)
+                centers, cnt = lloyd(centers, per)
+
+            c_rot = hdot(centers, rot.T)
+            # codebooks: every shard trains on its OWN sample's residuals
+            # (replicated compute), shard 0's result is broadcast — "one
+            # trainer", SPMD-uniform
+            x_rot = hdot(x, rot.T)
+            d2 = (jnp.sum(x_rot * x_rot, axis=1, keepdims=True)
+                  - 2.0 * hdot(x_rot, c_rot.T)
+                  + jnp.sum(c_rot * c_rot, axis=1)[None, :])
+            resid = x_rot - c_rot[jnp.argmin(d2, axis=1)]
+            slices = jnp.transpose(resid.reshape(t, pq_dim, pq_len),
+                                   (1, 0, 2))
+            books = ivf_pq._train_per_subspace(slices, book_size, iters,
+                                               k_book)
+            return c_rot, comms.bcast(books, root=0)
+
+        prog = jax.jit(shard_map_compat(
+            body, mesh=self.mesh, in_specs=(P(AXIS, None, None), P()),
+            out_specs=(P(), P()), check=False))
+        smp = _fleet_put(self.mesh, self.topology, samples,
+                         P(AXIS, None, None))
+        c_rot, books = prog(smp, jnp.asarray(rotation))
+        return np.asarray(c_rot), np.asarray(books)
+
+    def _pack(self, dataset, parts, centers_rot, books, rotation, mt, p0,
+              pq_dim) -> ShardedIvfPq:
+        """Host-local list packing: each process assigns/encodes/sorts
+        ONLY its own hosts' row blocks against the replicated quantizer,
+        then the (p, ...)-stacked device arrays are assembled from
+        process-local slabs (:func:`_fleet_put`). The tiny per-shard
+        list-size tables — the only cross-host metadata — travel via
+        ``process_allgather``."""
+        topo = self.topology
+        p = self.n_shards
+        L = centers_rot.shape[0]
+        multi = jax.process_count() > 1
+        my_shards = (list(topo.shards_of(jax.process_index())) if multi
+                     else list(range(p)))
+        R = max(len(part) for part in parts)          # common padded rows
+
+        c_rot_j = jnp.asarray(centers_rot)
+        books_j = jnp.asarray(books)
+        rot_j = jnp.asarray(rotation)
+
+        @jax.jit
+        def assign_encode(xb):
+            xb_rot = hdot(xb, rot_j.T)
+            d2 = (jnp.sum(xb_rot * xb_rot, axis=1, keepdims=True)
+                  - 2.0 * hdot(xb_rot, c_rot_j.T)
+                  + jnp.sum(c_rot_j * c_rot_j, axis=1)[None, :])
+            lb = jnp.argmin(d2, axis=1)
+            resid = xb_rot - c_rot_j[lb]
+            return lb.astype(jnp.int32), ivf_pq._encode(resid, books_j, lb,
+                                                        False)
+
+        codes = np.zeros((p, R, pq_dim), np.uint8)
+        gids = np.full((p, R), -1, np.int32)
+        sizes = np.zeros((p, L), np.int32)
+        for s in my_shards:
+            rows = parts[s]
+            lb, cd = assign_encode(jnp.asarray(dataset[rows], jnp.float32))
+            lb, cd = np.asarray(lb), np.asarray(cd)
+            order = np.argsort(lb, kind="stable")     # cluster-sorted lists
+            codes[s, : len(rows)] = cd[order]
+            gids[s, : len(rows)] = rows[order]        # GLOBAL row ids
+            sizes[s] = np.bincount(lb, minlength=L)
+        if multi:
+            from jax.experimental import multihost_utils
+
+            # every process's local (D, L) size block, host-major — the
+            # only packing metadata that crosses DCN
+            local = sizes[_host_slab(topo, jax.process_index())]
+            sizes = np.asarray(multihost_utils.process_allgather(
+                jnp.asarray(local))).reshape(p, L).astype(np.int32)
+        offsets = np.concatenate(
+            [np.zeros((p, 1), np.int64), np.cumsum(sizes, axis=1)[:, :-1]],
+            axis=1).astype(np.int32)
+
+        put = lambda a, spec: _fleet_put(self.mesh, topo, a, spec)
+        stack = lambda a: np.broadcast_to(a, (p,) + a.shape).copy()
+        idx = ShardedIvfPq(
+            self.mesh,
+            put(codes, P(AXIS, None, None)),
+            put(gids, P(AXIS, None)),
+            put(stack(centers_rot), P(AXIS, None, None)),
+            put(stack(books), P(AXIS, None, None, None)),
+            put(stack(rotation), P(AXIS, None, None)),
+            put(offsets, P(AXIS, None)),
+            put(sizes, P(AXIS, None)),
+            len(dataset), mt, p0.pq_bits, p0.codebook_kind,
+            [sizes[s] for s in range(p)])
+        return idx
+
+    # -- search ------------------------------------------------------------
+    def search(self, index, queries, k: int,
+               params: ivf_pq.SearchParams | None = None,
+               allow_partial: bool = True, merge_engine=None, res=None):
+        """Topology-aware merged search with degradation auto-widen.
+
+        While ``served_frac < 1`` (a lost host), ``n_probes`` widens to
+        ``n_probes / served_frac`` (capped at ``n_lists``) so the
+        surviving shards probe proportionally more lists — recall under
+        a host loss recovers most of the way to healthy instead of
+        dropping by the dead fraction. Returns ``(d, i, shards_ok)``
+        with the default ``allow_partial=True`` (a fleet exists to keep
+        serving through a host loss), ``(d, i)`` when ``False``."""
+        sp = params or ivf_pq.SearchParams()
+        frac = sharded_ann.health(index)["served_frac"]
+        n_lists = int(index.centers_rot.shape[1])
+        eff = _effective_nprobe(sp.n_probes, frac, n_lists)
+        if eff != sp.n_probes:
+            sp = dataclasses.replace(sp, n_probes=eff)
+        return sharded_ann.search_ivf_pq(
+            index, queries, k, sp, res=res, allow_partial=allow_partial,
+            merge_engine=merge_engine)
+
+
+def ops_snapshot() -> dict:
+    """The fleet ops surface (read by serve/debugz.py): per-fleet
+    topology, per-host health, served_frac, the merge plan a search
+    resolves, and the last probe result."""
+    fleets = []
+    for _ in range(4):
+        try:
+            live = list(_FLEETS)
+            break
+        except RuntimeError:       # registration race (see sharded_ann)
+            continue
+    else:
+        live = []
+    for f in live:
+        ent = f.host_health()
+        ent["n_indexes"] = len(list(f._indexes))
+        ent["merge"] = {
+            "engine": "hier" if f.topology.multi_host else "flat",
+            "dcn_reduction": f.topology.devs_per_host
+            if f.topology.multi_host else 1}
+        ent["last_probe"] = f.last_probe
+        fleets.append(ent)
+    return {"fleets": fleets, "n_fleets": len(fleets)}

@@ -82,12 +82,13 @@ _downed_at: dict = {}
 
 def _merged_shard_search(mesh, family: str, local_fn, in_specs, arrays,
                          m: int, k: int, select_min: bool, comms,
-                         merge_engine=None):
+                         merge_engine=None, topology=None):
     """One chokepoint for every sharded family's cross-shard merge:
     resolve the engine (param/env override → autotune verdict → backend
-    default), run ``local_fn`` (per-shard candidates, dead shards
+    default; a multi-host ``topology`` adds the hierarchical ICI/DCN
+    tier), run ``local_fn`` (per-shard candidates, dead shards
     already masked to sentinel rows) under ``shard_map`` with that
-    engine's merge, and gate the ring engines behind
+    engine's merge, and gate every non-allgather engine behind
     ``guarded_call(MERGE_SITE)`` falling back to the bit-identical
     allgather program. Returns replica-identical (distances, ids)."""
     p = mesh.shape[AXIS]
@@ -95,13 +96,15 @@ def _merged_shard_search(mesh, family: str, local_fn, in_specs, arrays,
     # communicator restricted to subgroups keeps the allgather path
     plain_axis = getattr(comms, "groups", True) is None
     eng = ring_topk.resolve_engine(m, k, p, override=merge_engine,
-                                   plain_axis=plain_axis, mesh=mesh)
+                                   plain_axis=plain_axis, mesh=mesh,
+                                   topology=topology)
 
     def run(e):
         def body(*xs):
             d, gi = local_fn(*xs)
             return ring_topk.merge(d, gi, k, select_min, comms=comms,
-                                   axis=AXIS, axis_size=p, engine=e)
+                                   axis=AXIS, axis_size=p, engine=e,
+                                   topology=topology)
         return shard_map_compat(body, mesh=mesh, in_specs=tuple(in_specs),
                                 out_specs=(P(), P()), check=False)(*arrays)
 
@@ -172,7 +175,12 @@ def health(index) -> dict:
     if isinstance(index, ShardedCagra):
         counts = np.asarray(index.counts, np.int64)
     elif isinstance(index, (ShardedIvfFlat, ShardedIvfPq)):
-        counts = np.asarray(index.sizes, np.int64).sum(axis=1)
+        # count from the host-side size tables, NOT the device arrays: a
+        # multi-process fleet index's ``sizes`` spans non-addressable
+        # devices and cannot be fetched host-side
+        tbl = (index._sizes_host if isinstance(index, ShardedIvfPq)
+               else index._max_rows_tbl)
+        counts = np.asarray([int(np.sum(s)) for s in tbl], np.int64)
     else:
         raise TypeError(
             f"no health report for sharded type {type(index).__name__}")
@@ -545,7 +553,8 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
         arrays.append(index.scales)
     d, i = _merged_shard_search(index.mesh, "ivf_flat", local, in_specs,
                                 arrays, q.shape[0], k, select_min, comms,
-                                merge_engine)
+                                merge_engine,
+                                topology=getattr(index, "topology", None))
     return (d, i, ok) if allow_partial else (d, i)
 
 
@@ -687,7 +696,8 @@ def search_cagra(index: ShardedCagra, queries, k: int,
         arrays.append(index.seeds)
     d, i = _merged_shard_search(index.mesh, "cagra", local, in_specs,
                                 arrays, q.shape[0], k, select_min, comms,
-                                merge_engine)
+                                merge_engine,
+                                topology=getattr(index, "topology", None))
     return (d, i, ok) if allow_partial else (d, i)
 
 
@@ -817,7 +827,8 @@ def search_ivf_pq(index: ShardedIvfPq, queries, k: int,
               index.sizes, _shard_mask(index.mesh, ok), q)
     d, i = _merged_shard_search(index.mesh, "ivf_pq", local, in_specs,
                                 arrays, q.shape[0], k, select_min, comms,
-                                merge_engine)
+                                merge_engine,
+                                topology=getattr(index, "topology", None))
     return (d, i, ok) if allow_partial else (d, i)
 
 

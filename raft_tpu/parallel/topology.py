@@ -1,0 +1,199 @@
+"""Two-level fleet topology: hosts × local devices over ICI + DCN.
+
+The reference's MNMG layer treats the fabric as flat NCCL ranks; a TPU
+pod is not flat — devices within a host (really: within an ICI domain)
+see each other over the high-bandwidth interconnect, while hosts see
+each other over DCN at roughly an order of magnitude less bandwidth.
+This module is the one place that asymmetry is modeled:
+
+* :class:`Topology` — ``n_hosts × devs_per_host`` with the host-major
+  shard numbering every fleet mesh uses (shard ``s`` lives on host
+  ``s // devs_per_host``), plus the two group decompositions the
+  hierarchical merge needs: ``host_groups()`` (the ICI cliques) and
+  ``cross_groups()`` (one representative per host at each local slot —
+  the DCN planes).
+* :func:`detect` — derive the topology from ``jax.distributed``
+  process/device metadata (each jax process is one "host"; its
+  addressable devices are the ICI domain).
+* :func:`virtual` / :func:`fleet_mesh` — the CPU-emulation mode (the
+  ``multichip`` fixture precedent): a single process's virtual devices
+  reshaped ``hosts × devs``, so every cross-host code path (grouped
+  collectives, the DCN fold, host-loss masking) runs machine-checked in
+  tier-1 without a pod.
+* :func:`plan_merge` — the wire math for one merged search: what
+  crosses ICI, what crosses DCN, and the reduction factor vs. the flat
+  allgather merge (the number an operator sizes DCN by).
+
+Shard numbering is HOST-MAJOR everywhere: mesh position ``h * D + l``
+is host ``h``'s local device ``l``. ``detect`` validates that the
+device order actually satisfies this (jax orders ``jax.devices()`` by
+id, which groups by process for the CPU/gloo and TPU backends; a
+backend that interleaved processes would silently break the grouped
+collectives, so it is checked, not assumed).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.errors import expects
+
+__all__ = ["Topology", "detect", "virtual", "fleet_mesh", "plan_merge"]
+
+AXIS = "shard"
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """``n_hosts`` ICI domains of ``devs_per_host`` devices each.
+
+    A frozen value: resolve_engine keys behavior off it, so it must be
+    hashable and comparison-stable across processes.
+    """
+
+    n_hosts: int
+    devs_per_host: int
+
+    def __post_init__(self):
+        expects(self.n_hosts >= 1 and self.devs_per_host >= 1,
+                "bad topology %dx%d", self.n_hosts, self.devs_per_host)
+
+    @property
+    def n_shards(self) -> int:
+        return self.n_hosts * self.devs_per_host
+
+    @property
+    def multi_host(self) -> bool:
+        return self.n_hosts > 1
+
+    def host_of(self, shard: int) -> int:
+        """Host owning mesh position ``shard`` (host-major numbering)."""
+        expects(0 <= shard < self.n_shards, "shard %d out of range", shard)
+        return shard // self.devs_per_host
+
+    def shards_of(self, host: int) -> range:
+        """Mesh positions of ``host``'s local devices."""
+        expects(0 <= host < self.n_hosts, "host %d out of range", host)
+        return range(host * self.devs_per_host,
+                     (host + 1) * self.devs_per_host)
+
+    def host_groups(self) -> Tuple[Tuple[int, ...], ...]:
+        """ICI cliques: one group per host, its local shards in order —
+        the ``axis_index_groups`` of every within-host collective."""
+        return tuple(tuple(self.shards_of(h)) for h in range(self.n_hosts))
+
+    def cross_groups(self) -> Tuple[Tuple[int, ...], ...]:
+        """DCN planes: group ``l`` holds local slot ``l`` of every host,
+        in host order — the ``axis_index_groups`` of the cross-host fold
+        (group row order IS host order, which the hierarchical merge's
+        position stamping depends on)."""
+        return tuple(
+            tuple(h * self.devs_per_host + l for h in range(self.n_hosts))
+            for l in range(self.devs_per_host))
+
+
+def detect(devices=None) -> Topology:
+    """Topology from ``jax.distributed`` metadata: each process is one
+    host, its addressable devices the ICI domain. Single-process (no
+    ``jax.distributed``) collapses to ``Topology(1, n_devices)``.
+
+    Validates host-major device order and equal per-host device counts —
+    the two invariants every grouped collective below assumes.
+    """
+    import jax
+
+    devs = list(devices) if devices is not None else jax.devices()
+    expects(len(devs) > 0, "no devices to build a topology over")
+    procs = [d.process_index for d in devs]
+    uniq = sorted(set(procs))
+    per_host = [sum(1 for p in procs if p == u) for u in uniq]
+    expects(len(set(per_host)) == 1,
+            "unequal devices per host: %s (fleet meshes need a uniform "
+            "hosts x devs grid)", dict(zip(uniq, per_host)))
+    topo = Topology(len(uniq), per_host[0])
+    # host-major order check: position h*D+l must belong to host h
+    for s, p in enumerate(procs):
+        expects(uniq[topo.host_of(s)] == p,
+                "device order is not host-major at position %d (process "
+                "%d where host %d was expected); reorder the mesh devices "
+                "by (process_index, id)", s, p, topo.host_of(s))
+    return topo
+
+
+def virtual(n_hosts: int, devs_per_host: int) -> Topology:
+    """CPU-emulation topology: a single process's virtual devices
+    RESHAPED ``hosts × devs`` (the ``multichip`` fixture precedent) so
+    the hierarchical-merge and host-loss paths run in tier-1. The grouped
+    collectives behave identically; only the wire underneath differs."""
+    return Topology(n_hosts, devs_per_host)
+
+
+def fleet_mesh(topology: Optional[Topology] = None, devices=None,
+               axis: str = AXIS):
+    """1-D host-major mesh for a topology → ``(Mesh, Topology)``.
+
+    ``topology=None`` detects it from the (global) device set. Devices
+    are ordered ``(process_index, id)`` — host-major by construction —
+    and trimmed to ``topology.n_shards`` (virtual mode: a 2x4 topology
+    over the first 8 virtual CPU devices).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    devs = sorted(devs, key=lambda d: (d.process_index, d.id))
+    if topology is None:
+        topo = detect(devs)
+    else:
+        topo = topology
+        expects(len(devs) >= topo.n_shards,
+                "topology %dx%d needs %d devices, have %d", topo.n_hosts,
+                topo.devs_per_host, topo.n_shards, len(devs))
+        devs = devs[: topo.n_shards]
+        # real multi-process sets must still be host-major w.r.t. topo
+        if len({d.process_index for d in devs}) > 1:
+            expects(detect(devs) == topo,
+                    "device processes do not match topology %dx%d",
+                    topo.n_hosts, topo.devs_per_host)
+    return Mesh(np.array(devs), (axis,)), topo
+
+
+def plan_merge(topology: Topology, m: int, k: int) -> dict:
+    """The wire math of one hierarchically merged search over ``m``
+    queries × ``k`` results (f32 distances + i32 ids = 8 bytes/cell).
+
+    Stage 1 (ICI, per host): a ``(D-1)``-hop ring over the host's local
+    shards — each device moves ``per_hop_bytes`` per hop, all within the
+    ICI domain. Stage 2 (DCN): an allgather fold of the per-host winner
+    blocks — each device receives ``H-1`` foreign ``(m, k)`` blocks over
+    DCN. The flat allgather merge instead moves ``(H-1)·D`` blocks per
+    device over DCN: the hierarchy's DCN reduction factor is exactly
+    ``D`` (the whole point of merging within the ICI domain first).
+    """
+    from ..ops import ring_topk
+
+    H, D = topology.n_hosts, topology.devs_per_host
+    blk = m * k * (4 + 4)
+    plan = {
+        "topology": f"{H}x{D}",
+        "n_shards": topology.n_shards,
+        "engine": "hier" if topology.multi_host else "flat",
+        "stages": [],
+        "ici_bytes_per_device": 0,
+        "dcn_bytes_per_device": 0,
+    }
+    if D > 1:
+        plan["stages"].append(
+            {"stage": "ici_ring", "hops": D - 1,
+             "bytes_per_device": (D - 1) * ring_topk.per_hop_bytes(m, k)})
+        plan["ici_bytes_per_device"] = (D - 1) * ring_topk.per_hop_bytes(m, k)
+    if H > 1:
+        plan["stages"].append(
+            {"stage": "dcn_allgather_fold", "peers": H - 1,
+             "bytes_per_device": (H - 1) * blk})
+        plan["dcn_bytes_per_device"] = (H - 1) * blk
+        plan["flat_dcn_bytes_per_device"] = (H - 1) * D * blk
+        plan["dcn_reduction"] = D
+    return plan

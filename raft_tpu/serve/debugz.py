@@ -143,6 +143,16 @@ def snapshot(batcher=None, registry=None, events_n: int = 50,
         out["sharded"] = sharded_ann.ops_snapshot()
     except Exception:  # noqa: BLE001 - surface must render without parallel/
         pass
+    # multi-host fleet (docs/mnmg.md): per-fleet topology, per-host
+    # health, served_frac, merge plan and the last host probe
+    try:
+        from ..parallel import fleet as _fleet
+
+        fl = _fleet.ops_snapshot()
+        if fl["fleets"]:
+            out["fleet"] = fl["fleets"]
+    except Exception:  # noqa: BLE001 - surface must render without fleet
+        pass
     # mutable-tier state (docs/mutation.md): per-index delta rows,
     # tombstone count, WAL bytes and the last merge verdict
     try:
@@ -291,6 +301,20 @@ def render_text(batcher=None, registry=None, events_n: int = 20,
         lines.append(
             f"  ring demotions: {sh.get('ring_demotions', 0)}"
             + (" (site demoted)" if sh.get("ring_demoted") else ""))
+    for fl in s.get("fleet") or []:
+        hosts = "".join(".X"[not b] for b in fl.get("hosts_ok", [])) or "-"
+        lines += ["", f"-- fleet ({fl.get('topology', '?')}) --",
+                  f"  hosts[{hosts}] served_frac="
+                  f"{fl.get('served_frac', 1.0):g} "
+                  f"indexes={fl.get('n_indexes', 0)} "
+                  f"engine={fl.get('merge', {}).get('engine', '?')} "
+                  f"dcn_reduction="
+                  f"{fl.get('merge', {}).get('dcn_reduction', 1)}x"]
+        lp = fl.get("last_probe") or {}
+        if lp:
+            lines.append(
+                f"  last probe: restored={lp.get('hosts_restored', [])} "
+                f"shards={lp.get('shards', {})}")
     if s.get("mutable"):
         lines += ["", "-- mutable indexes --"]
         for name, ent in sorted(s["mutable"].items()):

@@ -55,6 +55,60 @@ class TestIO:
         assert metric == "cosine"
 
 
+class TestLaneResolution:
+    """The standing Pareto lane resolves SIFT-1M, falling back to a
+    bounded synthetic so the pipeline still runs without the dataset
+    (ROADMAP item 2a)."""
+
+    def test_fbin_dir_preferred(self, tmp_path):
+        from raft_tpu.bench.datasets import resolve_lane_dataset
+
+        d = tmp_path / "sift-1m"
+        d.mkdir()
+        bench.write_fbin(d / "base.fbin", np.zeros((4, 8), np.float32))
+        assert resolve_lane_dataset(str(tmp_path)) == ("sift-1m", "fbin")
+
+    def test_hdf5_second(self, tmp_path):
+        import h5py
+
+        from raft_tpu.bench.datasets import resolve_lane_dataset
+
+        with h5py.File(tmp_path / "sift-128-euclidean.hdf5", "w") as f:
+            f["train"] = np.zeros((4, 8), np.float32)
+        assert resolve_lane_dataset(str(tmp_path)) == (
+            "sift-128-euclidean", "hdf5")
+        # an fbin dir outranks the hdf5
+        d = tmp_path / "sift-1m"
+        d.mkdir()
+        bench.write_fbin(d / "base.fbin", np.zeros((4, 8), np.float32))
+        assert resolve_lane_dataset(str(tmp_path))[1] == "fbin"
+
+    def test_synthetic_fallback(self, tmp_path):
+        from raft_tpu.bench.datasets import resolve_lane_dataset
+
+        name, kind = resolve_lane_dataset(str(tmp_path), budget_rows=5000)
+        assert (name, kind) == ("blobs-5000x128", "synthetic-fallback")
+        # the fallback name must load through the normal dataset path
+        base, q, gt, metric = bench.load_dataset(name, n_queries=16)
+        assert base.shape == (5000, 128) and metric == "sqeuclidean"
+
+    def test_lane_cli_stamps_kind(self, tmp_path, monkeypatch):
+        """`bench lane` on an empty dataset dir runs the fallback sweep
+        and stamps how the lane resolved into the artifact, so a
+        synthetic run can never be mistaken for a SIFT number."""
+        from raft_tpu.bench.__main__ import main
+
+        monkeypatch.chdir(tmp_path)
+        out = tmp_path / "lane.json"
+        main(["lane", "--dataset-dir", str(tmp_path / "nothing"),
+              "--budget-rows", "2000", "--algorithms", "raft_brute_force",
+              "-k", "5", "--reps", "1", "--output", str(out)])
+        doc = json.loads(out.read_text())
+        assert doc["context"]["lane"] == {"dataset": "blobs-2000x128",
+                                          "kind": "synthetic-fallback"}
+        assert doc["benchmarks"]
+
+
 class TestGroundTruth:
     def test_matches_naive(self):
         from ann_utils import naive_knn

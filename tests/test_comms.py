@@ -107,3 +107,78 @@ def test_multicast_sendrecv(mesh):
     shmap = shard_map_compat(body, mesh=mesh, in_specs=(), out_specs=P(),
                           check=False)
     assert float(np.asarray(jax.jit(shmap)())) == 8.0
+
+
+# -- bootstrap (raft_tpu.comms.bootstrap): env autodetect + idempotence --
+
+from raft_tpu.comms import bootstrap  # noqa: E402
+from raft_tpu.core.errors import RaftError  # noqa: E402
+
+
+class TestBootstrapResolve:
+    def test_no_config_is_single_process(self):
+        assert bootstrap._resolve_env(environ={}) == {"distributed": False}
+
+    def test_full_env_autodetect(self):
+        env = {"RAFT_TPU_COORDINATOR": "127.0.0.1:1234",
+               "RAFT_TPU_NUM_PROCESSES": "2",
+               "RAFT_TPU_PROCESS_ID": "1"}
+        assert bootstrap._resolve_env(environ=env) == {
+            "distributed": True,
+            "coordinator_address": "127.0.0.1:1234",
+            "num_processes": 2, "process_id": 1}
+
+    def test_jax_env_fallback(self):
+        env = {"JAX_COORDINATOR_ADDRESS": "127.0.0.1:9",
+               "JAX_NUM_PROCESSES": "2", "JAX_PROCESS_ID": "0"}
+        assert bootstrap._resolve_env(environ=env)["distributed"]
+
+    def test_args_win_over_env(self):
+        env = {"RAFT_TPU_COORDINATOR": "env-host:1",
+               "RAFT_TPU_NUM_PROCESSES": "4",
+               "RAFT_TPU_PROCESS_ID": "3"}
+        cfg = bootstrap._resolve_env("arg-host:2", environ=env)
+        assert cfg["coordinator_address"] == "arg-host:2"
+        assert (cfg["num_processes"], cfg["process_id"]) == (4, 3)
+
+    def test_partial_config_raises_naming_missing(self):
+        """A partial spec would otherwise hang at the first collective —
+        the error must name what is set and what is missing."""
+        env = {"RAFT_TPU_COORDINATOR": "127.0.0.1:1234"}
+        with pytest.raises(RaftError) as ei:
+            bootstrap._resolve_env(environ=env)
+        msg = str(ei.value)
+        assert "coordinator_address" in msg
+        assert "num_processes" in msg and "process_id" in msg
+        assert "RAFT_TPU_NUM_PROCESSES" in msg
+
+    def test_bad_values_raise(self):
+        with pytest.raises(RaftError):
+            bootstrap._resolve_env(environ={
+                "RAFT_TPU_COORDINATOR": "c",
+                "RAFT_TPU_NUM_PROCESSES": "nope",
+                "RAFT_TPU_PROCESS_ID": "0"})
+        with pytest.raises(RaftError):   # rank out of range
+            bootstrap._resolve_env("c", 2, 5, environ={})
+        with pytest.raises(RaftError):
+            bootstrap._resolve_env("c", 0, 0, environ={})
+
+    def test_idempotent_reinit_guard(self, monkeypatch):
+        """Same triple: no-op with already=True. Different triple:
+        refused — one process is one rank for life. (The module state is
+        pre-seeded; jax.distributed.initialize is never called.)"""
+        triple = ("127.0.0.1:7777", 2, 0)
+        monkeypatch.setattr(bootstrap, "_initialized", triple)
+        cfg = bootstrap.init_distributed(*triple)
+        assert cfg.get("already") is True and cfg["process_id"] == 0
+        with pytest.raises(RaftError):
+            bootstrap.init_distributed("127.0.0.1:7777", 2, 1)
+
+    def test_single_process_passthrough(self, monkeypatch):
+        monkeypatch.setattr(bootstrap, "_initialized", None)
+        for name in ("RAFT_TPU_COORDINATOR", "RAFT_TPU_NUM_PROCESSES",
+                     "RAFT_TPU_PROCESS_ID", "JAX_COORDINATOR_ADDRESS",
+                     "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+            monkeypatch.delenv(name, raising=False)
+        assert bootstrap.init_distributed() == {"distributed": False}
+        assert bootstrap._initialized is None

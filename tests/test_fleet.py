@@ -1,0 +1,314 @@
+"""Fleet-layer tests (docs/mnmg.md): the topology planner, the
+hierarchical ICI/DCN merge's bit-identity contract, the distributed
+IVF-PQ build arc on a virtual multi-host mesh, and host-loss
+degradation. Everything runs on the 8-device virtual CPU mesh; the
+2-process loopback-DCN acceptance harness
+(``scratch/run_fleet_dryrun.py``) is wrapped as a slow+distributed
+test."""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.core.errors import RaftError
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.ops import ring_topk
+from raft_tpu.parallel import Fleet, Topology, sharded_ann
+from raft_tpu.parallel import fleet as fleet_mod
+from raft_tpu.parallel import topology as topo_mod
+from raft_tpu.utils import shard_map_compat
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestTopology:
+    def test_groups_and_numbering(self):
+        t = Topology(2, 4)
+        assert (t.n_shards, t.multi_host) == (8, True)
+        assert t.host_of(0) == 0 and t.host_of(5) == 1
+        assert list(t.shards_of(1)) == [4, 5, 6, 7]
+        assert t.host_groups() == ((0, 1, 2, 3), (4, 5, 6, 7))
+        assert t.cross_groups() == ((0, 4), (1, 5), (2, 6), (3, 7))
+        t42 = Topology(4, 2)
+        assert t42.host_groups() == ((0, 1), (2, 3), (4, 5), (6, 7))
+        assert t42.cross_groups() == ((0, 2, 4, 6), (1, 3, 5, 7))
+
+    def test_single_host_topology(self):
+        t = Topology(1, 8)
+        assert not t.multi_host
+        assert t.host_groups() == ((0, 1, 2, 3, 4, 5, 6, 7),)
+
+    def test_detect_single_process(self):
+        assert topo_mod.detect() == Topology(1, jax.device_count())
+
+    def test_invalid(self):
+        with pytest.raises(RaftError):
+            Topology(0, 2)
+        with pytest.raises(RaftError):
+            Topology(2, 2).host_of(4)
+
+    def test_fleet_mesh_virtual(self):
+        for h, d in ((2, 4), (4, 2), (2, 2)):
+            mesh, topo = topo_mod.fleet_mesh(topo_mod.virtual(h, d))
+            assert mesh.shape[topo_mod.AXIS] == h * d
+            assert topo == Topology(h, d)
+
+    def test_plan_merge_dcn_reduction(self):
+        plan = topo_mod.plan_merge(Topology(2, 4), m=128, k=10)
+        assert plan["engine"] == "hier"
+        assert plan["dcn_reduction"] == 4
+        assert (plan["flat_dcn_bytes_per_device"]
+                == 4 * plan["dcn_bytes_per_device"])
+        stages = [s["stage"] for s in plan["stages"]]
+        assert stages == ["ici_ring", "dcn_allgather_fold"]
+        flat = topo_mod.plan_merge(Topology(1, 8), m=128, k=10)
+        assert flat["engine"] == "flat"
+        assert flat["dcn_bytes_per_device"] == 0
+
+
+class TestResolveEngine:
+    def test_single_host_byte_identical(self):
+        """A single-host topology (or none) must leave today's engine
+        resolution untouched."""
+        for m, k in ((64, 10), (512, 32), (8, 4)):
+            base = ring_topk.resolve_engine(m, k, 8)
+            assert ring_topk.resolve_engine(
+                m, k, 8, topology=Topology(1, 8)) == base
+
+    def test_multi_host_default_hier(self):
+        assert ring_topk.resolve_engine(
+            128, 10, 8, topology=Topology(2, 4)) == "hier"
+
+    def test_multi_host_overrides(self):
+        t = Topology(2, 4)
+        assert ring_topk.resolve_engine(
+            128, 10, 8, override="ring", topology=t) == "ring"
+        assert ring_topk.resolve_engine(
+            128, 10, 8, override="allgather", topology=t) == "allgather"
+        # remote-DMA ring hops must not cross DCN
+        assert ring_topk.resolve_engine(
+            128, 10, 8, override="ring_pallas", topology=t) == "hier"
+        assert ring_topk.resolve_engine(
+            128, 10, 8, override="auto", topology=t) == "hier"
+
+    def test_subgroup_comms_force_allgather(self):
+        assert ring_topk.resolve_engine(
+            128, 10, 8, plain_axis=False, topology=Topology(2, 4)) \
+            == "allgather"
+
+    def test_hier_merge_requires_topology(self):
+        with pytest.raises(RaftError):
+            ring_topk.merge(jnp.zeros((2, 3)),
+                            jnp.zeros((2, 3), jnp.int32), 3, True,
+                            axis_size=8, engine="hier")
+
+
+def _merge_on(mesh, d, g, k, engine, topo=None):
+    """Dispatch one merge over the stacked (p, m, w) candidates."""
+    p = mesh.shape["shard"]
+
+    def body(dd, gg):
+        return ring_topk.merge(dd[0], gg[0], k, True, axis="shard",
+                               axis_size=p, engine=engine, topology=topo)
+
+    out = shard_map_compat(
+        body, mesh=mesh,
+        in_specs=(P("shard", None, None), P("shard", None, None)),
+        out_specs=(P(), P()), check=False)(jnp.asarray(d), jnp.asarray(g))
+    return np.asarray(out[0]), np.asarray(out[1])
+
+
+@pytest.mark.multichip
+class TestHierMergeBitIdentity:
+    @pytest.mark.parametrize("hosts,devs", [(2, 4), (4, 2)])
+    def test_hier_equals_flat_with_ties_and_sentinels(
+            self, multichip_mesh, hosts, devs, rng):
+        """The acceptance pin: the two-stage ICI/DCN merge must be
+        BIT-identical to the flat allgather under the (±distance,
+        concat-position) total order — including cross-host ties and a
+        dead shard's (+inf, −1) sentinel rows."""
+        p, m, k = 8, 16, 6
+        topo = Topology(hosts, devs)
+        d = rng.standard_normal((p, m, k)).astype(np.float32)
+        g = rng.permutation(p * m * k).astype(np.int32).reshape(p, m, k)
+        d[:, :, 0] = 0.5          # an 8-way cross-host tie on every query
+        d[p - 1] = np.inf         # a dead shard: all-sentinel candidates
+        g[p - 1] = -1
+        fd, fi = _merge_on(multichip_mesh, d, g, k, "allgather")
+        hd, hi = _merge_on(multichip_mesh, d, g, k, "hier", topo)
+        np.testing.assert_array_equal(hi, fi)
+        np.testing.assert_array_equal(hd, fd)
+
+    def test_single_host_column_topology(self, multichip_mesh, rng):
+        """H=8, D=1: stage 1 degenerates to a pass-through and the DCN
+        fold alone must still match flat."""
+        p, m, k = 8, 8, 4
+        d = rng.standard_normal((p, m, k)).astype(np.float32)
+        g = rng.permutation(p * m * k).astype(np.int32).reshape(p, m, k)
+        fd, fi = _merge_on(multichip_mesh, d, g, k, "allgather")
+        hd, hi = _merge_on(multichip_mesh, d, g, k, "hier", Topology(8, 1))
+        np.testing.assert_array_equal(hi, fi)
+        np.testing.assert_array_equal(hd, fd)
+
+
+def _gt(base, q, k, rows=None):
+    rows = np.arange(len(base)) if rows is None else np.asarray(rows)
+    sub = base[rows]
+    d2 = ((q[:, None, :] - sub[None, :, :]) ** 2).sum(-1)
+    return rows[np.argsort(d2, axis=1, kind="stable")[:, :k]]
+
+
+def _recall(found, want):
+    hits = sum(len(set(found[i].tolist()) & set(want[i].tolist()))
+               for i in range(len(want)))
+    return hits / want.size
+
+
+def test_effective_nprobe_widen():
+    f = fleet_mod._effective_nprobe
+    assert f(4, 1.0, 8) == 4          # healthy: untouched
+    assert f(4, 0.5, 8) == 8          # half dark: double the probes
+    assert f(4, 0.25, 8) == 8         # capped at n_lists
+    assert f(1, 0.9, 100) == 2
+    assert f(4, 0.0, 8) == 8          # degenerate frac clamps
+
+
+@pytest.mark.multichip
+class TestFleetArc:
+    def test_host_loss_bookkeeping_no_build(self):
+        """Host-granular loss bookkeeping without an index build (the
+        tier-1-lean slice of the arc: transitions, events, debugz; the
+        compile-heavy build+search arc runs in the slow lane)."""
+        from raft_tpu.core import events
+        from raft_tpu.serve import debugz
+
+        fleet = Fleet.virtual(2, 2)
+        assert fleet.merge_plan()["dcn_reduction"] == 2
+        fleet.mark_host_failed(1)
+        assert fleet.host_health()["hosts_down"] == [1]
+        kinds = [e["kind"] for e in events.recent()]
+        assert "host_lost" in kinds
+        # transition-only: re-marking an already-down host is silent
+        n_lost = kinds.count("host_lost")
+        fleet.mark_host_failed(1)
+        assert [e["kind"] for e in events.recent()].count(
+            "host_lost") == n_lost
+        fleet.mark_host_failed(1, ok=True)
+        assert fleet.host_health()["hosts_down"] == []
+        assert "host_restored" in [e["kind"] for e in events.recent()]
+
+        snap = debugz.snapshot()
+        ent = next(e for e in snap["fleet"] if e["topology"] == "2x2")
+        assert ent["merge"] == {"engine": "hier", "dcn_reduction": 2}
+        json.dumps(snap, allow_nan=False)
+        assert "fleet" in debugz.render_text()
+
+    @pytest.mark.slow
+    def test_build_search_host_loss_probe(self, multichip_mesh, rng):
+        """The full virtual-fleet arc: distributed build on a 2x2 fleet,
+        hier search bit-identical to the forced flat merge, host loss →
+        host-granular shards_ok + auto-widened recall over the
+        survivors, canary re-admission, and the debugz fleet section."""
+        from raft_tpu.core import events
+
+        fleet = Fleet.virtual(2, 2)
+        assert fleet.merge_plan()["dcn_reduction"] == 2
+        base = rng.standard_normal((1024, 16)).astype(np.float32)
+        q = rng.standard_normal((32, 16)).astype(np.float32)
+        params = ivf_pq.IndexParams(n_lists=8, pq_dim=8, pq_bits=4,
+                                    kmeans_n_iters=4, seed=3)
+        sp = ivf_pq.SearchParams(n_probes=4)
+        idx = fleet.build_ivf_pq(base, params)
+        assert idx.topology is fleet.topology
+        assert "fleet_build" in [e["kind"] for e in events.recent()]
+
+        d, i, ok = fleet.search(idx, q, 10, sp)
+        assert list(ok) == [True] * 4
+        d2, i2, _ = fleet.search(idx, q, 10, sp, merge_engine="allgather")
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(d2))
+        healthy = _recall(np.asarray(i), _gt(base, q, 10))
+        assert healthy > 0.3, healthy
+
+        fleet.mark_host_failed(1)
+        hh = fleet.host_health()
+        assert hh["hosts_ok"] == [True, False]
+        assert hh["hosts_down"] == [1]
+        assert abs(hh["served_frac"] - 0.5) < 0.05, hh
+        dd, ii, ok3 = fleet.search(idx, q, 10, sp)
+        assert list(ok3) == [True, True, False, False]
+        surv = np.concatenate(sharded_ann._split_rows(1024, 4)[:2])
+        ss = set(surv.tolist())
+        assert all(x == -1 or x in ss
+                   for x in np.asarray(ii).ravel().tolist()), \
+            "dead host's rows leaked into degraded results"
+        degraded = _recall(np.asarray(ii), _gt(base, q, 10, rows=surv))
+        assert degraded >= 0.9 * healthy, (degraded, healthy)
+        assert "host_lost" in [e["kind"] for e in events.recent()]
+
+        rep = fleet.probe_hosts()
+        assert rep["hosts_restored"] == [1], rep
+        assert fleet.host_health()["served_frac"] == 1.0
+        assert "host_restored" in [e["kind"] for e in events.recent()]
+        d3, i3, ok4 = fleet.search(idx, q, 10, sp)
+        assert list(ok4) == [True] * 4
+        np.testing.assert_array_equal(np.asarray(i3), np.asarray(i))
+        np.testing.assert_array_equal(np.asarray(d3), np.asarray(d))
+
+        # ops surface: fleet section present, strict-JSON, rendered
+        from raft_tpu.serve import debugz
+
+        snap = debugz.snapshot()
+        assert "fleet" in snap
+        ent = next(e for e in snap["fleet"]
+                   if e["topology"] == "2x2" and e["n_indexes"] >= 1)
+        assert ent["merge"] == {"engine": "hier", "dcn_reduction": 2}
+        assert ent["last_probe"]["hosts_restored"] == [1]
+        json.dumps(snap, allow_nan=False)
+        assert "fleet" in debugz.render_text()
+
+    def test_single_host_fleet_keeps_flat_engines(self, multichip_mesh):
+        fleet = Fleet.local(4)
+        assert fleet.topology == Topology(1, 4)
+        plan = fleet.merge_plan()
+        assert plan["engine"] == "flat" and plan["dcn_bytes_per_device"] == 0
+
+    def test_adopt_rejects_foreign_mesh(self, multichip_mesh, rng):
+        fleet = Fleet.virtual(2, 2)
+
+        class Foreign:
+            mesh = multichip_mesh
+
+        with pytest.raises(RaftError):
+            fleet.adopt(Foreign())
+
+    def test_build_rejects_per_cluster(self, multichip_mesh, rng):
+        fleet = Fleet.virtual(2, 2)
+        with pytest.raises(RaftError):
+            fleet.build_ivf_pq(
+                rng.standard_normal((256, 16)).astype(np.float32),
+                ivf_pq.IndexParams(
+                    n_lists=4, codebook_kind=ivf_pq.CodebookGen.PER_CLUSTER))
+
+
+@pytest.mark.slow
+@pytest.mark.distributed
+def test_fleet_dryrun_two_process():
+    """The MNMG acceptance harness: 2 loopback-DCN processes build the
+    index, pin bit-identity against a single-process reference, and
+    drill the host-loss arc (scratch/run_fleet_dryrun.py)."""
+    script = os.path.join(_ROOT, "scratch", "run_fleet_dryrun.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)    # children set their own device counts
+    r = subprocess.run([sys.executable, script], env=env,
+                       capture_output=True, text=True, timeout=800)
+    out = (r.stdout or "") + (r.stderr or "")
+    if "SKIPPED" in out:
+        pytest.skip(out[-500:])
+    assert r.returncode == 0 and "FLEET_DRYRUN_OK" in out, out[-3000:]
