@@ -522,6 +522,177 @@ def run_storage_ladder(lad_n: int, d: int, nq: int = 1000, k: int = 10,
     return entries
 
 
+def run_filter_sweep(n: int, d: int, nq: int = 100, k: int = 10,
+                     out_json: str = None) -> list:
+    """Filtered-search selectivity sweep (docs/perf.md "Filtered
+    search"): at each filtered-out fraction × family, measure the
+    ADAPTIVE policy (survivor-aware pruning + auto-widening +
+    survivor-brute crossover — the defaults) against the FIXED policy
+    (widen ladder pinned to level 1, crossover disabled), recording
+    recall against the exact filtered oracle, p50 batch latency, the
+    decision the policy took (widen level, effective probes, lists
+    pruned, crossover routing) and the measured scan-vs-brute race
+    verdict under the selectivity-bucketed autotune key. The summary
+    block carries the acceptance verdicts: at 99.9% filtered-out the
+    adaptive policy must hold ≥0.95× the family's unfiltered recall
+    where the fixed policy collapses, and the survivor-brute must beat
+    the widened scan. Standalone; ``main()`` wires it behind
+    RAFT_TPU_BENCH_FILTER."""
+    from raft_tpu.core.bitset import Bitset
+    from raft_tpu.neighbors import cagra, ivf_flat, ivf_pq
+    from raft_tpu.ops import filter_policy
+
+    data, queries = make_corpus(n, d, nq, seed=33)
+    X, Q = np.asarray(data), np.asarray(queries)
+    qj = jnp.asarray(queries)
+    rng = np.random.default_rng(51)
+
+    def oracle(mask):
+        """Exact filtered top-k ids, -1-padded past the survivor count."""
+        ids = np.nonzero(mask)[0]
+        sub = X[ids]
+        dd = ((Q ** 2).sum(1)[:, None] + (sub ** 2).sum(1)[None, :]
+              - 2.0 * Q @ sub.T)
+        order = np.argsort(dd, axis=1, kind="stable")[:, :min(k, ids.size)]
+        out = np.full((nq, k), -1, np.int64)
+        out[:, :order.shape[1]] = ids[order]
+        return out
+
+    def recall_of(found, want):
+        found = np.asarray(found)
+        hits = sum(len(set(found[i][found[i] >= 0].tolist())
+                       & set(want[i][want[i] >= 0].tolist()))
+                   for i in range(found.shape[0]))
+        return hits / max(int((want >= 0).sum()), 1)
+
+    def with_env(tmp, fn):
+        old = {kk: os.environ.get(kk) for kk in tmp}
+        os.environ.update(tmp)
+        try:
+            return fn()
+        finally:
+            for kk, vv in old.items():
+                if vv is None:
+                    os.environ.pop(kk, None)
+                else:
+                    os.environ[kk] = vv
+
+    gt = oracle(np.ones(n, bool))
+    n_probes = 8
+    fi = robust_call(lambda: ivf_flat.build(
+        data, ivf_flat.IndexParams(n_lists=64, seed=0)),
+        "filter ivf_flat build", tries=1)
+    pi = robust_call(lambda: ivf_pq.build(
+        data, ivf_pq.IndexParams(n_lists=64, pq_dim=16, seed=0)),
+        "filter ivf_pq build", tries=1)
+    ci = robust_call(lambda: cagra.build(data, cagra.IndexParams(
+        graph_degree=32, intermediate_graph_degree=48, seed=0)),
+        "filter cagra build", tries=1)
+    spf = ivf_flat.SearchParams(n_probes=n_probes)
+    spp = ivf_pq.SearchParams(n_probes=n_probes)
+    spc = cagra.SearchParams(itopk_size=max(64, 4 * k))
+    fams = {
+        "ivf_flat": lambda f: ivf_flat.search(fi, qj, k, spf, filter=f),
+        "ivf_pq": lambda f: ivf_pq.search(pi, qj, k, spp, filter=f),
+        "cagra": lambda f: cagra.search(ci, qj, k, spc, filter=f),
+    }
+    brutes = {
+        "ivf_flat": lambda f: filter_policy.survivor_brute_ivf(
+            fi, ivf_flat.reconstruct, qj, k, f),
+        "ivf_pq": lambda f: filter_policy.survivor_brute_ivf(
+            pi, ivf_pq.reconstruct, qj, k, f),
+        "cagra": lambda f: filter_policy.survivor_brute_dense(
+            ci.dataset, ci.metric, qj, k, f),
+    }
+    unfiltered = {fam: round(recall_of(fn(None)[1], gt), 4)
+                  for fam, fn in fams.items()}
+    log(f"# filter sweep {n}x{d} nq={nq} k={k}; unfiltered recall "
+        + " ".join(f"{f}={r}" for f, r in unfiltered.items()))
+
+    FIXED = {"RAFT_TPU_FILTER_WIDEN_MAX": "1",
+             "RAFT_TPU_FILTER_BRUTE_MAX": "0"}
+    SCAN_ONLY = {"RAFT_TPU_FILTER_BRUTE_MAX": "0"}
+    entries, extreme = [], {}
+    for frac_out in (0.5, 0.9, 0.99, 0.999):
+        surv_n = max(k, int(round(n * (1.0 - frac_out))))
+        mask = np.zeros(n, bool)
+        mask[rng.choice(n, surv_n, replace=False)] = True
+        want = oracle(mask)
+        selectivity = surv_n / n
+        for fam, fn in fams.items():
+            bs = Bitset.from_mask(jnp.asarray(mask))
+            if fam == "cagra":
+                fd = filter_policy.decide_graph(bs, n, d, k)
+            else:
+                fd = filter_policy.decide_ivf(
+                    fi if fam == "ivf_flat" else pi, bs, n_probes, k, fam)
+            t_ad = median_time(lambda: jax.block_until_ready(
+                fn(bs)[1]), reps=3)
+            r_ad = recall_of(fn(bs)[1], want)
+            t_fx = with_env(FIXED, lambda: median_time(
+                lambda: jax.block_until_ready(fn(bs)[1]), reps=3))
+            r_fx = with_env(FIXED, lambda: recall_of(fn(bs)[1], want))
+            # race the widened scan vs the compacted brute under the
+            # bucketed key — the recorded winner steers later filtered
+            # calls in this selectivity decade
+            _key, winner, timings = filter_policy.tune_crossover(
+                fam, n, d, k, selectivity,
+                lambda: with_env(SCAN_ONLY, lambda: fn(bs)[1]),
+                lambda: brutes[fam](bs)[1], reps=2)
+            e = {"algo": "filter_sweep",
+                 "name": f"filter_sweep.{fam}.out{frac_out}",
+                 "family": fam, "filtered_out": frac_out,
+                 "selectivity": round(selectivity, 6),
+                 "survivors": surv_n,
+                 "qps": round(nq / t_ad, 1) if t_ad else None,
+                 "latency_ms": round(t_ad * 1e3, 2) if t_ad else None,
+                 "recall": round(r_ad, 4),
+                 "unfiltered_recall": unfiltered[fam],
+                 "widen_level": fd.level,
+                 "effective_probes": fd.n_probes or None,
+                 "lists_pruned": fd.lists_pruned or None,
+                 "crossover": bool(fd.use_brute),
+                 "fixed_policy": {
+                     "recall": round(r_fx, 4),
+                     "latency_ms": round(t_fx * 1e3, 2) if t_fx else None},
+                 "race": {"winner": winner,
+                          "scan_s": round(timings.get("scan", 0), 4),
+                          "brute_s": round(timings.get("brute", 0), 4)}}
+            entries.append(e)
+            if frac_out == 0.999:
+                extreme[fam] = e
+            log(f"#   {e['name']}: adaptive recall={r_ad:.4f} "
+                f"({t_ad * 1e3:.1f}ms, level={fd.level} "
+                f"pruned={fd.lists_pruned} brute={fd.use_brute}) "
+                f"fixed recall={r_fx:.4f} ({t_fx * 1e3:.1f}ms) "
+                f"race->{winner}")
+
+    summary = {fam: {
+        "adaptive_holds": e["recall"] >= 0.95 * e["unfiltered_recall"],
+        "fixed_collapses": e["fixed_policy"]["recall"]
+        < 0.95 * e["unfiltered_recall"],
+        "brute_beats_scan": e["race"]["brute_s"] < e["race"]["scan_s"],
+    } for fam, e in extreme.items()}
+    for fam, v in summary.items():
+        log(f"#   extreme-point verdict {fam}: {v}")
+
+    if out_json:
+        payload = {"schema": "raft_tpu_bench_v1", "lane": "filter_sweep",
+                   "n": n, "d": d, "nq": nq, "k": k,
+                   "unfiltered_recall": unfiltered,
+                   "extreme_point_verdicts": summary,
+                   "entries": entries}
+        os.makedirs(os.path.dirname(out_json) or ".", exist_ok=True)
+        tmp = out_json + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, out_json)
+        log(f"# filter sweep artifact -> {out_json}")
+    return entries
+
+
 def main():
     t_wall0 = time.perf_counter()
     budget_s = float(os.environ.get("RAFT_TPU_BENCH_BUDGET_S", "2400"))
@@ -1702,6 +1873,19 @@ def main():
         lad_n = int(os.environ.get("RAFT_TPU_BENCH_LADDER_N",
                                    str(10_000_000)))
         entries.extend(run_storage_ladder(lad_n, d, nq=1000, k=k))
+
+    # --- filtered-search selectivity sweep ------------------------------
+    # Adaptive vs fixed filter policy across filtered-out fractions
+    # (docs/perf.md "Filtered search"). RAFT_TPU_BENCH_FILTER=1 runs it
+    # (default: skip — an on-demand lane; its artifact backs the docs).
+    with algo_section('filter_sweep'):
+        from raft_tpu.core.errors import expects as _expects
+        _expects(os.environ.get("RAFT_TPU_BENCH_FILTER") == "1",
+                 "filter sweep skip (set RAFT_TPU_BENCH_FILTER=1 to run)")
+        fs_n = int(os.environ.get("RAFT_TPU_BENCH_FILTER_N", "20000"))
+        entries.extend(run_filter_sweep(
+            fs_n, d, nq=100, k=k,
+            out_json=os.path.join("artifacts", "bench_filter_sweep.json")))
 
     # --- graph-build race: fused exact all-pairs vs NN-descent ----------
     # The two CAGRA graph builders at one shape (100k×128 at k=96, the

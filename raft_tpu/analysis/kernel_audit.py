@@ -109,9 +109,12 @@ def _v_fused_knn(dtype: str):
             return (functools.partial(fused_knn, k=k, interpret=True),
                     (q, data),
                     {"scales": jnp.ones((n,), jnp.float32)})
+        kw = {}
+        if dtype == "f32_pen":
+            kw["penalty"] = jnp.zeros((n,), jnp.float32)
         data = jnp.zeros((n, d),
                          jnp.bfloat16 if dtype == "bf16" else jnp.float32)
-        return (functools.partial(fused_knn, k=k, interpret=True),
+        return (functools.partial(fused_knn, k=k, interpret=True, **kw),
                 (q, data), {})
     return build
 
@@ -165,9 +168,14 @@ def _v_ivf_pq(lut: str):
         offsets = jnp.arange(L, dtype=jnp.int32) * (n // L)
         sizes = jnp.full((L,), n // L, jnp.int32)
         q = jnp.zeros((m, rot_dim), jnp.float32)
+        kw = {}
+        mode = lut
+        if lut == "f32_pen":
+            mode = "f32"
+            kw["penalty"] = jnp.zeros((n,), jnp.float32)
         return (functools.partial(ivf_pq_scan, k=k, lmax=lmax,
-                                  pq_dim=pq_dim, book=book, lut_mode=lut,
-                                  interpret=True),
+                                  pq_dim=pq_dim, book=book, lut_mode=mode,
+                                  interpret=True, **kw),
                 (codes, norms, centers, cbm, probed, offsets, sizes, q), {})
     return build
 
@@ -274,6 +282,7 @@ SITES: Tuple[KernelSite, ...] = (
         ("bf16", _v_fused_knn("bf16")),
         ("int8", _v_fused_knn("int8")),
         ("int4", _v_fused_knn("int4")),
+        ("f32_pen", _v_fused_knn("f32_pen")),
     )),
     KernelSite("select_k.kpass", "raft_tpu/matrix/select_k.py", 0, (
         ("f32", _v_select_k),
@@ -286,6 +295,7 @@ SITES: Tuple[KernelSite, ...] = (
         ("f32", _v_ivf_pq("f32")),
         ("bf16", _v_ivf_pq("bf16")),
         ("int8", _v_ivf_pq("int8")),
+        ("f32_pen", _v_ivf_pq("f32_pen")),
     )),
     KernelSite("cagra.graph_expand", "raft_tpu/ops/graph_expand.py", 0, (
         ("dense", _v_graph_expand("dense")),

@@ -105,6 +105,34 @@ class Bitset:
         w = (w + (w >> 4)) & jnp.uint32(0x0F0F0F0F)
         return jnp.sum((w * jnp.uint32(0x01010101)) >> 24).astype(jnp.int32)
 
+    def count_by_segments(self, ids: jax.Array, segment_ids: jax.Array,
+                          num_segments: int) -> jax.Array:
+        """Survivor count per segment: ``out[s] = sum over j with
+        segment_ids[j] == s of test(ids[j])`` — one O(len(ids)) pass
+        yielding a grouped popcount (the per-IVF-list selectivity
+        measurement: ``ids`` = the index's ``source_ids`` in storage
+        order, ``segment_ids`` = the list label of each storage row).
+        Out-of-range ids (capacity-slack rows carry source id -1) read
+        as False via :meth:`test`, so they never count. jit-safe."""
+        bits = self.test(ids).astype(jnp.int32)
+        return jax.ops.segment_sum(bits, segment_ids,
+                                   num_segments=num_segments)
+
+    def fingerprint(self) -> str:
+        """Stable content digest of the packed words + length (host
+        read). Two bitsets share a fingerprint iff they select the same
+        rows — the cache-key component serving stacks fold in so a
+        filtered answer can never alias an unfiltered (or differently
+        filtered) one. Eager-only: forces a device→host transfer."""
+        import hashlib
+
+        import numpy as np
+
+        h = hashlib.blake2b(np.asarray(self._masked_words()).tobytes(),
+                            digest_size=16)
+        h.update(str(int(self.n_bits)).encode())
+        return h.hexdigest()
+
     def any(self) -> jax.Array:
         return jnp.any(self._masked_words() != 0)
 

@@ -102,6 +102,9 @@ WELL_KNOWN_KINDS = frozenset({
     "hook_error", "soak_phase",
     # multi-host fleet (docs/mnmg.md)
     "host_lost", "host_restored", "fleet_build",
+    # selectivity-adaptive filtered search (docs/perf.md "Filtered
+    # search"): a search routed to the compacted survivor-brute path
+    "filter_crossover",
 })
 
 # arrays above this many elements are summarized, not inlined — one

@@ -662,6 +662,27 @@ def search(
     select_min = is_min_close(mt)
     expanded = mt in _PALLAS_METRICS
 
+    if (filter is not None and valid_rows is None
+            and index.logical_dim is None and not in_jax_trace()):
+        # selectivity-adaptive crossover (ops/filter_policy.py): at
+        # extreme selectivity a full scan pays the whole corpus's HBM
+        # traffic to penalize almost every row — gather the survivors
+        # and search the compacted set instead (exact either way; int4
+        # stores skip it: nibble-packed rows don't row-gather).
+        from ..ops import filter_policy
+
+        fd = (None if filter_policy.adaptive_off()
+              else filter_policy.decide_graph(filter, n, index.dim, k,
+                                              family="brute_force"))
+        if fd is not None and fd.use_brute:
+            return filter_policy.crossover(
+                fd, "brute_force",
+                lambda: filter_policy.survivor_brute_dense(
+                    index.dataset, mt, q, k, filter, index.scales,
+                    index.metric_arg),
+                lambda: search(index, q, k, tile_size, filter, valid_rows,
+                               algo, precision, workspace_mb))
+
     if algo == "auto":
         from ..ops import autotune
 

@@ -952,10 +952,22 @@ def _search_jit(dataset, dataset_score, score_scales, graph, qc, mask_bits,
 
     # seed the itopk buffer: per-query random nodes (random_seed init,
     # search_plan.cuh), plus the shared covering set when present
-    seeds = jax.random.randint(seed_key, (m, n_seeds), 0, n)
-    seed_d = _gather_score(dataset_score, score_scales, seeds, qc, mt)
     if mask_bits is not None:
+        # survivor-aware seeding (ops/filter_policy.py): uniform-over-n
+        # seeds can ALL land on filtered rows under a high-selectivity
+        # filter (empty result despite survivors). Sampling the r-th
+        # set bit via the mask's cumulative sum is uniform over the
+        # surviving rows by construction; an all-cleared mask keeps
+        # every seed at +inf, so the empty-result contract holds.
+        csum = jnp.cumsum(mask_bits.astype(jnp.int32))
+        r = jax.random.randint(seed_key, (m, n_seeds), 0,
+                               jnp.maximum(csum[-1], 1))
+        seeds = jnp.minimum(jnp.searchsorted(csum, r + 1), n - 1)
+        seed_d = _gather_score(dataset_score, score_scales, seeds, qc, mt)
         seed_d = jnp.where(mask_bits[seeds], seed_d, jnp.inf)
+    else:
+        seeds = jax.random.randint(seed_key, (m, n_seeds), 0, n)
+        seed_d = _gather_score(dataset_score, score_scales, seeds, qc, mt)
     # dedup identical random seeds (mark later occurrences)
     seed_d = jnp.where(_dup_mask(seeds), jnp.inf, seed_d)
     if seed_rows is not None:
@@ -1345,6 +1357,34 @@ def search(
     expects(q.ndim == 2 and q.shape[1] == index.dim, "bad query shape %s",
             tuple(q.shape))
     itopk, width, max_iter = _plan_dims(p, k)
+    if filter is not None:
+        from ..ops import filter_policy
+        from ..utils import in_jax_trace
+
+        if not in_jax_trace() and not filter_policy.adaptive_off():
+            # selectivity-adaptive policy (ops/filter_policy.py): widen
+            # itopk along the brownout ladder so survivor hits are not
+            # crowded out of the frontier, and at extreme selectivity
+            # cross over to an exact brute pass on the compacted
+            # survivors (a graph walk through mostly-filtered nodes
+            # stops converging long before that point). Ladder levels
+            # land on existing compile buckets — zero new compiles.
+            import dataclasses as _dc
+
+            fd = filter_policy.decide_graph(filter, index.size, index.dim,
+                                            k)
+            if fd.use_brute:
+                return filter_policy.crossover(
+                    fd, "cagra",
+                    lambda: filter_policy.survivor_brute_dense(
+                        index.dataset, index.metric, q, k, filter),
+                    lambda: search(index, q, k, p, filter, res,
+                                   query_chunk, engine))
+            if fd.level > 1:
+                p = _dc.replace(p, itopk_size=min(
+                    max(p.itopk_size, k) * fd.level,
+                    max(index.size, k)))
+                itopk, width, max_iter = _plan_dims(p, k)
     if (index.seed_nodes is not None and filter is None
             and index.seed_nodes.shape[0] >= 64):
         # the shared covering set does the heavy seeding; random seeds

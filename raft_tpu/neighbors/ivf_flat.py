@@ -333,7 +333,7 @@ def prepare_scan(index: Index) -> None:
 
 
 def _search_pallas(index, q, k, n_probes, offsets_j, sizes_j, precision,
-                   pen_p=None):
+                   pen_p=None, survivors=None):
     """Fused query-grouped list scan (the TPU perf path; ops/ivf_scan.py)."""
     from ..ops.ivf_scan import _ivf_flat_scan_jit, coarse_probe, pad_for_scan
 
@@ -341,7 +341,7 @@ def _search_pallas(index, q, k, n_probes, offsets_j, sizes_j, precision,
     probed = coarse_probe(q, index.centers, n_probes,
                           metric=_PALLAS_METRICS[mt],
                           center_norms=index.center_norms,
-                          precision=precision)
+                          precision=precision, survivors=survivors)
     lmax = int(index.list_sizes.max())
     # the aligned-DMA padding copies the dataset: cached once per index,
     # but NEVER stored from inside a trace (leaked tracers)
@@ -418,6 +418,37 @@ def search(
     sizes_j = jnp.asarray(sizes_np, jnp.int32)
     mask_bits = filter.to_mask() if filter is not None else None
 
+    # selectivity-adaptive policy (ops/filter_policy.py): measure per-list
+    # survivor counts once, prune zero-survivor lists (their scan size
+    # zeroes → sentinel rows, no DMA), widen the probe set to restore the
+    # survivor-weighted candidate mass, and at extreme selectivity cross
+    # over to an exact brute-force pass on the compacted survivors. The
+    # widen/crossover half needs host values, so a traced search keeps
+    # only the free device-side prune.
+    surv_dev = None
+    if filter is not None:
+        from ..ops import filter_policy
+
+        if (in_jax_trace() or getattr(_hot_local, "skip", False)
+                or filter_policy.adaptive_off()):
+            # traced, the resident half of a host-streamed search (which
+            # keeps its own machinery), or a suspended internal filter
+            # (mutable tombstones): free prune only
+            surv_dev = filter_policy.list_survivors(index, filter)
+        else:
+            fd = filter_policy.decide_ivf(index, filter, n_probes, k,
+                                          "ivf_flat")
+            if fd.use_brute:
+                return filter_policy.crossover(
+                    fd, "ivf_flat",
+                    lambda: filter_policy.survivor_brute_ivf(
+                        index, reconstruct, q, k, filter),
+                    lambda: search(index, q, k, p, filter, query_chunk,
+                                   algo, precision, res))
+            n_probes = fd.n_probes
+            surv_dev = fd.surv_dev
+        sizes_j = jnp.where(surv_dev > 0, sizes_j, 0)
+
     # every storage dtype rides the pallas scan: f32/bf16 natively,
     # int8 via per-row scales applied to the dot in-kernel, uint8 exact
     # (byte values are representable in bf16; role of the per-dtype
@@ -451,7 +482,7 @@ def search(
                 lambda qs, _s0: _search_chunk(index, qs, k, n_probes,
                                               fb_state["max_rows"],
                                               offsets_j, sizes_j, mask_bits,
-                                              mt),
+                                              mt, surv_dev),
                 qc, fb_state["chunk"])
 
         # guarded: a scan-kernel failure demotes this site to the exact
@@ -460,7 +491,7 @@ def search(
             lambda qc, _s0: guarded_call(
                 "ivf_flat.scan",
                 lambda: _search_pallas(index, qc, k, n_probes, offsets_j,
-                                       sizes_j, precision, pen_p),
+                                       sizes_j, precision, pen_p, surv_dev),
                 lambda: _xla_fallback(qc)),
             q, query_chunk, res)
 
@@ -472,13 +503,14 @@ def search(
 
     return run_query_chunks(
         lambda qc, _s0: _search_chunk(index, qc, k, n_probes, max_rows,
-                                      offsets_j, sizes_j, mask_bits, mt),
+                                      offsets_j, sizes_j, mask_bits, mt,
+                                      surv_dev),
         q, query_chunk, res)
 
 
 def search_arrays(data, data_norms, source_ids, centers, center_norms,
                   offsets_j, sizes_j, qc, k, n_probes, max_rows, mt,
-                  mask_bits=None, scales=None):
+                  mask_bits=None, scales=None, survivors=None):
     """Pure-array IVF-Flat search core — everything traced, so it runs under
     jit, vmap and shard_map alike (the multi-chip path stacks per-shard
     arrays and calls this per shard). ``data`` may be stored low-precision
@@ -493,7 +525,7 @@ def search_arrays(data, data_norms, source_ids, centers, center_norms,
     cmetric = ("ip" if mt is DistanceType.InnerProduct
                else "cos" if mt is DistanceType.CosineExpanded else "l2")
     probed = coarse_probe(qc, centers, n_probes, metric=cmetric,
-                          center_norms=center_norms)
+                          center_norms=center_norms, survivors=survivors)
 
     # stage 2: gather candidates and score (the fused-scan analog)
     rows, valid, _ = _candidate_rows(probed, offsets_j, sizes_j, max_rows)
@@ -530,11 +562,11 @@ def search_arrays(data, data_norms, source_ids, centers, center_norms,
 
 
 def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
-                  mask_bits, mt):
+                  mask_bits, mt, survivors=None):
     return search_arrays(index.data, index.data_norms, index.source_ids,
                          index.centers, index.center_norms, offsets_j,
                          sizes_j, qc, k, n_probes, max_rows, mt, mask_bits,
-                         index.scales)
+                         index.scales, survivors)
 
 
 _hot_local = __import__("threading").local()   # re-entry guard: the hot

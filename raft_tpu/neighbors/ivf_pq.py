@@ -518,7 +518,7 @@ def prepare_scan(index: Index) -> None:
 
 
 def _search_pallas(index: Index, q, k, n_probes, lut_dtype, precision,
-                   pen_p=None):
+                   pen_p=None, survivors=None):
     """Fused query-grouped PQ scan (ops/ivf_pq_scan.py) — the TPU perf
     path (expanded-form LUT + one-hot GEMM scoring)."""
     from ..ops.ivf_pq_scan import _ivf_pq_scan_jit
@@ -537,13 +537,18 @@ def _search_pallas(index: Index, q, k, n_probes, lut_dtype, precision,
     q_rot = hdot(q, index.rotation.T)
     coarse_metric = "ip" if mt is DistanceType.InnerProduct else "l2"
     probed = coarse_probe(q_rot, index.centers_rot, n_probes,
-                          metric=coarse_metric, precision=precision)
+                          metric=coarse_metric, precision=precision,
+                          survivors=survivors)
+    sizes_j = jnp.asarray(index.list_sizes, jnp.int32)
+    if survivors is not None:
+        # zero-survivor lists scan as empty: sentinel rows only, no DMA
+        sizes_j = jnp.where(survivors > 0, sizes_j, 0)
     interpret = jax.default_backend() != "tpu"
     vals, rows = _ivf_pq_scan_jit(
         cache["codes_p"], cache["norms_p"], pen_p, index.centers_rot,
         cache["cbm"], probed,
         jnp.asarray(index.list_offsets[:-1], jnp.int32),
-        jnp.asarray(index.list_sizes, jnp.int32), q_rot, k, lmax,
+        sizes_j, q_rot, k, lmax,
         index.pq_dim, index.pq_book_size,
         "ip" if mt is DistanceType.InnerProduct else "l2",
         _lut_mode(lut_dtype), interpret, precision)
@@ -591,6 +596,34 @@ def search(
                                    query_chunk, algo, precision, res)
     expects(index.size > 0, "index is empty")
     n_probes = min(p.n_probes, index.n_lists)
+
+    # selectivity-adaptive policy (ops/filter_policy.py): same contract
+    # as ivf_flat.search — prune zero-survivor lists, widen the probe
+    # set to the survivor-weighted mass target, cross over to the exact
+    # compacted brute pass (decode + back-rotate the survivors) at
+    # extreme selectivity. Traced searches keep only the device prune.
+    surv_dev = None
+    if filter is not None:
+        from ..ops import filter_policy
+
+        if (in_jax_trace() or getattr(_hot_local, "skip", False)
+                or filter_policy.adaptive_off()):
+            # traced, the resident half of a host-streamed search (which
+            # keeps its own machinery), or a suspended internal filter
+            # (mutable tombstones): free prune only
+            surv_dev = filter_policy.list_survivors(index, filter)
+        else:
+            fd = filter_policy.decide_ivf(index, filter, n_probes, k,
+                                          "ivf_pq")
+            if fd.use_brute:
+                return filter_policy.crossover(
+                    fd, "ivf_pq",
+                    lambda: filter_policy.survivor_brute_ivf(
+                        index, reconstruct, q, k, filter),
+                    lambda: search(index, q, k, p, filter, query_chunk,
+                                   algo, precision, res))
+            n_probes = fd.n_probes
+            surv_dev = fd.surv_dev
 
     # wide PQ shapes need the bf16/int8 LUT modes in the kernel (an f32
     # one-hot block would bust VMEM); an explicit f32-LUT request there
@@ -641,7 +674,10 @@ def search(
                 fb_state["max_rows"] = _probe_budget(sizes_np, n_probes)
                 fb_state["offsets_j"] = jnp.asarray(
                     index.list_offsets[:-1], jnp.int32)
-                fb_state["sizes_j"] = jnp.asarray(sizes_np, jnp.int32)
+                sizes_j = jnp.asarray(sizes_np, jnp.int32)
+                if surv_dev is not None:
+                    sizes_j = jnp.where(surv_dev > 0, sizes_j, 0)
+                fb_state["sizes_j"] = sizes_j
                 per_q = fb_state["max_rows"] * index.pq_dim * 8 + \
                     n_probes * index.pq_dim * index.pq_book_size * 4
                 fb_state["chunk"] = max(
@@ -651,7 +687,8 @@ def search(
                                               fb_state["max_rows"],
                                               fb_state["offsets_j"],
                                               fb_state["sizes_j"],
-                                              mask_bits, p.lut_dtype),
+                                              mask_bits, p.lut_dtype,
+                                              surv_dev),
                 qc, fb_state["chunk"])
 
         # guarded: a PQ-scan kernel failure demotes this site to the
@@ -660,7 +697,7 @@ def search(
             lambda qc, _s0: guarded_call(
                 "ivf_pq.scan",
                 lambda: _search_pallas(index, qc, k, n_probes, p.lut_dtype,
-                                       precision, pen_p),
+                                       precision, pen_p, surv_dev),
                 lambda: _xla_fallback(qc)),
             q, query_chunk, res)
 
@@ -674,16 +711,18 @@ def search(
 
     offsets_j = jnp.asarray(index.list_offsets[:-1], jnp.int32)
     sizes_j = jnp.asarray(sizes_np, jnp.int32)
+    if surv_dev is not None:
+        sizes_j = jnp.where(surv_dev > 0, sizes_j, 0)
 
     return run_query_chunks(
         lambda qc, _s0: _search_chunk(index, qc, k, n_probes, max_rows,
                                       offsets_j, sizes_j, mask_bits,
-                                      p.lut_dtype),
+                                      p.lut_dtype, surv_dev),
         q, query_chunk, res)
 
 
 def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
-                  mask_bits, lut_dtype):
+                  mask_bits, lut_dtype, survivors=None):
     mt = index.metric
     m = qc.shape[0]
     pq_dim, book = index.pq_dim, index.pq_book_size
@@ -697,6 +736,9 @@ def _search_chunk(index, qc, k, n_probes, max_rows, offsets_j, sizes_j,
     else:
         c2 = jnp.sum(index.centers_rot * index.centers_rot, axis=1)
         coarse = c2[None, :] - 2.0 * cross              # + q² is rank-constant
+    if survivors is not None:
+        # filter-pruned lists never win a probe slot (ops/filter_policy.py)
+        coarse = jnp.where(survivors[None, :] > 0, coarse, jnp.inf)
     _, probed = select_k(coarse, n_probes, select_min=True)   # (m, p)
 
     # stage 2: per-(query, probe) LUTs (the smem LUT analog)

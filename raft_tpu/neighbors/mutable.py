@@ -696,25 +696,42 @@ class MutableIndex:
             # the traffic actually being served
             self._last_shape = (int(q.shape[0]), int(k))
             self._last_request = (params, dict(opts))
-        expects(sview is not None or dview is not None,
+            phys = len(self._alive) + len(self._d_ids)
+        expects(sview is not None or dview is not None or phys > 0,
                 "mutable index is empty")
         select_min = is_min_close(self.metric)
         bad = jnp.inf if select_min else -jnp.inf
+        if sview is None and dview is None:
+            # rows exist but every one is tombstoned: same (+inf, -1)
+            # sentinel padding the immutable families return when a
+            # filter leaves fewer than k survivors
+            return (jnp.full((q.shape[0], k), bad, jnp.float32),
+                    jnp.full((q.shape[0], k), -1, jnp.int32))
+        from ..ops import filter_policy
+
         parts = []
-        if sview is not None:
-            sealed, filt, ids_dev = sview
-            ks = min(k, sealed.size)
-            d, i = self._search_sealed(sealed, q, ks, params, filt, opts)
-            ext = jnp.where(i >= 0,
-                            jnp.take(ids_dev, jnp.clip(i, 0, None)), -1)
-            parts.append(_pad_k(d, ext, k, bad))
-        if dview is not None:
-            didx, dfilt, dids_dev, cap = dview
-            kd = min(k, cap)
-            d, i = brute_force.search(didx, q, kd, filter=dfilt)
-            ext = jnp.where(i >= 0,
-                            jnp.take(dids_dev, jnp.clip(i, 0, None)), -1)
-            parts.append(_pad_k(d, ext, k, bad))
+        # tombstone masks are internal shape-stable filters: the views
+        # above are capacity-padded precisely so repeated searches reuse
+        # executables, and the adaptive crossover would re-gather the
+        # survivors into a fresh shape after every delete (one compile
+        # per mutation) — suspend it; the free prune stays
+        with filter_policy.suspended():
+            if sview is not None:
+                sealed, filt, ids_dev = sview
+                ks = min(k, sealed.size)
+                d, i = self._search_sealed(sealed, q, ks, params, filt,
+                                           opts)
+                ext = jnp.where(i >= 0,
+                                jnp.take(ids_dev, jnp.clip(i, 0, None)), -1)
+                parts.append(_pad_k(d, ext, k, bad))
+            if dview is not None:
+                didx, dfilt, dids_dev, cap = dview
+                kd = min(k, cap)
+                d, i = brute_force.search(didx, q, kd, filter=dfilt)
+                ext = jnp.where(i >= 0,
+                                jnp.take(dids_dev, jnp.clip(i, 0, None)),
+                                -1)
+                parts.append(_pad_k(d, ext, k, bad))
         if len(parts) == 1:
             return parts[0]
         return brute_force.knn_merge_parts(

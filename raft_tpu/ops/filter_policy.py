@@ -1,0 +1,380 @@
+"""Selectivity-adaptive filtered-search policy (docs/perf.md "Filtered
+search").
+
+Every family threads a ``filter=`` bitset through its search path, but a
+static policy wastes the information the filter carries: at 99%+
+filtered-out a fixed ``n_probes``/``itopk`` collapses recall (the
+survivors the probe set covers shrink with the selectivity), while the
+kernels still scan every row only to penalize most of them. This module
+turns one cheap measurement — the bitset's per-IVF-list survivor counts
+(:meth:`raft_tpu.core.bitset.Bitset.count_by_segments`, a grouped
+popcount) — into three decisions, all sharing one :class:`FilterDecision`:
+
+* **prune**: lists with zero survivors are dropped from probe selection
+  (their ``sizes`` zero out, so the scan kernel emits only sentinel rows
+  with no DMA — ``allow_partial``/merge semantics untouched);
+* **widen**: the probe set grows along a small ladder of levels
+  (brownout-style ×1/×2/×4/×8) until the *survivor-weighted* probe mass
+  reaches the unfiltered target, so recall holds without paying the
+  widest setting on mild filters. Levels are the only shape knob — each
+  lands on an existing compile bucket, so widening costs zero new
+  compiles;
+* **crossover**: when few enough rows survive
+  (``RAFT_TPU_FILTER_BRUTE_MAX``, or a measured verdict under a
+  selectivity-bucketed autotune key), gather the survivors and run the
+  existing brute-force engine over the compacted set — exact by
+  construction and, at extreme selectivity, orders of magnitude less HBM
+  traffic than any widened scan. The compacted path is gated behind
+  ``guarded_call("filter.survivor_brute")`` with the widened-scan search
+  as the bit-safe fallback.
+
+The decision points are eager-only (they read survivor counts onto the
+host); a traced filtered search still gets the free device-side zero-
+survivor prune via :func:`list_survivors`, just not the adaptive widen/
+crossover. Host-streamed IVF indexes keep their own machinery and skip
+the adaptive policy entirely, and internal shape-stable filters (the
+mutable tier's tombstone masks) run under :func:`suspended`.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+import threading
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import env_int
+
+__all__ = ["FilterDecision", "LEVELS", "list_survivors", "decide_ivf",
+           "decide_graph", "crossover", "crossover_key",
+           "selectivity_bucket", "survivor_ids", "survivor_brute_ivf",
+           "survivor_brute_dense", "tune_crossover", "suspended",
+           "adaptive_off"]
+
+# widen ladder: each level multiplies the probe budget (n_probes / itopk)
+# and lands on its own compile bucket — four buckets total, never one per
+# filter. RAFT_TPU_FILTER_WIDEN_MAX caps the ladder (default: full).
+LEVELS: Tuple[int, ...] = (1, 2, 4, 8)
+
+_local = threading.local()   # re-entry guard: the crossover's widened-scan
+# fallback re-enters the family search, which must not crossover again
+
+
+@contextlib.contextmanager
+def suspended():
+    """Disable the adaptive policy (widen + crossover) on this thread;
+    the free zero-survivor prune stays. For INTERNAL filters whose
+    caller owns a shape-stability contract: the mutable tier masks
+    tombstones through the family filter slot, and its views are
+    deliberately capacity-padded so repeated searches hit the same
+    executables — a crossover there would re-gather the survivors into
+    a new shape after every delete (one recompile per mutation, the
+    exact storm the soak's steady-state invariant exists to catch)."""
+    prev = getattr(_local, "off", False)
+    _local.off = True
+    try:
+        yield
+    finally:
+        _local.off = prev
+
+
+def adaptive_off() -> bool:
+    """True while inside :func:`suspended` on this thread."""
+    return getattr(_local, "off", False)
+
+
+@dataclasses.dataclass(frozen=True)
+class FilterDecision:
+    """One filtered call's measured selectivity + the policy verdict."""
+
+    selectivity: float            # surviving fraction (1.0 = no filtering)
+    survivors: int                # total surviving rows
+    level: int                    # widen multiplier chosen from LEVELS
+    n_probes: int                 # widened probe count (IVF families)
+    lists_pruned: int             # zero-survivor lists dropped (IVF)
+    use_brute: bool               # route to the compacted brute crossover
+    surv_dev: Optional[jax.Array] = None   # per-list survivor counts
+
+
+def _widen_max() -> int:
+    return max(1, env_int("RAFT_TPU_FILTER_WIDEN_MAX", LEVELS[-1]))
+
+
+def _brute_max() -> int:
+    return env_int("RAFT_TPU_FILTER_BRUTE_MAX", 8192)
+
+
+def _set_gauge(selectivity: float) -> None:
+    try:
+        from ..serve import metrics as serve_metrics
+
+        serve_metrics.gauge("serve.filter.selectivity").set(selectivity)
+    except Exception:  # noqa: BLE001 - telemetry must not break search
+        pass
+
+
+def _list_labels(index) -> jax.Array:
+    """(total_rows,) int32 list label of each storage row, from the host
+    ``list_offsets`` spans. Cached on the index (concrete array built
+    from host metadata, so caching is trace-safe)."""
+    total = int(index.list_offsets[-1])
+    cache = getattr(index, "_filter_list_labels", None)
+    if cache is None or cache.shape[0] != total:
+        spans = np.diff(np.asarray(index.list_offsets, np.int64))
+        lab = np.repeat(np.arange(index.n_lists, dtype=np.int32), spans)
+        cache = jnp.asarray(lab)
+        index._filter_list_labels = cache
+    return cache
+
+
+def list_survivors(index, filter) -> jax.Array:  # noqa: A002
+    """(n_lists,) int32 survivor count per IVF list — one O(total_rows)
+    pass (grouped popcount over storage order). Capacity-slack rows carry
+    source id -1 and never count. jit-safe; this is the half of the
+    policy a traced search still gets (zero-survivor lists zero their
+    scan size, so the kernel skips their DMA entirely)."""
+    return filter.count_by_segments(index.source_ids, _list_labels(index),
+                                    int(index.n_lists))
+
+
+def selectivity_bucket(selectivity: float) -> str:
+    """Coarse categorical tag for autotune keys: decades of surviving
+    fraction ("e0" ≈ unfiltered … "e4" ≈ 1-in-10k survives, "none" =
+    nothing survives). Crossover verdicts move with the decade, not the
+    exact fraction — one race steers the whole bucket."""
+    if selectivity <= 0.0:
+        return "none"
+    return f"e{min(6, max(0, int(-math.log10(min(selectivity, 1.0)))))}"
+
+
+def crossover_key(family: str, n: int, d: int, k: int,
+                  selectivity: float) -> str:
+    """Selectivity-bucketed autotune key for the brute-vs-scan race."""
+    from . import autotune
+
+    return autotune.shape_bucket("filter_brute", fam=family, n=int(n),
+                                 d=int(d), k=int(k),
+                                 sel=selectivity_bucket(selectivity))
+
+
+def _want_brute(family: str, n: int, d: int, k: int, survivors: int,
+                selectivity: float) -> bool:
+    """Crossover verdict: a measured race winner under the bucketed key
+    when one exists, else the env threshold. The widened-fallback
+    re-entry guard always wins."""
+    if getattr(_local, "skip", False):
+        return False
+    from . import autotune
+
+    verdict = autotune.lookup(crossover_key(family, n, d, k, selectivity))
+    if verdict == "brute":
+        return survivors > 0
+    if verdict == "scan":
+        return False
+    return 0 < survivors <= _brute_max()
+
+
+def decide_ivf(index, filter, n_probes: int, k: int,  # noqa: A002
+               family: str) -> FilterDecision:
+    """Measure + decide for an IVF family (eager-only: reads the per-list
+    survivor counts onto the host).
+
+    Widening math: the unfiltered probe set covers up to T = Σ of the
+    ``n_probes`` largest list sizes candidate rows; under the filter the
+    same probes cover only their survivors. Pick the smallest ladder
+    level whose top-(n_probes·level) *survivor* mass reaches
+    min(T, total survivors) — i.e. restore the unfiltered candidate mass
+    where possible, and never widen past what survives."""
+    surv_dev = list_survivors(index, filter)
+    surv = np.asarray(surv_dev, np.int64)
+    total = int(surv.sum())
+    selectivity = total / max(int(filter.n_bits), 1)
+    _set_gauge(selectivity)
+
+    sizes = np.asarray(index.list_sizes, np.int64)
+    n_lists = int(index.n_lists)
+    target = int(np.sort(sizes)[::-1][:n_probes].sum())
+    target = min(target, total)
+    cum = np.cumsum(np.sort(surv)[::-1])
+    lists_pruned = int((surv == 0).sum())
+
+    widen_max = _widen_max()
+    level = max(lv for lv in LEVELS if lv <= widen_max)
+    for lv in LEVELS:
+        if lv > widen_max:
+            break
+        p = min(n_probes * lv, n_lists)
+        if total == 0 or cum[p - 1] >= target:
+            level = lv
+            break
+    eff = min(n_probes * level, n_lists)
+
+    use_brute = _want_brute(family, index.size, index.dim, k, total,
+                            selectivity)
+    return FilterDecision(selectivity, total, level, eff, lists_pruned,
+                          use_brute, surv_dev)
+
+
+def decide_graph(filter, n: int, d: int, k: int,  # noqa: A002
+                 family: str = "cagra") -> FilterDecision:
+    """Measure + decide for a graph/dense family (eager-only). No lists
+    to prune — the verdict is a widen level for the traversal's
+    ``itopk`` (the survivor-reachability analog of probe mass: keep the
+    frontier wide enough that survivor hits are not crowded out) plus
+    the same crossover decision as the IVF path."""
+    total = int(filter.count())
+    selectivity = total / max(int(filter.n_bits), 1)
+    _set_gauge(selectivity)
+
+    widen_max = _widen_max()
+    if selectivity >= 0.5:
+        level = 1
+    elif selectivity >= 0.1:
+        level = 2
+    elif selectivity >= 0.01:
+        level = 4
+    else:
+        level = LEVELS[-1]
+    level = min(level, max(lv for lv in LEVELS if lv <= widen_max))
+
+    use_brute = _want_brute(family, n, d, k, total, selectivity)
+    return FilterDecision(selectivity, total, level, 0, 0, use_brute)
+
+
+def crossover(fd: FilterDecision, family: str, brute_fn: Callable[[], object],
+              widened_fn: Callable[[], object]):
+    """Run the compacted survivor-brute path behind its breaker, with the
+    family's own widened scan as the bit-safe fallback. ``widened_fn``
+    re-enters the family search; the thread-local skip flag keeps the
+    re-entry from deciding crossover again (infinite recursion)."""
+    try:
+        from ..core import events as core_events
+
+        core_events.record("filter_crossover", f"filter.{family}",
+                           family=family, survivors=fd.survivors,
+                           selectivity=round(fd.selectivity, 6))
+    except Exception:  # noqa: BLE001 - telemetry must not break search
+        pass
+
+    def _widened():
+        _local.skip = True
+        try:
+            return widened_fn()
+        finally:
+            _local.skip = False
+
+    from .guarded import guarded_call
+
+    return guarded_call("filter.survivor_brute", brute_fn, _widened)
+
+
+def survivor_ids(filter) -> np.ndarray:  # noqa: A002
+    """Host int64 array of surviving sample ids (set-bit positions),
+    cached on the bitset object — bitset ops are functional (every
+    mutation returns a new object), so identity-keyed caching is safe."""
+    cached = getattr(filter, "_survivor_ids_cache", None)
+    if cached is None:
+        cached = np.nonzero(np.asarray(filter.to_mask()))[0].astype(np.int64)
+        filter._survivor_ids_cache = cached
+    return cached
+
+
+def _physical_rows(index, src: np.ndarray) -> np.ndarray:
+    """Map surviving source ids → physical storage rows via the cached
+    inverse of ``index.source_ids`` (slack rows carry -1 and never
+    enter the inverse)."""
+    inv = getattr(index, "_source_inverse", None)
+    if inv is None:
+        sid = np.asarray(index.source_ids, np.int64)
+        inv = np.full(int(index.size), -1, np.int64)
+        pos = np.nonzero((sid >= 0) & (sid < index.size))[0]
+        inv[sid[pos]] = pos
+        index._source_inverse = inv
+    return inv[src]
+
+
+def _pad_to_k(d, i, k: int, bad):
+    kk = d.shape[1]
+    if kk < k:
+        d = jnp.pad(d, ((0, 0), (0, k - kk)), constant_values=bad)
+        i = jnp.pad(i, ((0, 0), (0, k - kk)), constant_values=-1)
+    return d, i
+
+
+def _brute_over(vecs, metric, queries, k: int, src: np.ndarray,
+                metric_arg: float = 2.0):
+    """Exact brute-force top-k over the compacted survivor rows, mapped
+    back to original sample ids and padded to ``k`` with the family
+    sentinel ((+inf, -1) min-close / (-inf, -1) inner-product)."""
+    from ..distance.distance_types import DistanceType
+    from ..neighbors import brute_force
+
+    bad = (-jnp.inf if metric is DistanceType.InnerProduct else jnp.inf)
+    m = queries.shape[0]
+    n_surv = int(vecs.shape[0]) if vecs is not None else 0
+    if n_surv == 0:
+        return (jnp.full((m, k), bad, jnp.float32),
+                jnp.full((m, k), -1, jnp.int32))
+    sub = brute_force.build(vecs, metric, metric_arg)
+    kk = min(k, n_surv)
+    d, i = brute_force.search(sub, queries, kk)
+    src_j = jnp.asarray(src, jnp.int32)
+    i = jnp.where(i >= 0, src_j[jnp.maximum(i, 0)], -1)
+    return _pad_to_k(d, i, k, bad)
+
+
+def survivor_brute_ivf(index, reconstruct_fn, queries, k: int,
+                       filter):  # noqa: A002
+    """Compacted crossover for IVF families: gather the survivors'
+    stored rows (``reconstruct_fn``: physical rows → f32 vectors — exact
+    for ivf_flat, decode+back-rotate for ivf_pq) and brute-force the
+    compacted set. Survivor bits with no stored row (never-added ids)
+    are skipped — they could never be returned by any path."""
+    src = survivor_ids(filter)
+    src = src[src < int(index.size)]
+    rows = _physical_rows(index, src)
+    keep = rows >= 0
+    src, rows = src[keep], rows[keep]
+    vecs = (reconstruct_fn(index, jnp.asarray(rows, jnp.int32))
+            if rows.size else None)
+    return _brute_over(vecs, index.metric, queries, k, src)
+
+
+def survivor_brute_dense(dataset, metric, queries, k: int,
+                         filter, scales=None,  # noqa: A002
+                         metric_arg: float = 2.0):
+    """Compacted crossover for dense-storage families (cagra /
+    brute_force): row id IS the sample id, so the gather needs no
+    inverse map. ``scales`` dequantizes int8/bf16 stores on the fly."""
+    from .quant import dequantize_rows
+
+    src = survivor_ids(filter)
+    src = src[src < dataset.shape[0]]
+    if src.size == 0:
+        vecs = None
+    else:
+        rows = jnp.asarray(src, jnp.int32)
+        vecs = dequantize_rows(dataset[rows],
+                               None if scales is None else scales[rows])
+    return _brute_over(vecs, metric, queries, k, src, metric_arg)
+
+
+def tune_crossover(family: str, n: int, d: int, k: int, selectivity: float,
+                   scan_fn: Callable, brute_fn: Callable, *args,
+                   reps: int = 3):
+    """Race the widened scan vs the compacted brute under the
+    selectivity-bucketed key (both closures must take ``*args`` and
+    return device arrays); the recorded winner steers every later
+    filtered call in the same bucket. Called from ``tune_search``-style
+    warmup and the bench sweep lane — never from the hot path."""
+    from . import autotune
+
+    key = crossover_key(family, n, d, k, selectivity)
+    winner, timings = autotune.tune_best(
+        key, {"scan": scan_fn, "brute": brute_fn}, *args,
+        reps=reps, force=True, value_read=True)
+    return key, winner, timings

@@ -137,6 +137,11 @@ POLICIES: Dict[str, BreakerPolicy] = {
     # drill exercises the full breaker arc (and the heal.mttr verdict)
     # with zero recall impact
     "soak.serve": DEFAULT_POLICY,
+    # the selectivity crossover (ops/filter_policy.py): exact brute
+    # force over the compacted filter survivors; falls back to the
+    # family's own widened-scan search (bit-safe — same contract, more
+    # HBM traffic), so a gather/rebuild failure costs latency only
+    "filter.survivor_brute": DEFAULT_POLICY,
 }
 
 

@@ -82,14 +82,20 @@ def pack_pairs(probed: jax.Array, n_lists: int):
 
 
 def coarse_probe(q, centers, n_probes: int, metric: str = "l2",
-                 center_norms=None, precision: str = "highest"):
+                 center_norms=None, precision: str = "highest",
+                 survivors=None):
     """Probe selection (ivf_flat_search-inl.cuh:38 role): one GEMM over
     the centers plus a rank-k select. Scores are RANKING-ONLY (per-query
     constants dropped — ||q||² never changes which lists win), and the
     select rides matrix.select_k's AUTO engine: at (m, n_lists=1024,
     k=20) the Pallas k-pass engine measured ~6x under lax.top_k
     (scratch/exp_select_slope_r5.json), which the old fused_knn coarse
-    could not use."""
+    could not use.
+
+    ``survivors``: optional (n_lists,) per-list filter-survivor counts
+    (ops/filter_policy.py); lists with zero survivors score +inf so the
+    probe budget is spent only where a candidate can actually come from
+    (a pruned list would contribute nothing but sentinel rows)."""
     from ..matrix.select_k import select_k
 
     q = jnp.asarray(q, jnp.float32)
@@ -108,6 +114,8 @@ def coarse_probe(q, centers, n_probes: int, metric: str = "l2",
         score = -cross / jnp.sqrt(jnp.maximum(cn, 1e-30))[None, :]
     else:                                           # "l2"
         score = cn[None, :] - 2.0 * cross
+    if survivors is not None:
+        score = jnp.where(survivors[None, :] > 0, score, jnp.inf)
     return select_k(score, n_probes, select_min=True)[1]
 
 

@@ -502,7 +502,7 @@ def build_ivf_flat(dataset, mesh: Mesh,
 def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
                     params: ivf_flat.SearchParams | None = None,
                     res=None, allow_partial: bool = False,
-                    merge_engine: str | None = None):
+                    merge_engine: str | None = None, filter=None):  # noqa: A002
     """Replicated queries → per-shard local search → cross-shard merge
     (ring or allgather engine; see :func:`_merged_shard_search`).
 
@@ -514,6 +514,11 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
     ``merge_engine``: force one of ``ops.ring_topk.ENGINES`` (or
     ``"auto"``); default consults ``RAFT_TPU_SHARDED_MERGE`` and the
     autotune verdict for this shape bucket.
+    ``filter``: optional GLOBAL-id sample bitset (n_total bits); the
+    replicated mask rides into every shard's local search (shard
+    source ids are global, so the gather indexes it directly). A
+    filtered row yields the same (+inf, -1) sentinel the dead-shard
+    path emits, so the merge needs no new semantics.
     """
     sp = params or ivf_flat.SearchParams()
     q = jnp.asarray(queries, jnp.float32)
@@ -526,15 +531,18 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
     _health_gate(ok, allow_partial, "ivf_flat")
 
     has_scales = index.scales is not None
+    mask = filter.to_mask() if filter is not None else None
+    has_filter = mask is not None
 
     def local(data, norms, gids, centers, cnorms, offsets, sizes, okf, qq,
               *rest):
         args = [a[0] for a in (data, norms, gids, centers, cnorms, offsets,
                                sizes)]
         sc = rest[0][0] if has_scales else None
+        mb = rest[int(has_scales)] if has_filter else None
         d, i = ivf_flat.search_arrays(
             args[0], args[1], args[2], args[3], args[4], args[5], args[6],
-            qq, k, n_probes, max_rows, mt, scales=sc)
+            qq, k, n_probes, max_rows, mt, mask_bits=mb, scales=sc)
         # dead-shard containment: an invalid shard's list is all
         # (+inf, -1) sentinel rows, so the merge is over survivors only
         bad = jnp.inf if select_min else -jnp.inf
@@ -551,6 +559,9 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
     if has_scales:
         in_specs.append(P(AXIS, None))
         arrays.append(index.scales)
+    if has_filter:
+        in_specs.append(P())           # replicated: gids are global
+        arrays.append(mask)
     d, i = _merged_shard_search(index.mesh, "ivf_flat", local, in_specs,
                                 arrays, q.shape[0], k, select_min, comms,
                                 merge_engine,
@@ -648,11 +659,14 @@ def build_cagra(dataset, mesh: Mesh,
 def search_cagra(index: ShardedCagra, queries, k: int,
                  params: cagra.SearchParams | None = None,
                  res=None, allow_partial: bool = False,
-                 merge_engine: str | None = None):
+                 merge_engine: str | None = None, filter=None):  # noqa: A002
     """Replicated queries → per-shard graph traversal → cross-shard merge.
 
-    ``allow_partial``/``merge_engine``: contract of
-    :func:`search_ivf_flat`.
+    ``allow_partial``/``merge_engine``/``filter``: contract of
+    :func:`search_ivf_flat`. CAGRA shard rows are LOCAL (row = global id
+    - base), so each shard slices its window out of the replicated
+    global mask and folds it into the padding-row validity mask that
+    already rides ``_search_jit``'s filter slot.
     """
     sp = params or cagra.SearchParams()
     q = jnp.asarray(queries, jnp.float32)
@@ -669,12 +683,27 @@ def search_cagra(index: ShardedCagra, queries, k: int,
     _health_gate(ok, allow_partial, "cagra")
 
     has_seeds = index.seeds is not None
+    mask = None
+    if filter is not None:
+        R = int(index.data.shape[1])
+        mask = filter.to_mask()
+        # pad the global mask with False so every shard's (base, base+R)
+        # window is in range: lax.dynamic_slice CLAMPS an out-of-range
+        # start, which would silently shift the last shard's window
+        need = int(np.asarray(index.bases).max()) + R
+        if mask.shape[0] < need:
+            mask = jnp.pad(mask, (0, need - mask.shape[0]))
+    has_filter = mask is not None
 
     def local(data, graph, base, count, okf, qq, *rest):
         # padding rows (beyond this shard's real count) are masked out so
         # neither random nor covering seeding can surface them
         valid = jnp.arange(data.shape[1], dtype=jnp.int32) < count[0]
         seed_rows = rest[0][0] if has_seeds else None
+        if has_filter:
+            gm = rest[int(has_seeds)]
+            valid = valid & jax.lax.dynamic_slice(gm, (base[0],),
+                                                  (data.shape[1],))
         # gather engine explicitly: shard-local data lives only inside
         # this trace, so an edge-resident store can never be attached
         d, i = cagra._search_jit(
@@ -694,6 +723,9 @@ def search_cagra(index: ShardedCagra, queries, k: int,
     if has_seeds:
         in_specs.append(P(AXIS, None))
         arrays.append(index.seeds)
+    if has_filter:
+        in_specs.append(P())           # replicated; sliced per shard
+        arrays.append(mask)
     d, i = _merged_shard_search(index.mesh, "cagra", local, in_specs,
                                 arrays, q.shape[0], k, select_min, comms,
                                 merge_engine,
@@ -787,12 +819,13 @@ def build_ivf_pq(dataset, mesh: Mesh,
 def search_ivf_pq(index: ShardedIvfPq, queries, k: int,
                   params: ivf_pq.SearchParams | None = None,
                   res=None, allow_partial: bool = False,
-                  merge_engine: str | None = None):
+                  merge_engine: str | None = None, filter=None):  # noqa: A002
     """Replicated queries → per-shard LUT search → cross-shard merge
     (knn_merge_parts.cuh:172 role, ring or allgather engine).
 
-    ``allow_partial``/``merge_engine``: contract of
-    :func:`search_ivf_flat`.
+    ``allow_partial``/``merge_engine``/``filter``: contract of
+    :func:`search_ivf_flat` (PQ shard source ids are global, so the
+    replicated mask indexes directly).
     """
     sp = params or ivf_pq.SearchParams()
     q = jnp.asarray(queries, jnp.float32)
@@ -807,24 +840,32 @@ def search_ivf_pq(index: ShardedIvfPq, queries, k: int,
     # args, never from the Index (search() does, but we bypass it)
     dummy_off = np.zeros(index.centers_rot.shape[1] + 1, np.int64)
 
-    def local(codes, gids, centers, books, rots, offsets, sizes, okf, qq):
+    mask = filter.to_mask() if filter is not None else None
+    has_filter = mask is not None
+
+    def local(codes, gids, centers, books, rots, offsets, sizes, okf, qq,
+              *rest):
+        mb = rest[0] if has_filter else None
         shard = ivf_pq.Index(
             codes[0], gids[0], centers[0], books[0], rots[0], dummy_off,
             mt, index.pq_bits, index.codebook_kind)
         d, i = ivf_pq._search_chunk(shard, qq, k, n_probes, max_rows,
-                                    offsets[0], sizes[0], None, sp.lut_dtype)
+                                    offsets[0], sizes[0], mb, sp.lut_dtype)
         i = jnp.where(okf[0, 0], i, -1)     # dead-shard containment
         bad = jnp.inf if select_min else -jnp.inf
         d = jnp.where(i >= 0, d, bad)       # padded rows carry id -1
         return d, i
 
-    in_specs = (P(AXIS, None, None), P(AXIS, None), P(AXIS, None, None),
+    in_specs = [P(AXIS, None, None), P(AXIS, None), P(AXIS, None, None),
                 P(AXIS, *([None] * (index.codebooks.ndim - 1))),
                 P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
-                P(AXIS, None), P())
-    arrays = (index.codes, index.source_ids, index.centers_rot,
+                P(AXIS, None), P()]
+    arrays = [index.codes, index.source_ids, index.centers_rot,
               index.codebooks, index.rotations, index.offsets,
-              index.sizes, _shard_mask(index.mesh, ok), q)
+              index.sizes, _shard_mask(index.mesh, ok), q]
+    if has_filter:
+        in_specs.append(P())           # replicated: gids are global
+        arrays.append(mask)
     d, i = _merged_shard_search(index.mesh, "ivf_pq", local, in_specs,
                                 arrays, q.shape[0], k, select_min, comms,
                                 merge_engine,

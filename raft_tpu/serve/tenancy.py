@@ -153,8 +153,21 @@ def _build_searcher(index, params, opts: dict) -> Callable:
 
 
 def _params_sig(params, opts: dict) -> str:
-    """Stable cache-key component for a tenant's frozen search policy."""
-    return f"{params!r}|{sorted(opts.items())!r}"
+    """Stable cache-key component for a tenant's frozen search policy.
+
+    Opt values that are device bitsets (a per-tenant ``filter=``) sign
+    by CONTENT digest, not ``repr``: a large jnp array reprs truncated
+    ("..."), so two different filters could collide on one signature —
+    and the query cache would then serve one tenant-slice's answer to
+    another. ``Bitset.fingerprint()`` is a blake2b over the packed
+    words, so equal-content filters still share cache entries."""
+    def _sig(v):
+        if hasattr(v, "fingerprint") and hasattr(v, "n_bits"):
+            return f"bitset:{v.fingerprint()}"
+        return repr(v)
+
+    sig_opts = [(name, _sig(v)) for name, v in sorted(opts.items())]
+    return f"{params!r}|{sig_opts!r}"
 
 
 class Tenant:

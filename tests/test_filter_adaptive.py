@@ -1,0 +1,509 @@
+"""Selectivity-adaptive filtered search tests (ISSUE 18): survivor
+counting, the widen-ladder decision, the survivor-brute crossover (pinned
+bit-exact vs a filtered reference), the CAGRA filtered-seed regression,
+k > survivors sentinel parity across every family, and the filter's
+interaction with host streaming, the qcache key, sharding, and the
+serving searcher/batcher path.
+
+Ground truth is an exact NumPy oracle over the compacted survivor set
+(``filtered_ref``) — the same construction the crossover claims to be
+bit-equal to.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ann_utils import calc_recall, naive_knn
+from raft_tpu.core import events, faults
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+from raft_tpu.ops import filter_policy, guarded
+
+N, D, K = 3000, 32, 10
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(7)
+    return rng.standard_normal((N, D)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def queries():
+    rng = np.random.default_rng(8)
+    return rng.standard_normal((20, D)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def flat_index(dataset):
+    return ivf_flat.build(dataset, ivf_flat.IndexParams(n_lists=16, seed=0))
+
+
+@pytest.fixture(scope="module")
+def pq_index(dataset):
+    return ivf_pq.build(dataset, ivf_pq.IndexParams(n_lists=16, pq_dim=8,
+                                                    seed=0))
+
+
+@pytest.fixture(scope="module")
+def cagra_index(dataset):
+    return cagra.build(dataset, cagra.IndexParams(
+        intermediate_graph_degree=32, graph_degree=16, seed=0))
+
+
+def make_mask(n: int, survivors: int, seed: int = 3) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    mask = np.zeros(n, bool)
+    if survivors:
+        mask[rng.choice(n, size=survivors, replace=False)] = True
+    return mask
+
+
+def filtered_ref(dataset, queries, k, mask):
+    """Exact filtered oracle: brute force over the compacted survivors,
+    ids mapped back, padded to k with the (+inf, -1) sentinel."""
+    ids = np.nonzero(mask)[0]
+    m = queries.shape[0]
+    d = np.full((m, k), np.inf, np.float32)
+    i = np.full((m, k), -1, np.int64)
+    if ids.size:
+        kk = min(k, ids.size)
+        dd, ii = naive_knn(dataset[ids], queries, kk)
+        d[:, :kk] = dd
+        i[:, :kk] = ids[ii]
+    return d, i
+
+
+def assert_in_survivors(indices, mask):
+    i = np.asarray(indices)
+    valid = i >= 0
+    assert valid.any(), "no valid neighbors returned at all"
+    assert mask[i[valid]].all(), "returned a filtered-out id"
+
+
+class TestSurvivorCounting:
+    def test_count_by_segments_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        n_bits, rows, segs = 500, 2048, 12
+        mask = rng.random(n_bits) < 0.3
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        # ids include -1 (slack) and out-of-range rows: both count as 0
+        ids = rng.integers(-1, n_bits + 50, size=rows)
+        seg = rng.integers(0, segs, size=rows)
+        got = np.asarray(bs.count_by_segments(
+            jnp.asarray(ids, jnp.int32), jnp.asarray(seg, jnp.int32), segs))
+        want = np.zeros(segs, np.int64)
+        for i, s in zip(ids, seg):
+            if 0 <= i < n_bits and mask[i]:
+                want[s] += 1
+        np.testing.assert_array_equal(got, want)
+
+    def test_list_survivors_matches_per_list_reference(self, flat_index):
+        mask = make_mask(N, 300)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        got = np.asarray(filter_policy.list_survivors(flat_index, bs))
+        src = np.asarray(flat_index.source_ids)
+        offs = np.asarray(flat_index.list_offsets)
+        want = np.zeros(flat_index.n_lists, np.int64)
+        for j in range(flat_index.n_lists):
+            span = src[offs[j]:offs[j + 1]]
+            # capacity-slack rows carry source id -1 and never count
+            live = span[(span >= 0) & (span < N)]
+            want[j] = mask[live].sum()
+        np.testing.assert_array_equal(got, want)
+        assert got.sum() == mask.sum()
+
+    def test_fingerprint_content_equality(self):
+        mask = make_mask(100_000, 50_000, seed=1)
+        a = Bitset.from_mask(jnp.asarray(mask))
+        b = Bitset.from_mask(jnp.asarray(mask.copy()))
+        assert a.fingerprint() == b.fingerprint()
+        mask2 = mask.copy()
+        mask2[50_000] = not mask2[50_000]      # flip one mid-array bit
+        c = Bitset.from_mask(jnp.asarray(mask2))
+        assert a.fingerprint() != c.fingerprint()
+
+
+class TestDecision:
+    def test_all_pass_filter_stays_level_one(self, flat_index, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_FILTER_BRUTE_MAX", "0")
+        bs = Bitset.from_mask(jnp.ones(N, bool))
+        fd = filter_policy.decide_ivf(flat_index, bs, 4, K, "ivf_flat")
+        assert fd.level == 1 and fd.n_probes == 4
+        assert not fd.use_brute
+        assert fd.selectivity == 1.0 and fd.lists_pruned == 0
+
+    def test_mild_filter_widens_at_most_once(self, flat_index, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_FILTER_BRUTE_MAX", "0")
+        bs = Bitset.from_mask(jnp.asarray(make_mask(N, int(N * 0.9))))
+        fd = filter_policy.decide_ivf(flat_index, bs, 4, K, "ivf_flat")
+        # one doubling restores the ~10% survivor-mass shortfall; the
+        # mild end must never pay the widest rung
+        assert fd.level <= 2
+        assert not fd.use_brute
+        assert abs(fd.selectivity - 0.9) < 0.01
+
+    def test_extreme_filter_widens_and_prunes(self, flat_index, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_FILTER_BRUTE_MAX", "0")
+        bs = Bitset.from_mask(jnp.asarray(make_mask(N, 30)))
+        fd = filter_policy.decide_ivf(flat_index, bs, 2, K, "ivf_flat")
+        assert fd.level > 1, "30/3000 survivors must widen the probe set"
+        assert fd.n_probes == min(2 * fd.level, flat_index.n_lists)
+        assert fd.lists_pruned > 0, "some of 16 lists hold none of 30 ids"
+        assert fd.survivors == 30
+
+    def test_widen_cap_env(self, flat_index, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_FILTER_BRUTE_MAX", "0")
+        monkeypatch.setenv("RAFT_TPU_FILTER_WIDEN_MAX", "2")
+        bs = Bitset.from_mask(jnp.asarray(make_mask(N, 30)))
+        fd = filter_policy.decide_ivf(flat_index, bs, 2, K, "ivf_flat")
+        assert fd.level <= 2
+
+    def test_brute_threshold_env(self, flat_index):
+        # default threshold (8192) >> N: tiny survivor sets route brute
+        bs = Bitset.from_mask(jnp.asarray(make_mask(N, 30)))
+        fd = filter_policy.decide_ivf(flat_index, bs, 4, K, "ivf_flat")
+        assert fd.use_brute
+
+    def test_decide_graph_ladder(self, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_FILTER_BRUTE_MAX", "0")
+        for frac, lv in ((0.6, 1), (0.2, 2), (0.05, 4), (0.001, 8)):
+            bs = Bitset.from_mask(jnp.asarray(make_mask(N, int(N * frac))))
+            fd = filter_policy.decide_graph(bs, N, D, K)
+            assert fd.level == lv, (frac, fd.level)
+
+    def test_selectivity_bucket(self):
+        assert filter_policy.selectivity_bucket(0.0) == "none"
+        assert filter_policy.selectivity_bucket(1.0) == "e0"
+        assert filter_policy.selectivity_bucket(0.05) == "e1"
+        assert filter_policy.selectivity_bucket(1e-3) == "e3"
+        assert filter_policy.selectivity_bucket(1e-9) == "e6"
+
+    def test_traced_filtered_search_prunes_free(self, flat_index, dataset,
+                                                queries):
+        """A jitted filtered search keeps the device-side prune (no host
+        pulls) and still honors the filter."""
+        mask = make_mask(N, 1500)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        sp = ivf_flat.SearchParams(n_probes=16)
+
+        @jax.jit
+        def go(q):
+            return ivf_flat.search(flat_index, q, K, sp, filter=bs)
+
+        d, i = go(jnp.asarray(queries))
+        assert_in_survivors(i, mask)
+        want_d, want_i = filtered_ref(dataset, queries, K, mask)
+        np.testing.assert_array_equal(np.asarray(i), want_i)
+
+
+class TestCrossoverExact:
+    """The survivor-brute crossover is exact by construction: ids must be
+    bit-equal to the filtered oracle (the ISSUE 18 acceptance pin)."""
+
+    def test_ivf_flat_bit_equal(self, flat_index, dataset, queries):
+        mask = make_mask(N, 50)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        d, i = ivf_flat.search(flat_index, queries, K,
+                               ivf_flat.SearchParams(n_probes=4), filter=bs)
+        want_d, want_i = filtered_ref(dataset, queries, K, mask)
+        np.testing.assert_array_equal(np.asarray(i), want_i)
+        np.testing.assert_allclose(np.asarray(d), want_d, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_cagra_bit_equal(self, cagra_index, dataset, queries):
+        mask = make_mask(N, 50)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        d, i = cagra.search(cagra_index, queries, K,
+                            cagra.SearchParams(itopk_size=32), filter=bs)
+        _, want_i = filtered_ref(dataset, queries, K, mask)
+        np.testing.assert_array_equal(np.asarray(i), want_i)
+
+    def test_brute_force_bit_equal(self, dataset, queries):
+        mask = make_mask(N, 50)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        ix = brute_force.build(dataset)
+        d, i = brute_force.search(ix, queries, K, filter=bs)
+        _, want_i = filtered_ref(dataset, queries, K, mask)
+        np.testing.assert_array_equal(np.asarray(i), want_i)
+
+    def test_ivf_pq_in_survivors_high_recall(self, pq_index, dataset,
+                                             queries):
+        mask = make_mask(N, 50)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        d, i = ivf_pq.search(pq_index, queries, K,
+                             ivf_pq.SearchParams(n_probes=4), filter=bs)
+        assert_in_survivors(i, mask)
+        # pq decode reorders near-ties; the neighbor SET must still track
+        # the exact filtered oracle closely over 50 survivors
+        _, want_i = filtered_ref(dataset, queries, K, mask)
+        assert calc_recall(np.asarray(i), want_i) >= 0.8
+
+    def test_crossover_records_event(self, flat_index, queries):
+        bs = Bitset.from_mask(jnp.asarray(make_mask(N, 20)))
+        before = len(events.recent(kind="filter_crossover"))
+        ivf_flat.search(flat_index, queries, K,
+                        ivf_flat.SearchParams(n_probes=4), filter=bs)
+        after = events.recent(kind="filter_crossover")
+        assert len(after) > before
+        assert after[-1]["survivors"] == 20
+
+    def test_widened_path_when_disabled(self, flat_index, queries,
+                                        monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_FILTER_BRUTE_MAX", "0")
+        mask = make_mask(N, 50)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        d, i = ivf_flat.search(flat_index, queries, K,
+                               ivf_flat.SearchParams(n_probes=4), filter=bs)
+        assert_in_survivors(i, mask)
+
+    @pytest.mark.faults
+    def test_breaker_falls_back_to_widened_scan(self, flat_index, dataset,
+                                                queries):
+        """A survivor-brute failure demotes the site and serves through
+        the family's widened scan — results stay inside the survivor
+        set, nothing raises."""
+        if any(f.kind in ("kernel_compile", "kernel_fault")
+               for f in faults.active()):
+            pytest.skip("ambient kernel faults change demotion counts")
+        mask = make_mask(N, 50)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        sp = ivf_flat.SearchParams(n_probes=4)
+        guarded.reset()
+        try:
+            with faults.inject("kernel_fault", "filter.survivor_brute"):
+                d, i = ivf_flat.search(flat_index, queries, K, sp, filter=bs)
+            assert "filter.survivor_brute" in guarded.demoted_sites()
+        finally:
+            guarded.reset()
+        assert_in_survivors(i, mask)
+
+
+class TestCagraSeedRegression:
+    def test_survivor_seeding_with_tiny_survivor_set(self, cagra_index,
+                                                     dataset, queries,
+                                                     monkeypatch):
+        """Regression (ISSUE 18 S1): with 10 survivors in 3000 rows and
+        the crossover disabled, uniform-random seeds are all filtered out
+        with high probability and the old traversal returned nothing.
+        Survivor-aware seeding must still find real neighbors."""
+        monkeypatch.setenv("RAFT_TPU_FILTER_BRUTE_MAX", "0")
+        mask = make_mask(N, 10)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        d, i = cagra.search(cagra_index, queries, 5,
+                            cagra.SearchParams(itopk_size=32), filter=bs)
+        i = np.asarray(i)
+        valid = i >= 0
+        assert mask[i[valid]].all()
+        # every query must surface at least one survivor, most several
+        assert (valid.any(axis=1)).all()
+        assert valid.mean() >= 0.5
+
+
+class TestKGreaterThanSurvivors:
+    """S2: every family returns the same (+inf, -1) sentinel padding when
+    fewer than k rows survive, and the real prefix is exactly the
+    survivor set."""
+
+    @pytest.mark.parametrize("survivors", [0, 1, K - 1])
+    @pytest.mark.parametrize("family", ["brute", "ivf_flat", "ivf_pq",
+                                        "cagra"])
+    def test_sentinel_parity(self, family, survivors, dataset, queries,
+                             flat_index, pq_index, cagra_index):
+        mask = make_mask(N, survivors, seed=survivors + 5)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        q = queries[:4]
+        if family == "brute":
+            d, i = brute_force.search(brute_force.build(dataset), q, K,
+                                      filter=bs)
+        elif family == "ivf_flat":
+            d, i = ivf_flat.search(flat_index, q, K,
+                                   ivf_flat.SearchParams(n_probes=4),
+                                   filter=bs)
+        elif family == "ivf_pq":
+            d, i = ivf_pq.search(pq_index, q, K,
+                                 ivf_pq.SearchParams(n_probes=4), filter=bs)
+        else:
+            d, i = cagra.search(cagra_index, q, K,
+                                cagra.SearchParams(itopk_size=32), filter=bs)
+        d, i = np.asarray(d), np.asarray(i)
+        assert (i[:, survivors:] == -1).all()
+        assert np.isinf(d[:, survivors:]).all()
+        surv_ids = set(np.nonzero(mask)[0].tolist())
+        for row in i:
+            assert set(row[:survivors].tolist()) == surv_ids
+
+    @pytest.mark.parametrize("survivors", [0, 1, K - 1])
+    def test_mutable_tombstones(self, survivors, tmp_path):
+        from raft_tpu.neighbors import mutable
+
+        rng = np.random.default_rng(11)
+        x = rng.standard_normal((60, 8)).astype(np.float32)
+        m = mutable.create(tmp_path / "i", x)
+        keep = set(range(survivors))
+        m.delete([i for i in range(60) if i not in keep])
+        before = len(events.recent(kind="filter_crossover"))
+        d, i = m.search(x[:3], K)
+        # tombstone masks are shape-stable internal filters: the policy
+        # runs suspended, so the crossover must never fire here (it
+        # would recompile after every delete — the soak's steady-state
+        # invariant catches exactly that storm)
+        assert len(events.recent(kind="filter_crossover")) == before
+        d, i = np.asarray(d), np.asarray(i)
+        assert (i[:, survivors:] == -1).all()
+        assert np.isinf(d[:, survivors:]).all()
+        for row in i:
+            assert set(row[:survivors].tolist()) == keep
+
+
+class TestHostStreamFilter:
+    def test_host_stream_filtered_exact(self, dataset, queries):
+        """A host-streamed index keeps the classic masked path (the
+        adaptive policy is device-resident-only): with full probes the
+        filtered result is exact, and no crossover event fires."""
+        ix = ivf_flat.build(dataset, ivf_flat.IndexParams(n_lists=16,
+                                                          seed=0))
+        ivf_flat.prepare_host_stream(ix, budget_gb=80e3 / (1 << 30),
+                                     chunk_mb=0.1)
+        assert ix._host_tier is not None
+        mask = make_mask(N, 1500)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        before = len(events.recent(kind="filter_crossover"))
+        d, i = ivf_flat.search(ix, queries, K,
+                               ivf_flat.SearchParams(n_probes=16), filter=bs)
+        assert len(events.recent(kind="filter_crossover")) == before
+        _, want_i = filtered_ref(dataset, queries, K, mask)
+        np.testing.assert_array_equal(np.asarray(i), want_i)
+
+
+class TestQCacheFilterKey:
+    def test_params_sig_large_bitsets_never_collide(self):
+        """Regression: jax array reprs truncate with '...', so two large
+        bitsets differing only in the middle used to sign identically —
+        a different filter could hit another filter's cached answer."""
+        from raft_tpu.serve.tenancy import _params_sig
+
+        mask = make_mask(100_000, 50_000, seed=1)
+        mask2 = mask.copy()
+        mask2[50_000] = not mask2[50_000]
+        a = Bitset.from_mask(jnp.asarray(mask))
+        b = Bitset.from_mask(jnp.asarray(mask2))
+        same = Bitset.from_mask(jnp.asarray(mask.copy()))
+        assert _params_sig(None, {"filter": a}) != \
+            _params_sig(None, {"filter": b})
+        assert _params_sig(None, {"filter": a}) == \
+            _params_sig(None, {"filter": same})
+
+    @pytest.mark.serve
+    def test_fabric_filter_swap_never_serves_stale_hit(self, dataset):
+        """End to end: a cached unfiltered answer must never be served
+        after the tenant swaps in a filtered searcher."""
+        from raft_tpu.serve import metrics
+        from raft_tpu.serve.batcher import BucketLadder
+        from raft_tpu.serve.qcache import QueryCache
+        from raft_tpu.serve.tenancy import ServeFabric
+
+        ix = brute_force.build(dataset)
+        mask = make_mask(N, 50)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        fab = ServeFabric(D, ladder=BucketLadder((4,), (K,)),
+                          autostart=False, registry=metrics.Registry(),
+                          cache=QueryCache(capacity=8,
+                                           registry=metrics.Registry()))
+        q = dataset[:1].copy()
+        fab.add_tenant("a", index=ix)
+        r1 = fab.submit("a", q, K)
+        fab.drain_once()
+        out1 = r1.result(5.0)
+        assert fab.submit("a", q, K).done(), "warm unfiltered hit expected"
+        fab.tenant("a").swap(new_index=ix, warm=False, filter=bs)
+        r2 = fab.submit("a", q, K)
+        assert not r2.done(), "filtered tenant must not hit the stale entry"
+        fab.drain_once()
+        out2 = r2.result(5.0)
+        assert_in_survivors(out2.indices, mask)
+        assert not np.array_equal(np.asarray(out1.indices),
+                                  np.asarray(out2.indices))
+
+
+@pytest.mark.multichip
+class TestShardedFilter:
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        from jax.sharding import Mesh
+
+        return Mesh(np.array(jax.devices()[:4]), ("shard",))
+
+    @pytest.mark.slow
+    def test_sharded_ivf_flat_filtered_exact(self, mesh, dataset, queries):
+        from raft_tpu.parallel import sharded_ann
+
+        ix = sharded_ann.build_ivf_flat(
+            dataset, mesh, ivf_flat.IndexParams(n_lists=16, seed=0))
+        mask = make_mask(N, 1500)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        d, i = sharded_ann.search_ivf_flat(
+            ix, queries, k=K, params=ivf_flat.SearchParams(n_probes=16),
+            filter=bs)
+        _, want_i = filtered_ref(dataset, queries, K, mask)
+        np.testing.assert_array_equal(np.asarray(i), want_i)
+
+    @pytest.mark.slow
+    def test_sharded_ivf_pq_filtered(self, mesh, dataset, queries):
+        from raft_tpu.parallel import sharded_ann
+
+        ix = sharded_ann.build_ivf_pq(
+            dataset, mesh, ivf_pq.IndexParams(n_lists=16, pq_dim=16,
+                                              seed=0))
+        mask = make_mask(N, 1500)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        d, i = sharded_ann.search_ivf_pq(
+            ix, queries, k=K, params=ivf_pq.SearchParams(n_probes=16),
+            filter=bs)
+        assert_in_survivors(i, mask)
+        _, want_i = filtered_ref(dataset, queries, K, mask)
+        assert calc_recall(np.asarray(i), want_i) >= 0.8
+
+    @pytest.mark.slow
+    def test_sharded_cagra_filtered(self, mesh, dataset, queries):
+        from raft_tpu.parallel import sharded_ann
+
+        ix = sharded_ann.build_cagra(
+            dataset, mesh, cagra.IndexParams(intermediate_graph_degree=32,
+                                             graph_degree=16, seed=0))
+        mask = make_mask(N, 1500)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        d, i = sharded_ann.search_cagra(
+            ix, queries, k=K, params=cagra.SearchParams(itopk_size=64),
+            filter=bs)
+        assert_in_survivors(i, mask)
+        _, want_i = filtered_ref(dataset, queries, K, mask)
+        assert calc_recall(np.asarray(i), want_i) >= 0.8
+
+
+class TestSearcherFlow:
+    def test_make_searcher_filter_flows(self, flat_index, dataset, queries):
+        mask = make_mask(N, 50)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        fn = ivf_flat.make_searcher(flat_index,
+                                    ivf_flat.SearchParams(n_probes=4),
+                                    filter=bs)
+        d, i = fn(queries[:4], K)
+        _, want_i = filtered_ref(dataset, queries[:4], K, mask)
+        np.testing.assert_array_equal(np.asarray(i), want_i)
+
+    @pytest.mark.serve
+    def test_microbatcher_filtered(self, flat_index, dataset, queries):
+        from raft_tpu.serve.batcher import BucketLadder, MicroBatcher
+
+        mask = make_mask(N, 50)
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        fn = ivf_flat.make_searcher(flat_index,
+                                    ivf_flat.SearchParams(n_probes=4),
+                                    filter=bs)
+        with MicroBatcher(fn, D, ladder=BucketLadder((8,), (K,)),
+                          max_wait_s=0.001) as b:
+            out = b.submit(queries[:4], K).result(60)
+        _, want_i = filtered_ref(dataset, queries[:4], K, mask)
+        np.testing.assert_array_equal(np.asarray(out.indices), want_i)

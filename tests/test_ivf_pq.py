@@ -71,6 +71,8 @@ class TestIvfPq:
             true = ((queries[row] - dataset[i[row, 0]]) ** 2).sum()
             assert abs(d[row, 0] - true) < 1e-1
 
+    @pytest.mark.slow  # 23s single-core: variant-recall check; the
+    # PER_SUBSPACE path keeps tier-1 coverage of the shared machinery
     def test_per_cluster_codebooks(self, dataset, queries):
         index = ivf_pq.build(dataset, ivf_pq.IndexParams(
             n_lists=32, pq_dim=8, codebook_kind=ivf_pq.CodebookGen.PER_CLUSTER,
@@ -103,6 +105,9 @@ class TestIvfPq:
         _, want = naive_knn(dataset, queries, 10)
         assert calc_recall(np.asarray(idx), want) >= 0.4
 
+    @pytest.mark.slow  # 20s single-core for a relative recall-delta
+    # check between two lut_dtype rungs of the same scan (cf. the
+    # tier-1 budget note on test_int8_lut_pq_bits_4 below)
     def test_int8_lut_mode(self, dataset, queries):
         """fp8-LUT role (ivf_pq_types.hpp:110-146): the int8-quantized
         codebook scan must track the bf16 scan's recall closely."""

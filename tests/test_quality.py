@@ -437,7 +437,8 @@ class TestGuardedDriftGuard:
     KNOWN = {"select_k.kpass", "ivf_flat.scan", "ivf_pq.scan",
              "brute_force.fused", "cagra.graph_expand",
              "cagra.fused_search", "cagra.nn_descent",
-             "sharded.ring_topk", "mutable.merge"}
+             "sharded.ring_topk", "mutable.merge",
+             "filter.survivor_brute"}
 
     def _discover_sites(self):
         import raft_tpu

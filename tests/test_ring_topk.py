@@ -141,6 +141,9 @@ class TestShardedFamilies:
     demotion to allgather, make_searcher/debugz pick-up — runs as ONE
     consolidated test with the minimum number of search dispatches."""
 
+    @pytest.mark.slow  # ~30s single-core (5 eager shard_map compiles);
+    # tier-1 keeps the per-family sharded coverage in test_sharded_ann
+    # and the breaker arc drills in test_faults
     def test_ring_acceptance_flow(self, flat4):
         from raft_tpu.core import faults
         from raft_tpu.ops import guarded
