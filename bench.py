@@ -522,6 +522,136 @@ def run_storage_ladder(lad_n: int, d: int, nq: int = 1000, k: int = 10,
     return entries
 
 
+def run_fleet_ladder(n: int, d: int, nq: int = 256, k: int = 10,
+                     out_json: str = None, hosts: int = 2, devs: int = 2,
+                     hbm_budget_frac: float = 0.5) -> list:
+    """Fleet storage-ladder rung (ISSUE 19 / docs/mnmg.md "Per-host
+    storage tiers"): one virtual ``hosts × devs`` fleet, every
+    ``FLEET_STORE_RUNGS`` rung built under a per-host HBM budget of
+    ``hbm_budget_frac`` × the f32 resident rows, measured end-to-end
+    through :meth:`Fleet.search` (resident + host-streamed cold lists).
+    Each entry records rows/host, device bytes/host (budgeted AND
+    unbudgeted-resident), host-tier bytes/host, recall, and the bytes
+    ratio vs the float32 rung — the per-host capacity claims as
+    artifacts, not README math. Exact rungs (float32/int8/int4)
+    additionally assert bit-parity against their unbudgeted build: a
+    capacity number from a build that changed the answers would be
+    worthless. Run with ``d >= 64``: below that the int4 rung's 64-byte
+    sublane-pair padding (``quant.int4_half_width``) dominates and the
+    ladder is not byte-monotone."""
+    from raft_tpu.neighbors import ivf_flat, ivf_pq
+    from raft_tpu.parallel import fleet as fleet_mod
+    from raft_tpu.serve import quality as _q
+
+    fl = fleet_mod.Fleet.virtual(hosts, devs)
+    data, queries = make_corpus(n, d, nq, seed=23)
+    data = np.asarray(data, np.float32)       # host packing wants numpy
+    queries = np.asarray(queries, np.float32)
+    qj = jnp.asarray(queries)
+    gt = np.argsort(
+        (queries ** 2).sum(1)[:, None] - 2.0 * queries @ data.T
+        + (data ** 2).sum(1)[None, :], axis=1)[:, :k]
+
+    n_lists = max(8, min(256, int(np.sqrt(n))))
+    pq_dim = max(4, d // 4)
+    # pq_bits=4: the edge-store books (16 entries/subspace). At bench
+    # corpus sizes an 8-bit book is a ~400 KB fixed cost that swamps the
+    # codes and would make the per-host capacity ratio measure the
+    # quantizer, not the ladder; at fleet corpus sizes it amortizes away.
+    p0 = ivf_pq.IndexParams(n_lists=n_lists, pq_dim=pq_dim, pq_bits=4,
+                            seed=0)
+    n_probes = max(4, n_lists // 8)
+    rows_host = -(-n // hosts)
+    budget_b = int(rows_host * fleet_mod.store_row_bytes("float32", d)
+                   * hbm_budget_frac)
+
+    def host_recall(ids):
+        ids = np.asarray(ids)
+        return float(np.mean([len(set(ids[m]) & set(gt[m])) / k
+                              for m in range(nq)]))
+
+    def per_host_bytes(idx):
+        rep = _q.device_bytes(idx)
+        return (int(rep["total_device_bytes"]) // fl.n_shards
+                * fl.topology.devs_per_host)
+
+    entries = []
+    f32_bytes_host = f32_resident_host = None
+    for rung in fleet_mod.FLEET_STORE_RUNGS:
+        sp = (ivf_pq.SearchParams(n_probes=n_probes) if rung == "pq"
+              else ivf_flat.SearchParams(n_probes=n_probes))
+        idx0 = robust_call(lambda r=rung: fl.build_ivf_pq(
+            data, p0, store_dtype=r), f"fleet ladder {rung} build",
+            tries=1)
+        d0, i0, _ = fl.search(idx0, qj, k, sp)
+        bytes0_host = per_host_bytes(idx0)
+        idx = robust_call(lambda r=rung: fl.build_ivf_pq(
+            data, p0, store_dtype=r, hbm_budget_gb=budget_b / (1 << 30),
+            sample_queries=queries), f"fleet ladder {rung} budgeted",
+            tries=1)
+        d1, i1, _ = fl.search(idx, qj, k, sp)
+        if rung != "pq":
+            assert (np.array_equal(np.asarray(d0), np.asarray(d1))
+                    and np.array_equal(np.asarray(i0), np.asarray(i1))), \
+                f"budgeted {rung} diverged from unbudgeted build"
+        thr = median_time(lambda: jax.block_until_ready(
+            fl.search(idx, qj, k, sp)[0]), reps=3)
+        bytes_host = per_host_bytes(idx)
+        tier_host = max(
+            (sum(int(idx._fleet_tiers[s].host_bytes)
+                 for s in fl.topology.shards_of(h)
+                 if s in idx._fleet_tiers) for h in range(hosts)),
+            default=0)
+        cold = {h: int((~m).sum())
+                for h, m in idx._fleet_ctx["hot"].items()}
+        if rung == "float32":
+            f32_bytes_host = bytes_host
+            f32_resident_host = bytes0_host
+        e = {"algo": "fleet_ladder",
+             "name": f"fleet_ladder.{hosts}x{devs}.{rung}",
+             "qps": round(nq / thr, 1) if thr else None,
+             "latency_ms": None,
+             "recall": round(host_recall(i1), 4),
+             "recall_unbudgeted": round(host_recall(i0), 4),
+             "build_s": 0.0, "corpus_n": n,
+             "store": rung, "topology": f"{hosts}x{devs}",
+             "rows_per_host": rows_host,
+             "device_bytes_per_host": bytes_host,
+             "device_bytes_per_host_unbudgeted": bytes0_host,
+             "host_tier_bytes_per_host": tier_host,
+             "bytes_per_vector": round(bytes_host / rows_host, 2),
+             "hbm_budget_bytes_per_host": budget_b,
+             "cold_lists_per_host": cold,
+             "bitwise_vs_unbudgeted": rung != "pq"}
+        if f32_bytes_host:
+            e["bytes_vs_float32"] = round(
+                bytes_host / max(f32_bytes_host, 1), 4)
+            # the ISSUE acceptance ratio: budgeted bytes vs the FULLY
+            # RESIDENT f32 build (what an unladdered fleet would hold)
+            e["bytes_vs_float32_resident"] = round(
+                bytes_host / max(f32_resident_host, 1), 4)
+        entries.append(e)
+        log(f"#   {e['name']}: qps={e['qps']} recall={e['recall']} "
+            f"bytes/host {bytes_host:,} "
+            f"({e.get('bytes_vs_float32', 1.0)}x of f32) "
+            f"cold={cold}")
+
+    if out_json:
+        payload = {"schema": "raft_tpu_bench_v1", "lane": "fleet_ladder",
+                   "n": n, "d": d, "topology": f"{hosts}x{devs}",
+                   "hbm_budget_bytes_per_host": budget_b,
+                   "entries": entries}
+        os.makedirs(os.path.dirname(out_json) or ".", exist_ok=True)
+        tmp = out_json + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, out_json)
+        log(f"# fleet ladder artifact -> {out_json}")
+    return entries
+
+
 def run_filter_sweep(n: int, d: int, nq: int = 100, k: int = 10,
                      out_json: str = None) -> list:
     """Filtered-search selectivity sweep (docs/perf.md "Filtered
@@ -1873,6 +2003,27 @@ def main():
         lad_n = int(os.environ.get("RAFT_TPU_BENCH_LADDER_N",
                                    str(10_000_000)))
         entries.extend(run_storage_ladder(lad_n, d, nq=1000, k=k))
+
+    # --- fleet storage ladder (per-host HBM-budget tiers) ---------------
+    # Every FLEET_STORE_RUNGS rung on a virtual 2x2 fleet under a
+    # per-host budget (docs/mnmg.md "Per-host storage tiers").
+    # RAFT_TPU_BENCH_FLEET_LADDER=1 runs it (default: skip — an
+    # on-demand lane; scratch/check_bench_artifact.py validates it).
+    with algo_section('fleet_ladder'):
+        from raft_tpu.core.errors import expects as _expects
+        _expects(os.environ.get("RAFT_TPU_BENCH_FLEET_LADDER") == "1",
+                 "fleet ladder skip (set RAFT_TPU_BENCH_FLEET_LADDER=1 "
+                 "to run)")
+        _expects(len(jax.devices()) >= 4,
+                 "fleet ladder skip: %d devices < 4 (CPU runs need "
+                 "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+                 len(jax.devices()))
+        fn_n = int(os.environ.get("RAFT_TPU_BENCH_FLEET_LADDER_N",
+                                  "8192"))
+        entries.extend(run_fleet_ladder(
+            fn_n, d, nq=256, k=k,
+            out_json=os.path.join("artifacts",
+                                  "bench_fleet_ladder.json")))
 
     # --- filtered-search selectivity sweep ------------------------------
     # Adaptive vs fixed filter policy across filtered-out fractions

@@ -52,7 +52,10 @@ and the multi-host fleet set (docs/mnmg.md, parallel/fleet.py):
 rejoined the serving set — the host-granular transition above the
 per-shard ``shard_marked``/``shard_restored`` pair, carrying the
 per-host health map), ``fleet_build`` (one distributed IVF-PQ build
-completed, with topology and wire-shape stats).
+completed, with topology and wire-shape stats), ``host_tier_armed``
+(a beyond-HBM budget actually armed a host tier — one per distinct
+budget value), ``fleet_tier_step`` (a host stepped down or back up the
+per-host budget ladder: the MEMORY degrade axis of ROADMAP item 3).
 
 Details are scrubbed JSON-safe at record time: non-finite floats become
 None, numpy scalars/arrays become python values/lists (large arrays a
@@ -102,6 +105,12 @@ WELL_KNOWN_KINDS = frozenset({
     "hook_error", "soak_phase",
     # multi-host fleet (docs/mnmg.md)
     "host_lost", "host_restored", "fleet_build",
+    # per-host storage tiers (docs/mnmg.md "Per-host storage tiers"):
+    # ``host_tier_armed`` — a beyond-HBM budget became live (one per
+    # distinct value, so debugz shows whether a tier is armed at all);
+    # ``fleet_tier_step`` — a host stepped down/up the budget ladder
+    # (the MEMORY degrade axis), with levels and effective budget
+    "host_tier_armed", "fleet_tier_step",
     # selectivity-adaptive filtered search (docs/perf.md "Filtered
     # search"): a search routed to the compacted survivor-brute path
     "filter_crossover",

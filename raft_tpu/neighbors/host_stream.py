@@ -42,22 +42,63 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import warnings
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import env_float
+
 __all__ = ["HostTier", "budget_bytes", "plan_hot_cold", "build_tier",
            "probe_frequency"]
+
+# budgets that already flight-recorded a ``host_tier_armed`` activation
+# (one event per distinct armed value, not one per budget_bytes() call —
+# the planner re-reads the budget on every re-plan)
+_armed_seen: set = set()
 
 
 def budget_bytes(budget_gb: Optional[float] = None) -> int:
     """HBM budget for one index's list data: the explicit argument, else
-    ``RAFT_TPU_HBM_BUDGET_GB``, else 0 (no budget → no host tier)."""
+    ``RAFT_TPU_HBM_BUDGET_GB``, else 0 (no budget → no host tier).
+
+    A malformed env value is a LOUD no-op: it parses through
+    :func:`raft_tpu.utils.env_float` (the never-crash operator-knob
+    contract) but emits a ``RuntimeWarning`` instead of silently
+    disabling the budget — an over-HBM index with a typo'd budget would
+    otherwise OOM in prod with the operator convinced a tier was armed.
+    Any budget that actually arms a tier (> 0) flight-records one
+    ``host_tier_armed`` event per distinct value, so debugz shows
+    whether the ladder's beyond-HBM rung is live."""
+    source = "arg"
     if budget_gb is None:
-        budget_gb = float(os.environ.get("RAFT_TPU_HBM_BUDGET_GB", "0"))
-    return int(float(budget_gb) * (1 << 30))
+        raw = os.environ.get("RAFT_TPU_HBM_BUDGET_GB", "")
+        if not raw:
+            return 0
+        source = "env"
+        try:
+            float(raw)
+        except ValueError:
+            warnings.warn(
+                f"malformed RAFT_TPU_HBM_BUDGET_GB={raw!r} (not a float): "
+                "HBM budget DISABLED, no host tier will be armed",
+                RuntimeWarning, stacklevel=2)
+            return 0
+        budget_gb = env_float("RAFT_TPU_HBM_BUDGET_GB", 0.0)
+    b = int(float(budget_gb) * (1 << 30))
+    if b > 0 and b not in _armed_seen:
+        _armed_seen.add(b)
+        try:
+            from ..core import events
+
+            events.record("host_tier_armed", "host_stream.budget",
+                          budget_gb=float(budget_gb), budget_bytes=b,
+                          source=source)
+        except Exception:  # noqa: BLE001 - telemetry must not fail a plan
+            pass
+    return b
 
 
 def probe_frequency(probed: np.ndarray, n_lists: int) -> np.ndarray:

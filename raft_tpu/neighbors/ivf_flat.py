@@ -510,11 +510,14 @@ def search(
 
 def search_arrays(data, data_norms, source_ids, centers, center_norms,
                   offsets_j, sizes_j, qc, k, n_probes, max_rows, mt,
-                  mask_bits=None, scales=None, survivors=None):
+                  mask_bits=None, scales=None, survivors=None,
+                  int4_dim=None):
     """Pure-array IVF-Flat search core — everything traced, so it runs under
     jit, vmap and shard_map alike (the multi-chip path stacks per-shard
     arrays and calls this per shard). ``data`` may be stored low-precision
-    (bf16/int8 + per-row ``scales``); gathers dequantize on the fly."""
+    (bf16/int8 + per-row ``scales``, or nibble-packed int4 when
+    ``int4_dim`` names the logical width); gathers dequantize on the
+    fly."""
     from .brute_force import dequantize_rows
 
     from ..ops.ivf_scan import coarse_probe
@@ -529,8 +532,13 @@ def search_arrays(data, data_norms, source_ids, centers, center_norms,
 
     # stage 2: gather candidates and score (the fused-scan analog)
     rows, valid, _ = _candidate_rows(probed, offsets_j, sizes_j, max_rows)
-    cand = dequantize_rows(data[rows],
-                           None if scales is None else scales[rows])
+    if int4_dim is not None:
+        from ..ops.quant import dequantize_int4
+
+        cand = dequantize_int4(data[rows], scales[rows], int4_dim)
+    else:
+        cand = dequantize_rows(data[rows],
+                               None if scales is None else scales[rows])
     if mt is DistanceType.InnerProduct:
         dist = jnp.einsum("msd,md->ms", cand, qc, precision="highest")
     elif mt is DistanceType.CosineExpanded:
@@ -575,7 +583,7 @@ _hot_local = __import__("threading").local()   # re-entry guard: the hot
 
 def prepare_host_stream(index: Index, budget_gb: Optional[float] = None,
                         sample_queries=None, n_probes: int = 20,
-                        chunk_mb: int = 64) -> None:
+                        chunk_mb: int = 64, hot_mask=None) -> None:
     """Move cold lists past the HBM budget into a host-RAM tier
     (docs/perf.md "Storage ladder", the beyond-HBM rung): the device
     keeps the hottest lists — ranked by measured probe frequency over
@@ -589,6 +597,11 @@ def prepare_host_stream(index: Index, budget_gb: Optional[float] = None,
     Mutates the index in place (resident arrays shrink to the hot
     lists); ``index._host_tier`` carries the cold chunks and stats.
     Host-streamed search is EAGER-only — serving dispatch already is.
+
+    ``hot_mask`` (bool, ``(n_lists,)``) bypasses the local budget plan
+    with an externally-planned hot set — the fleet layer plans
+    hot/cold ONCE from fleet-wide probe counts and hands each shard its
+    slice, so per-shard planners never disagree about what is hot.
     """
     from ..ops.ivf_scan import scan_window
     from ..utils import round_up_to
@@ -596,28 +609,35 @@ def prepare_host_stream(index: Index, budget_gb: Optional[float] = None,
 
     if getattr(index, "_host_tier", None) is not None:
         return
-    budget = hs.budget_bytes(budget_gb)
-    expects(budget > 0, "prepare_host_stream needs budget_gb or "
-            "RAFT_TPU_HBM_BUDGET_GB")
     sizes = index.list_sizes
     itemsize = jnp.dtype(index.data.dtype).itemsize
     row_bytes = (index.dim * itemsize + 8
                  + (4 if index.scales is not None else 0))
-    if int(sizes.sum()) * row_bytes <= budget:
-        return   # everything fits: stay fully resident
-    freq = None
-    if sample_queries is not None:
-        from ..ops.ivf_scan import coarse_probe
+    if hot_mask is not None:
+        hot = np.asarray(hot_mask, bool)
+        expects(hot.shape == (index.n_lists,),
+                f"hot_mask shape {hot.shape} != ({index.n_lists},)")
+        if bool(hot.all()):
+            return   # externally planned: everything stays resident
+    else:
+        budget = hs.budget_bytes(budget_gb)
+        expects(budget > 0, "prepare_host_stream needs budget_gb or "
+                "RAFT_TPU_HBM_BUDGET_GB")
+        if int(sizes.sum()) * row_bytes <= budget:
+            return   # everything fits: stay fully resident
+        freq = None
+        if sample_queries is not None:
+            from ..ops.ivf_scan import coarse_probe
 
-        cmetric = ("ip" if index.metric is DistanceType.InnerProduct
-                   else "cos" if index.metric is DistanceType.CosineExpanded
-                   else "l2")
-        probed = np.asarray(coarse_probe(
-            jnp.asarray(sample_queries, jnp.float32), index.centers,
-            min(n_probes, index.n_lists), metric=cmetric,
-            center_norms=index.center_norms))
-        freq = hs.probe_frequency(probed, index.n_lists)
-    hot = hs.plan_hot_cold(sizes, row_bytes, budget, freq)
+            cmetric = ("ip" if index.metric is DistanceType.InnerProduct
+                       else "cos" if index.metric is DistanceType.CosineExpanded
+                       else "l2")
+            probed = np.asarray(coarse_probe(
+                jnp.asarray(sample_queries, jnp.float32), index.centers,
+                min(n_probes, index.n_lists), metric=cmetric,
+                center_norms=index.center_norms))
+            freq = hs.probe_frequency(probed, index.n_lists)
+        hot = hs.plan_hot_cold(sizes, row_bytes, budget, freq)
 
     dim_pad = round_up_to(index.dim, 128)
     # cold chunks carry their rows SCAN-READY: dim padded to the lane
@@ -663,6 +683,9 @@ class _ColdScanArgs:
     lmax: int
     metric: str
     precision: str
+    # logical row width when the chunk's rows are nibble-packed int4
+    # (fleet quant-ladder tiers); None for f32/bf16/int8 storage
+    int4_dim: Optional[int] = None
 
 
 def _cold_chunk_scan_flat(index, dev, probed_local, qc, args, mask_bits):
@@ -699,8 +722,13 @@ def _cold_chunk_xla_flat(index, dev, probed_local, qc, args, mask_bits):
     from .brute_force import dequantize_rows
 
     sc = dev.get("scales")
-    cand = dequantize_rows(dev["data"][rows],
-                           None if sc is None else sc[rows])[..., :index.dim]
+    if args.int4_dim is not None:
+        from ..ops.quant import dequantize_int4
+
+        cand = dequantize_int4(dev["data"][rows], sc[rows], args.int4_dim)
+    else:
+        cand = dequantize_rows(dev["data"][rows],
+                               None if sc is None else sc[rows])[..., :index.dim]
     mt = index.metric
     ip = jnp.einsum("msd,md->ms", cand, qc, precision="highest")
     if mt is DistanceType.InnerProduct:

@@ -809,7 +809,7 @@ _hot_local = __import__("threading").local()   # re-entry guard (the hot
 
 def prepare_host_stream(index: Index, budget_gb: Optional[float] = None,
                         sample_queries=None, n_probes: int = 20,
-                        chunk_mb: float = 64) -> None:
+                        chunk_mb: float = 64, hot_mask=None) -> None:
     """Move cold PQ lists past the HBM budget into a host-RAM tier —
     same contract as :func:`ivf_flat.prepare_host_stream` (probe-
     frequency pinning, fixed-shape double-buffered chunks, eager-only
@@ -817,7 +817,11 @@ def prepare_host_stream(index: Index, budget_gb: Optional[float] = None,
     16-32x smaller than raw rows, so this rung matters for indexes whose
     *code* store outgrows HBM (the DEEP-1B shape) or that share a device
     with raw-row indexes. Chunk rows carry codes (scan-padded), decoded
-    row norms, source ids and the row's chunk-local list label."""
+    row norms, source ids and the row's chunk-local list label.
+
+    ``hot_mask`` (bool, ``(n_lists,)``) bypasses the local budget plan
+    with an externally-planned hot set — same contract as the ivf_flat
+    variant (the fleet layer plans once, fleet-wide)."""
     from ..ops.ivf_pq_scan import decoded_row_norms
     from ..ops.ivf_scan import scan_window
     from ..utils import round_up_to
@@ -825,25 +829,32 @@ def prepare_host_stream(index: Index, budget_gb: Optional[float] = None,
 
     if getattr(index, "_host_tier", None) is not None:
         return
-    budget = hs.budget_bytes(budget_gb)
-    expects(budget > 0, "prepare_host_stream needs budget_gb or "
-            "RAFT_TPU_HBM_BUDGET_GB")
     sizes = index.list_sizes
     row_bytes = index.pq_dim + 12
-    if int(sizes.sum()) * row_bytes <= budget:
-        return
-    freq = None
-    if sample_queries is not None:
-        from ..ops.ivf_scan import coarse_probe
+    if hot_mask is not None:
+        hot = np.asarray(hot_mask, bool)
+        expects(hot.shape == (index.n_lists,),
+                f"hot_mask shape {hot.shape} != ({index.n_lists},)")
+        if bool(hot.all()):
+            return   # externally planned: everything stays resident
+    else:
+        budget = hs.budget_bytes(budget_gb)
+        expects(budget > 0, "prepare_host_stream needs budget_gb or "
+                "RAFT_TPU_HBM_BUDGET_GB")
+        if int(sizes.sum()) * row_bytes <= budget:
+            return
+        freq = None
+        if sample_queries is not None:
+            from ..ops.ivf_scan import coarse_probe
 
-        q_rot = hdot(jnp.asarray(sample_queries, jnp.float32),
-                     index.rotation.T)
-        probed = np.asarray(coarse_probe(
-            q_rot, index.centers_rot, min(n_probes, index.n_lists),
-            metric="ip" if index.metric is DistanceType.InnerProduct
-            else "l2"))
-        freq = hs.probe_frequency(probed, index.n_lists)
-    hot = hs.plan_hot_cold(sizes, row_bytes, budget, freq)
+            q_rot = hdot(jnp.asarray(sample_queries, jnp.float32),
+                         index.rotation.T)
+            probed = np.asarray(coarse_probe(
+                q_rot, index.centers_rot, min(n_probes, index.n_lists),
+                metric="ip" if index.metric is DistanceType.InnerProduct
+                else "l2"))
+            freq = hs.probe_frequency(probed, index.n_lists)
+        hot = hs.plan_hot_cold(sizes, row_bytes, budget, freq)
 
     rn = decoded_row_norms(index.codes, index.centers_rot,
                            index.codebooks, index.list_offsets)

@@ -44,11 +44,33 @@ module composes the existing single-mesh machinery into exactly that:
   the dead shards and emits ``host_restored`` when a host's full ICI
   clique is healthy again. Per-host health is one
   :meth:`host_health` call and a ``fleet`` debugz section.
+
+* **Per-host storage tiers** (docs/mnmg.md "Per-host storage tiers"):
+  :meth:`Fleet.build_ivf_pq` composes the single-host storage ladder
+  with the fleet — ``store_dtype`` picks the rung each host's lists are
+  stored at (``"pq"`` today's compressed build; ``"float32"`` /
+  ``"int8"`` / ``"int4"`` flat rungs packed host-local, codes never
+  crossing DCN), and ``hbm_budget_gb`` pins each host's resident set
+  under a per-host HBM budget: hot lists stay device-resident, cold
+  lists stream through :mod:`raft_tpu.neighbors.host_stream` chunks.
+  Hot/cold is planned ONCE, fleet-wide, from per-list probe counts —
+  only the ``(n_lists,)`` int count tables cross DCN
+  (``process_allgather``), never rows. Budget *enforcement* is
+  :class:`FleetTierController`: a host measured over budget (the memz
+  decomposition aggregated per host in :meth:`Fleet.host_memz`) steps
+  DOWN the ladder — resident set re-planned at half the budget, more
+  lists streamed — instead of OOMing (``fleet_tier_step`` event,
+  recovery on sustained headroom; the MEMORY degrade axis of ROADMAP
+  item 3, reusing the brownout state machine). Every step re-packs the
+  stepping host's shards into the EXISTING stacked shapes, so serving
+  sees new values in the same compiled executables: zero recompiles,
+  zero stranded work.
 """
 from __future__ import annotations
 
 import dataclasses
 import time
+import types
 import weakref
 from typing import Optional
 
@@ -59,17 +81,49 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..comms import AxisComms
 from ..core.errors import expects
-from ..distance.distance_types import canonical_metric
-from ..neighbors import ivf_pq
+from ..distance.distance_types import (DistanceType, canonical_metric,
+                                       is_min_close)
+from ..neighbors import host_stream as hs
+from ..neighbors import ivf_flat, ivf_pq
 from ..utils import cdiv, hdot, shard_map_compat
 from . import sharded_ann
-from .sharded_ann import ShardedIvfPq
+from .sharded_ann import ShardedIvfFlat, ShardedIvfPq
 from .topology import AXIS, Topology, detect, fleet_mesh, plan_merge, virtual
 
-__all__ = ["Fleet", "FleetBuildParams", "ops_snapshot"]
+__all__ = ["Fleet", "FleetBuildParams", "FleetTierController",
+           "FLEET_STORE_RUNGS", "store_row_bytes", "ops_snapshot"]
+
+# the storage-ladder rungs a fleet build can land on, cheapest-recall
+# first (bench lane + docs order): full-precision flat, int8 flat,
+# nibble-packed int4 flat, PQ codes
+FLEET_STORE_RUNGS = ("float32", "int8", "int4", "pq")
 
 # live fleets (weak — dropping a fleet must not leak it through debugz)
 _FLEETS = weakref.WeakSet()
+
+
+def store_row_bytes(store: str, dim: int, pq_dim: Optional[int] = None
+                    ) -> int:
+    """Resident bytes one stored row costs at a ladder rung — row data
+    plus its per-row norms (4)/ids (4)/scales (4 where quantized). This
+    is the number ``plan_hot_cold`` budgets with and ``plan_merge``'s
+    storage block reports, so budget math in docs, bench, and the
+    planner can never drift apart."""
+    from ..ops.quant import int4_half_width
+
+    if store == "pq":
+        expects(pq_dim is not None and pq_dim > 0,
+                "pq rung needs pq_dim for row-byte math")
+        return int(pq_dim) + 12          # codes + norms + ids (tier rows
+        #                                  carry decoded norms)
+    if store == "float32":
+        return dim * 4 + 8
+    if store == "int8":
+        return dim + 12
+    if store == "int4":
+        return int4_half_width(dim) + 12
+    raise ValueError(f"unknown store rung {store!r}; "
+                     f"expected one of {FLEET_STORE_RUNGS}")
 
 
 @dataclasses.dataclass
@@ -274,8 +328,10 @@ class Fleet:
     # -- distributed build -------------------------------------------------
     def build_ivf_pq(self, dataset,
                      params: ivf_pq.IndexParams | None = None,
-                     build_params: FleetBuildParams | None = None
-                     ) -> ShardedIvfPq:
+                     build_params: FleetBuildParams | None = None, *,
+                     store_dtype: str = "pq",
+                     hbm_budget_gb: Optional[float] = None,
+                     sample_queries=None, chunk_mb: float = 4.0):
         """Distributed IVF-PQ build (module docstring): one allreduced
         coarse quantizer, broadcast codebooks, host-local list packing.
 
@@ -286,9 +342,31 @@ class Fleet:
         :class:`~raft_tpu.parallel.sharded_ann.ShardedIvfPq` whose
         searches resolve the topology-aware merge. PER_SUBSPACE
         codebooks only (PER_CLUSTER's trainer is host-driven and cannot
-        run SPMD)."""
+        run SPMD).
+
+        ``store_dtype`` picks the storage rung (``FLEET_STORE_RUNGS``):
+        the default ``"pq"`` is today's compressed build, byte-for-byte;
+        ``"float32"``/``"int8"``/``"int4"`` store each host's lists as
+        flat rows at that rung (the PR 13 ladder pushed through
+        ``parallel/``), returning a
+        :class:`~raft_tpu.parallel.sharded_ann.ShardedIvfFlat` over the
+        SAME shared coarse quantizer. The rung is fleet-wide (one stacked
+        dtype per index); per-host enforcement happens by shrinking the
+        resident set, not by mixing dtypes.
+
+        ``hbm_budget_gb`` (per HOST; ``RAFT_TPU_HBM_BUDGET_GB`` when
+        None) arms the beyond-HBM rung: hot lists — planned fleet-wide
+        from probe counts over ``sample_queries`` (list sizes standing
+        in without a sample) — stay resident, cold lists stream from
+        host RAM in ``chunk_mb`` chunks at search time, scored by the
+        same XLA math as the resident path. Exact rungs stay BITWISE
+        equal to the unbudgeted build's results (same probed lists, same
+        per-candidate dot products — batch composition cancels out of
+        both)."""
         p0 = params or ivf_pq.IndexParams()
         bp = build_params or FleetBuildParams()
+        expects(store_dtype in FLEET_STORE_RUNGS,
+                "store_dtype %r not in %s", store_dtype, FLEET_STORE_RUNGS)
         expects(p0.codebook_kind is ivf_pq.CodebookGen.PER_SUBSPACE,
                 "fleet build supports PER_SUBSPACE codebooks only")
         mt = canonical_metric(p0.metric)
@@ -303,6 +381,7 @@ class Fleet:
         pq_len = cdiv(dim, pq_dim)
         rot_dim = pq_dim * pq_len
         book_size = 1 << p0.pq_bits
+        budget = hs.budget_bytes(hbm_budget_gb)
         t0 = time.perf_counter()
 
         parts = sharded_ann._split_rows(n, p)
@@ -322,12 +401,32 @@ class Fleet:
         rotation = np.asarray(ivf_pq.make_rotation_matrix(
             k_rot, rot_dim, dim, p0.force_random_rotation))
 
-        centers_rot, books = self._train(samples, rotation, L, pq_dim,
-                                         pq_len, book_size, p0, bp, k_book)
-
-        index = self._pack(dataset, parts, centers_rot, books, rotation,
-                           mt, p0, pq_dim)
+        if store_dtype == "pq":
+            centers_rot, books = self._train(samples, rotation, L, pq_dim,
+                                             pq_len, book_size, p0, bp,
+                                             k_book)
+            index, ctx = self._pack(dataset, parts, centers_rot, books,
+                                    rotation, mt, p0, pq_dim,
+                                    keep_host=budget > 0)
+        else:
+            # flat rungs share the SAME trainer program plus one extra
+            # traced output (the input-space centers the flat searches
+            # probe against); the pq path's program is untouched
+            centers_rot, books, centers = self._train(
+                samples, rotation, L, pq_dim, pq_len, book_size, p0, bp,
+                k_book, want_centers=True)
+            index, ctx = self._pack_flat(dataset, parts, centers, mt,
+                                         store_dtype,
+                                         keep_host=budget > 0)
         self.adopt(index)
+        if budget > 0:
+            ctx.update(store=store_dtype, dim=dim, metric=mt,
+                       pq_dim=pq_dim if store_dtype == "pq" else None,
+                       rotation=rotation if store_dtype == "pq" else None,
+                       books=books if store_dtype == "pq" else None,
+                       centers_rot=(centers_rot if store_dtype == "pq"
+                                    else None))
+            self._plan_budget(index, ctx, budget, sample_queries, chunk_mb)
         try:
             from ..core import events as _events
 
@@ -335,14 +434,16 @@ class Fleet:
                 "fleet_build", "fleet.build_ivf_pq",
                 topology=f"{self.n_hosts}x{self.topology.devs_per_host}",
                 n=n, dim=dim, n_lists=L, pq_dim=pq_dim, pq_bits=p0.pq_bits,
-                sample_rows_per_shard=t,
+                sample_rows_per_shard=t, store=store_dtype,
+                hbm_budget_bytes=int(budget),
                 wall_s=round(time.perf_counter() - t0, 3))
         except Exception:  # noqa: BLE001
             pass
         return index
 
     def _train(self, samples: np.ndarray, rotation: np.ndarray, L: int,
-               pq_dim: int, pq_len: int, book_size: int, p0, bp, k_book):
+               pq_dim: int, pq_len: int, book_size: int, p0, bp, k_book,
+               want_centers: bool = False):
         """The SPMD trainer: one shard_map program over the fleet mesh.
 
         Determinism contract: the cross-fleet allreduce is an allgather
@@ -352,7 +453,12 @@ class Fleet:
         the same topology produce bitwise-equal centers. ``psum`` would
         be the hardware-efficient choice on a pod, at the cost of this
         guarantee. Codebooks are shard 0's, broadcast (masked psum:
-        ``x + 0`` — exact)."""
+        ``x + 0`` — exact).
+
+        ``want_centers=True`` (the flat storage rungs) additionally
+        returns the INPUT-space centers — a python-level flag, so the
+        default traced program (the pq path, whose bitwise dryrun digest
+        is pinned) is byte-identical to before."""
         p = self.n_shards
         t, dim = samples.shape[1:]
         iters = max(1, int(p0.kmeans_n_iters))
@@ -416,24 +522,36 @@ class Fleet:
                                    (1, 0, 2))
             books = ivf_pq._train_per_subspace(slices, book_size, iters,
                                                k_book)
+            if want_centers:
+                return c_rot, comms.bcast(books, root=0), centers
             return c_rot, comms.bcast(books, root=0)
 
+        out_specs = (P(), P(), P()) if want_centers else (P(), P())
         prog = jax.jit(shard_map_compat(
             body, mesh=self.mesh, in_specs=(P(AXIS, None, None), P()),
-            out_specs=(P(), P()), check=False))
+            out_specs=out_specs, check=False))
         smp = _fleet_put(self.mesh, self.topology, samples,
                          P(AXIS, None, None))
+        if want_centers:
+            c_rot, books, centers = prog(smp, jnp.asarray(rotation))
+            return (np.asarray(c_rot), np.asarray(books),
+                    np.asarray(centers))
         c_rot, books = prog(smp, jnp.asarray(rotation))
         return np.asarray(c_rot), np.asarray(books)
 
     def _pack(self, dataset, parts, centers_rot, books, rotation, mt, p0,
-              pq_dim) -> ShardedIvfPq:
+              pq_dim, keep_host: bool = False):
         """Host-local list packing: each process assigns/encodes/sorts
         ONLY its own hosts' row blocks against the replicated quantizer,
         then the (p, ...)-stacked device arrays are assembled from
         process-local slabs (:func:`_fleet_put`). The tiny per-shard
         list-size tables — the only cross-host metadata — travel via
-        ``process_allgather``."""
+        ``process_allgather``.
+
+        Returns ``(index, ctx)``; with ``keep_host=True`` (a budgeted
+        build) ``ctx`` keeps each LOCAL shard's cluster-sorted host
+        arrays plus the full size/offset tables so the tier planner can
+        (re)split hot/cold without fetching device arrays."""
         topo = self.topology
         p = self.n_shards
         L = centers_rot.shape[0]
@@ -460,6 +578,7 @@ class Fleet:
         codes = np.zeros((p, R, pq_dim), np.uint8)
         gids = np.full((p, R), -1, np.int32)
         sizes = np.zeros((p, L), np.int32)
+        host_arrays: dict = {}
         for s in my_shards:
             rows = parts[s]
             lb, cd = assign_encode(jnp.asarray(dataset[rows], jnp.float32))
@@ -468,6 +587,11 @@ class Fleet:
             codes[s, : len(rows)] = cd[order]
             gids[s, : len(rows)] = rows[order]        # GLOBAL row ids
             sizes[s] = np.bincount(lb, minlength=L)
+            if keep_host:
+                host_arrays[s] = {
+                    "codes": codes[s, : len(rows)].copy(),
+                    "ids": gids[s, : len(rows)].copy(),
+                }
         if multi:
             from jax.experimental import multihost_utils
 
@@ -493,11 +617,355 @@ class Fleet:
             put(sizes, P(AXIS, None)),
             len(dataset), mt, p0.pq_bits, p0.codebook_kind,
             [sizes[s] for s in range(p)])
-        return idx
+        ctx = {"sizes_full": sizes.copy(), "arrays": host_arrays,
+               "fills": {"ids": -1, "labels": 0},
+               "resident_names": ("codes", "ids"),
+               "attr_of": {"codes": "codes", "ids": "source_ids"}}
+        return idx, ctx
+
+    def _pack_flat(self, dataset, parts, centers, mt, store,
+                   keep_host: bool = False):
+        """Flat-rung packing (the storage-ladder analog of :meth:`_pack`):
+        each process assigns its own hosts' rows to the SHARED coarse
+        quantizer, quantizes them at the rung
+        (:mod:`raft_tpu.ops.quant`), cluster-sorts, and assembles the
+        stacked :class:`ShardedIvfFlat` from process-local slabs — rows
+        never cross the DCN, same contract as the pq pack. Stored norms
+        are the DEQUANTIZED rows' (what the search math scores against),
+        so a quantized rung is self-consistent, not mixed-precision."""
+        from ..ops import quant
+
+        topo = self.topology
+        p = self.n_shards
+        n, dim = dataset.shape
+        L = centers.shape[0]
+        multi = jax.process_count() > 1
+        my_shards = (list(topo.shards_of(jax.process_index())) if multi
+                     else list(range(p)))
+        R = max(len(part) for part in parts)
+
+        c_j = jnp.asarray(centers)
+
+        @jax.jit
+        def assign(xb):
+            d2 = (jnp.sum(xb * xb, axis=1, keepdims=True)
+                  - 2.0 * hdot(xb, c_j.T)
+                  + jnp.sum(c_j * c_j, axis=1)[None, :])
+            return jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+        @jax.jit
+        def quantize(xb):
+            if store == "float32":
+                return xb, None, jnp.sum(xb * xb, axis=1)
+            rows, scales = quant.quantize_rows(
+                xb, "int4" if store == "int4" else jnp.int8)
+            deq = (quant.dequantize_int4(rows, scales, dim)
+                   if store == "int4"
+                   else quant.dequantize_rows(rows, scales))
+            return rows, scales, jnp.sum(deq * deq, axis=1)
+
+        has_scales = store in ("int8", "int4")
+        width = (quant.int4_half_width(dim) if store == "int4" else dim)
+        data = np.zeros((p, R, width),
+                        np.int8 if has_scales else np.float32)
+        norms = np.zeros((p, R), np.float32)
+        scales_np = np.ones((p, R), np.float32) if has_scales else None
+        gids = np.full((p, R), -1, np.int32)
+        sizes = np.zeros((p, L), np.int32)
+        host_arrays: dict = {}
+        for s in my_shards:
+            rows_idx = parts[s]
+            xb = jnp.asarray(dataset[rows_idx], jnp.float32)
+            lb = np.asarray(assign(xb))
+            rq, sc, nr = quantize(xb)
+            order = np.argsort(lb, kind="stable")     # cluster-sorted
+            m = len(rows_idx)
+            data[s, :m] = np.asarray(rq)[order]
+            norms[s, :m] = np.asarray(nr)[order]
+            if has_scales:
+                scales_np[s, :m] = np.asarray(sc)[order]
+            gids[s, :m] = rows_idx[order]             # GLOBAL row ids
+            sizes[s] = np.bincount(lb, minlength=L)
+            if keep_host:
+                host_arrays[s] = {
+                    "data": data[s, :m].copy(),
+                    "norms": norms[s, :m].copy(),
+                    "ids": gids[s, :m].copy(),
+                }
+                if has_scales:
+                    host_arrays[s]["scales"] = scales_np[s, :m].copy()
+        if multi:
+            from jax.experimental import multihost_utils
+
+            local = sizes[_host_slab(topo, jax.process_index())]
+            sizes = np.asarray(multihost_utils.process_allgather(
+                jnp.asarray(local))).reshape(p, L).astype(np.int32)
+        offsets = np.concatenate(
+            [np.zeros((p, 1), np.int64), np.cumsum(sizes, axis=1)[:, :-1]],
+            axis=1).astype(np.int32)
+        cnorms = np.sum(centers * centers, axis=1).astype(np.float32)
+
+        put = lambda a, spec: _fleet_put(self.mesh, topo, a, spec)
+        stack = lambda a: np.broadcast_to(a, (p,) + a.shape).copy()
+        idx = ShardedIvfFlat(
+            self.mesh,
+            put(data, P(AXIS, None, None)),
+            put(norms, P(AXIS, None)),
+            put(gids, P(AXIS, None)),
+            put(stack(centers.astype(np.float32)), P(AXIS, None, None)),
+            put(stack(cnorms), P(AXIS, None)),
+            put(offsets, P(AXIS, None)),
+            put(sizes, P(AXIS, None)),
+            n, mt, [sizes[s] for s in range(p)],
+            scales=(put(scales_np, P(AXIS, None)) if has_scales else None),
+            store=store, logical_dim=dim)
+        idx.topology = self.topology
+        ctx = {"sizes_full": sizes.copy(), "arrays": host_arrays,
+               "fills": {"ids": -1, "scales": 1.0},
+               "resident_names": (("data", "norms", "ids", "scales")
+                                  if has_scales
+                                  else ("data", "norms", "ids")),
+               "attr_of": {"data": "data", "norms": "data_norms",
+                           "ids": "source_ids", "scales": "scales"},
+               "centers": centers.astype(np.float32),
+               "cnorms": cnorms}
+        return idx, ctx
+
+    # -- per-host HBM-budget tiers ----------------------------------------
+    def _plan_budget(self, index, ctx, budget: int, sample_queries,
+                     chunk_mb: float, n_probes_plan: int = 20) -> None:
+        """Arm the beyond-HBM rung fleet-wide: one hot/cold plan per
+        host from fleet-aggregated probe counts, each shard's cold
+        lists cut into host-RAM chunks, resident arrays re-packed to
+        the hot rows. Only ``(n_lists,)`` int count tables cross DCN
+        (:meth:`_probe_counts`); every process computes every host's
+        mask from the global size table, so the plans cannot diverge."""
+        topo = self.topology
+        p = self.n_shards
+        sizes = ctx["sizes_full"]
+        row_bytes = store_row_bytes(ctx["store"], ctx["dim"],
+                                    ctx.get("pq_dim"))
+        ctx["row_bytes"] = row_bytes
+        ctx["budget_bytes"] = int(budget)
+        ctx["chunk_rows"] = max(1, int(float(chunk_mb) * (1 << 20))
+                                // max(int(row_bytes), 1))
+        # full cluster-sorted row offsets per shard (L+1), the tier
+        # splitter's view of the pre-tier layout
+        ctx["offsets_full"] = {
+            s: np.concatenate([[0], np.cumsum(sizes[s].astype(np.int64))])
+            for s in range(p)}
+        ctx["counts"] = (None if sample_queries is None
+                         else self._probe_counts(ctx, sample_queries,
+                                                 n_probes_plan))
+        ctx["levels"] = {h: 0 for h in range(self.n_hosts)}
+        ctx["hot"] = {}
+        ctx["hot_sizes"] = {}
+        ctx["hot_offsets"] = {}
+        index._fleet_ctx = ctx
+        index._fleet_tiers = {}
+        # health() must keep reporting the FULL corpus as served: cold
+        # rows stream, they are not lost (the auto-widen keys off this)
+        index._rows_tbl_full = [sizes[s] for s in range(p)]
+        # R_hot: the padded resident row count every shard shares — the
+        # compiled row shape every later tier step must fit back into
+        masks = {h: hs.plan_hot_cold(
+            sizes[_host_slab(topo, h)].sum(axis=0).astype(np.int64),
+            row_bytes, budget, ctx["counts"]) for h in range(self.n_hosts)}
+        ctx["R_hot"] = max(1, max(
+            int(sizes[s][masks[topo.host_of(s)]].sum()) for s in range(p)))
+        ctx["resident"] = self._blank_resident(ctx)
+        for h in range(self.n_hosts):
+            self._retier_host(index, h, masks[h])
+        self._swap_resident(index)
+
+    def _blank_resident(self, ctx) -> dict:
+        """Fill-initialized (p, R_hot, ...) host copies of the resident
+        arrays — the buffers :meth:`_retier_host` packs hot rows into
+        and :meth:`_swap_resident` device_puts whole."""
+        p = self.n_shards
+        R_hot = ctx["R_hot"]
+        out = {}
+        for name in ctx["resident_names"]:
+            # any local shard's host array gives the trailing shape/dtype
+            proto = next(iter(ctx["arrays"].values()))[name]
+            out[name] = np.full((p, R_hot) + proto.shape[1:],
+                                ctx["fills"].get(name, 0), proto.dtype)
+        return out
+
+    def _probe_counts(self, ctx, sample_queries,
+                      n_probes: int) -> np.ndarray:
+        """Fleet-wide per-list probe counts over a query sample: each
+        process probes ITS slice against the replicated quantizer, then
+        the ``(n_lists,)`` int tables are allgathered and summed — the
+        only planning signal that crosses DCN."""
+        from ..ops.ivf_scan import coarse_probe
+
+        L = ctx["sizes_full"].shape[1]
+        q = np.asarray(sample_queries, np.float32)
+        nproc = jax.process_count()
+        if nproc > 1:
+            q = q[jax.process_index()::nproc]
+        if q.shape[0] == 0:
+            local = np.zeros(L, np.int64)
+        elif ctx["store"] == "pq":
+            q_rot = hdot(jnp.asarray(q), jnp.asarray(ctx["rotation"]).T)
+            probed = np.asarray(coarse_probe(
+                q_rot, jnp.asarray(ctx["centers_rot"]),
+                min(n_probes, L),
+                metric="ip" if ctx["metric"] is DistanceType.InnerProduct
+                else "l2"))
+            local = hs.probe_frequency(probed, L)
+        else:
+            mt = ctx["metric"]
+            cmetric = ("ip" if mt is DistanceType.InnerProduct
+                       else "cos" if mt is DistanceType.CosineExpanded
+                       else "l2")
+            probed = np.asarray(coarse_probe(
+                jnp.asarray(q), jnp.asarray(ctx["centers"]),
+                min(n_probes, L), metric=cmetric,
+                center_norms=jnp.asarray(ctx["cnorms"])))
+            local = hs.probe_frequency(probed, L)
+        if nproc > 1:
+            from jax.experimental import multihost_utils
+
+            g = np.asarray(multihost_utils.process_allgather(
+                jnp.asarray(local)))
+            return g.reshape(nproc, L).sum(axis=0).astype(np.int64)
+        return local.astype(np.int64)
+
+    def _retier_host(self, index, host: int, hot_mask) -> None:
+        """(Re)build one host's tiers + resident slabs for a hot mask,
+        clamped into the index's existing padded row shape ``R_hot`` —
+        a tier step must never grow device arrays or fork compiled
+        signatures. Resident size/offset tables are global knowledge
+        (sizes × mask) computed on every process; row arrays only on
+        the owning process."""
+        ctx = index._fleet_ctx
+        topo = self.topology
+        sizes = ctx["sizes_full"]
+        R_hot = ctx["R_hot"]
+        hot = np.asarray(hot_mask, bool).copy()
+        host_sizes = sizes[_host_slab(topo, host)].sum(axis=0
+                                                       ).astype(np.int64)
+        freq = (host_sizes.astype(np.float64) if ctx["counts"] is None
+                else np.asarray(ctx["counts"], np.float64))
+        dens = freq / np.maximum(host_sizes * ctx["row_bytes"], 1.0)
+        while max(int(sizes[s][hot].sum())
+                  for s in topo.shards_of(host)) > R_hot:
+            cands = np.flatnonzero(hot & (host_sizes > 0))
+            hot[cands[np.argmin(dens[cands])]] = False
+        ctx["hot"][host] = hot
+        multi = jax.process_count() > 1
+        local = (not multi) or (jax.process_index() == host)
+        for s in topo.shards_of(host):
+            hsz = np.where(hot, sizes[s], 0).astype(np.int64)
+            ctx["hot_sizes"][s] = hsz
+            ctx["hot_offsets"][s] = np.concatenate(
+                [[0], np.cumsum(hsz)[:-1]])
+            if not local:
+                continue
+            arrays = dict(ctx["arrays"][s])
+            if ctx["store"] == "pq":
+                arrays["labels"] = np.repeat(
+                    np.arange(sizes.shape[1]), sizes[s]).astype(np.int32)
+                arrays["norms"] = self._pq_row_norms(ctx, s)
+            tier, hot_arrays, _, _ = hs.build_tier(
+                arrays, ctx["offsets_full"][s], sizes[s], hot,
+                ctx["chunk_rows"], pad_tail=0, fills=ctx["fills"])
+            if ctx["store"] == "pq":
+                self._pq_chunk_extras(ctx, tier)
+            index._fleet_tiers[s] = tier
+            for name, res in ctx["resident"].items():
+                res[s] = ctx["fills"].get(name, 0)
+                rows = hot_arrays[name]
+                res[s][: rows.shape[0]] = rows
+
+    def _pq_row_norms(self, ctx, s: int) -> np.ndarray:
+        """Exact decoded ||row||² for shard ``s``'s cluster-sorted codes
+        (what the XLA cold rescore scores with), cached per shard."""
+        cache = ctx.setdefault("_row_norms", {})
+        if s not in cache:
+            from ..ops.ivf_pq_scan import decoded_row_norms
+
+            cache[s] = np.asarray(decoded_row_norms(
+                jnp.asarray(ctx["arrays"][s]["codes"]),
+                jnp.asarray(ctx["centers_rot"]),
+                jnp.asarray(ctx["books"]),
+                ctx["offsets_full"][s]), np.float32)
+        return cache[s]
+
+    def _pq_chunk_extras(self, ctx, tier) -> None:
+        """Chunk-local label remap + per-chunk rotated centers (the
+        ivf_pq.prepare_host_stream pattern) so the XLA cold rescore can
+        reconstruct ``center + decode`` without global tables."""
+        cent = ctx["centers_rot"]
+        L = cent.shape[0]
+        for ci, ch in enumerate(tier.chunks):
+            lab = np.clip(ch.arrays["labels"], 0, L - 1)
+            ch.arrays["labels"] = np.where(
+                tier.chunk_of[lab] == ci, tier.local_of[lab],
+                0).astype(np.int32)
+            loc = np.zeros((tier.chunk_lists, cent.shape[1]), np.float32)
+            loc[: len(ch.lists)] = cent[ch.lists]
+            tier.extras[ci]["centers"] = loc
+
+    def _swap_resident(self, index) -> None:
+        """Re-put the stacked resident arrays and list tables from the
+        ctx host copies. Shapes never change across tier steps, so the
+        compiled search executables are reused — a step swaps VALUES,
+        not signatures (the zero-recompile contract of the drill)."""
+        ctx = index._fleet_ctx
+        p = self.n_shards
+        put = lambda a, spec: _fleet_put(self.mesh, self.topology, a, spec)
+        for name, arr in ctx["resident"].items():
+            spec = P(AXIS, *([None] * (arr.ndim - 1)))
+            setattr(index, ctx["attr_of"][name], put(arr, spec))
+        sizes = np.stack([ctx["hot_sizes"][s]
+                          for s in range(p)]).astype(np.int32)
+        offsets = np.stack([ctx["hot_offsets"][s]
+                            for s in range(p)]).astype(np.int32)
+        index.offsets = put(offsets, P(AXIS, None))
+        index.sizes = put(sizes, P(AXIS, None))
+        if index.family == "ivf_pq":
+            index._sizes_host = [sizes[s] for s in range(p)]
+        else:
+            index._max_rows_tbl = [sizes[s] for s in range(p)]
+
+    def _apply_tier_level(self, index, host: int, level: int,
+                          old_level: int, reason: str) -> None:
+        """Move one host to budget-ladder ``level``: re-plan its hot set
+        at ``budget / 2**level``, rebuild its shards' tiers and resident
+        slabs in place, and flight-record the transition. Called by
+        :class:`FleetTierController` on a verdict edge."""
+        ctx = index._fleet_ctx
+        budget = int(ctx["budget_bytes"])
+        eff = max(1, budget >> int(level))
+        sizes = ctx["sizes_full"]
+        host_sizes = sizes[_host_slab(self.topology, host)].sum(
+            axis=0).astype(np.int64)
+        hot = hs.plan_hot_cold(host_sizes, ctx["row_bytes"], eff,
+                               ctx["counts"])
+        for s in self.topology.shards_of(host):
+            index._fleet_tiers.pop(s, None)
+        self._retier_host(index, host, hot)
+        self._swap_resident(index)
+        ctx["levels"][host] = int(level)
+        try:
+            from ..core import events as _events
+
+            _events.record(
+                "fleet_tier_step", f"fleet.host{host}", host=host,
+                level_from=int(old_level), level_to=int(level),
+                direction="down" if level > old_level else "up",
+                reason=reason, store=ctx["store"],
+                budget_bytes=budget, effective_budget_bytes=int(eff),
+                cold_lists=int((~ctx["hot"][host]).sum()))
+        except Exception:  # noqa: BLE001 - telemetry must not fail a step
+            pass
 
     # -- search ------------------------------------------------------------
     def search(self, index, queries, k: int,
-               params: ivf_pq.SearchParams | None = None,
+               params=None,
                allow_partial: bool = True, merge_engine=None, res=None):
         """Topology-aware merged search with degradation auto-widen.
 
@@ -507,16 +975,232 @@ class Fleet:
         a host loss recovers most of the way to healthy instead of
         dropping by the dead fraction. Returns ``(d, i, shards_ok)``
         with the default ``allow_partial=True`` (a fleet exists to keep
-        serving through a host loss), ``(d, i)`` when ``False``."""
-        sp = params or ivf_pq.SearchParams()
+        serving through a host loss), ``(d, i)`` when ``False``.
+
+        Dispatches on the index family (a flat-rung build returns a
+        ``ShardedIvfFlat``). When the build armed an HBM budget, the
+        resident half above is merged with every live host's streamed
+        cold lists (:meth:`_merge_cold`) — a DEAD host's cold lists are
+        never streamed (its resident results are already masked; its
+        host tier must degrade with it, not resurrect through the side
+        door)."""
+        fam = getattr(index, "family", "ivf_pq")
+        if fam == "ivf_flat":
+            sp = params or ivf_flat.SearchParams()
+            n_lists = int(index.centers.shape[1])
+            fn = sharded_ann.search_ivf_flat
+        else:
+            sp = params or ivf_pq.SearchParams()
+            n_lists = int(index.centers_rot.shape[1])
+            fn = sharded_ann.search_ivf_pq
         frac = sharded_ann.health(index)["served_frac"]
-        n_lists = int(index.centers_rot.shape[1])
         eff = _effective_nprobe(sp.n_probes, frac, n_lists)
         if eff != sp.n_probes:
             sp = dataclasses.replace(sp, n_probes=eff)
-        return sharded_ann.search_ivf_pq(
-            index, queries, k, sp, res=res, allow_partial=allow_partial,
-            merge_engine=merge_engine)
+        out = fn(index, queries, k, sp, res=res,
+                 allow_partial=allow_partial, merge_engine=merge_engine)
+        ctx = getattr(index, "_fleet_ctx", None)
+        # collective-safe skip: every process computes the same
+        # any-cold answer from the GLOBAL hot masks
+        if ctx is None or not any((~np.asarray(m)).any()
+                                  for m in ctx["hot"].values()):
+            return out
+        if allow_partial:
+            d, i, ok = out
+        else:
+            d, i = out
+            ok = np.asarray(index.shards_ok, bool)
+        d, i = self._merge_cold(index, queries, k, sp, d, i, ok)
+        return (d, i, ok) if allow_partial else (d, i)
+
+    def _merge_cold(self, index, queries, k: int, sp, d, i, ok):
+        """Stream every LIVE shard's probed cold lists and fold them
+        into the resident merge (the host_stream pattern lifted
+        fleet-wide). Single-process: plain ``knn_merge_parts`` over
+        local parts. Multi-process: local parts fold to ONE ``(m, k)``
+        block per process (sentinel block when a process has nothing
+        cold to add), the blocks allgather over DCN, and one final merge
+        lands the global answer — every process participates in the
+        collective regardless of its local cold traffic."""
+        from ..neighbors.brute_force import knn_merge_parts
+
+        ctx = index._fleet_ctx
+        mt = ctx["metric"]
+        select_min = is_min_close(mt)
+        q = jnp.asarray(queries, jnp.float32)
+        n_probes = min(int(sp.n_probes), ctx["sizes_full"].shape[1])
+        probed = self._coarse_probed(index, q, n_probes)
+        okv = np.asarray(ok, bool)
+        parts_d, parts_i = [], []
+        for s in sorted(index._fleet_tiers):
+            if not okv[s] or self.topology.host_of(s) in self._hosts_down:
+                continue    # dead host: no cold resurrection (see search)
+            tier = index._fleet_tiers[s]
+            run = self._cold_runner(index, ctx, tier, q, k)
+            for cd, ci_ in tier.stream(probed, run):
+                parts_d.append(ivf_flat._postprocess(mt, cd))
+                parts_i.append(ci_)
+        if jax.process_count() == 1:
+            if not parts_d:
+                return d, i
+            return knn_merge_parts(jnp.stack([d] + parts_d),
+                                   jnp.stack([i] + parts_i), select_min)
+        bad = jnp.inf if select_min else -jnp.inf
+        if parts_d:
+            ld, li = knn_merge_parts(jnp.stack(parts_d),
+                                     jnp.stack(parts_i), select_min)
+        else:
+            ld = jnp.full((q.shape[0], k), bad, jnp.float32)
+            li = jnp.full((q.shape[0], k), -1, jnp.int32)
+        from jax.experimental import multihost_utils
+
+        gd = jnp.asarray(multihost_utils.process_allgather(ld))
+        gi = jnp.asarray(multihost_utils.process_allgather(li))
+        return knn_merge_parts(
+            jnp.concatenate([d[None], gd.reshape(-1, *ld.shape)]),
+            jnp.concatenate([i[None], gi.reshape(-1, *li.shape)]),
+            select_min)
+
+    def _coarse_probed(self, index, q, n_probes: int) -> np.ndarray:
+        """Probed list ids for the cold half — the SAME probe arguments
+        as the resident executables (shared quantizer, shared center
+        norms), so hot and cold scan the same lists and exact rungs stay
+        bitwise equal to the unbudgeted build."""
+        from ..ops.ivf_scan import coarse_probe
+
+        ctx = index._fleet_ctx
+        mt = ctx["metric"]
+        if ctx["store"] == "pq":
+            q_rot = hdot(q, jnp.asarray(ctx["rotation"]).T)
+            return np.asarray(coarse_probe(
+                q_rot, jnp.asarray(ctx["centers_rot"]), n_probes,
+                metric="ip" if mt is DistanceType.InnerProduct else "l2"))
+        cmetric = ("ip" if mt is DistanceType.InnerProduct
+                   else "cos" if mt is DistanceType.CosineExpanded
+                   else "l2")
+        return np.asarray(coarse_probe(
+            q, jnp.asarray(ctx["centers"]), n_probes, metric=cmetric,
+            center_norms=jnp.asarray(ctx["cnorms"])))
+
+    def _cold_runner(self, index, ctx, tier, q, k: int):
+        """One chunk-scan closure for :meth:`HostTier.stream`: the XLA
+        cold scorers from the single-host tiers, fed through a shim
+        carrying only the fields they read (the fleet's stacked index
+        has no single-shard attribute layout to hand them)."""
+        mt = ctx["metric"]
+        if ctx["store"] == "pq":
+            shim = types.SimpleNamespace(
+                pq_dim=int(ctx["pq_dim"]),
+                codebooks=jnp.asarray(ctx["books"]),
+                rotation=jnp.asarray(ctx["rotation"]),
+                metric=mt, _host_tier=tier)
+            return lambda ci, dev, pl: ivf_pq._cold_chunk_xla_pq(
+                shim, dev, pl, q, k, None)
+        args = ivf_flat._ColdScanArgs(
+            k=k, lmax=tier.lmax, metric="l2", precision="highest",
+            int4_dim=(ctx["dim"] if ctx["store"] == "int4" else None))
+        shim = types.SimpleNamespace(dim=int(ctx["dim"]), metric=mt)
+        return lambda ci, dev, pl: ivf_flat._cold_chunk_xla_flat(
+            shim, dev, pl, q, args, None)
+
+    # -- per-host memory accounting ---------------------------------------
+    def host_memz(self) -> list:
+        """Per-HOST memory decomposition over every registered index:
+        the stacked device arrays split evenly across shards (stacked
+        layouts are uniform by construction) and summed per host, plus
+        each host's tier bytes parked in host RAM. This is the
+        measurement :class:`FleetTierController` compares against the
+        budget — in a real multi-process fleet each process sees its own
+        hosts' tier bytes only (tiers are process-local by design)."""
+        from ..serve import quality
+
+        topo = self.topology
+        hosts = [{"host": h, "indexes": 0, "device_bytes": 0,
+                  "host_tier_bytes": 0, "rows": 0}
+                 for h in range(self.n_hosts)]
+        for idx in list(self._indexes):
+            try:
+                rep = quality.device_bytes(idx)
+            except TypeError:       # a family memz can't decompose yet
+                continue
+            per_host = (int(rep["total_device_bytes"]) // self.n_shards
+                        * topo.devs_per_host)
+            n = int(getattr(idx, "n_total", 0) or 0)
+            for e in hosts:
+                e["indexes"] += 1
+                e["device_bytes"] += per_host
+                e["rows"] += n // self.n_hosts
+            for s, tier in getattr(idx, "_fleet_tiers", {}).items():
+                hosts[topo.host_of(s)]["host_tier_bytes"] += int(
+                    tier.host_bytes)
+        for e in hosts:
+            e["bytes_per_vector"] = (round(e["device_bytes"] / e["rows"], 2)
+                                     if e["rows"] else 0.0)
+        return hosts
+
+
+class FleetTierController:
+    """Budget brownout, per host (the MEMORY degrade axis of ROADMAP
+    item 3): one :class:`~raft_tpu.serve.degrade.BrownoutController`
+    state machine per host walks a ladder of HALVING effective budgets.
+    A host measured over its HBM budget (:meth:`Fleet.host_memz`, or
+    injected measurements in tests/drills) steps DOWN — its resident set
+    re-planned at ``budget / 2**level``, more lists streamed — instead
+    of OOMing; sustained headroom steps it back up. Every transition
+    re-packs into the index's existing compiled shapes
+    (:meth:`Fleet._swap_resident`): zero recompiles, zero stranded
+    futures, one ``fleet_tier_step`` event.
+
+    Levels are budget halvings, not search-param overrides, so the
+    brownout ladder is constructed as empty dicts — the controller
+    reuses ONLY the verdict/hysteresis state machine (dwell,
+    sustained-green recovery, urgent memory step)."""
+
+    def __init__(self, fleet: Fleet, index, *, levels: int = 3,
+                 min_dwell_s: float = 0.0, up_after_s: float = 30.0,
+                 clock=time.monotonic):
+        from ..serve.degrade import BrownoutController
+
+        ctx = getattr(index, "_fleet_ctx", None)
+        expects(ctx is not None,
+                "index has no armed HBM budget (build with hbm_budget_gb "
+                "or RAFT_TPU_HBM_BUDGET_GB)")
+        self.fleet = fleet
+        self.index = index
+        self.budget_bytes = int(ctx["budget_bytes"])
+        self._ctls = [
+            BrownoutController([{} for _ in range(int(levels))],
+                               min_dwell_s=min_dwell_s,
+                               up_after_s=up_after_s,
+                               name=f"fleet.host{h}.tier", clock=clock)
+            for h in range(fleet.n_hosts)]
+
+    def observe(self, host_bytes: Optional[dict] = None) -> dict:
+        """Feed one per-host measurement (``{host: device_bytes}``;
+        default: live :meth:`Fleet.host_memz`) through each host's state
+        machine and apply any tier step it decides. Returns
+        ``{host: {level, measured_bytes, verdict}}``."""
+        if host_bytes is None:
+            host_bytes = {e["host"]: e["device_bytes"]
+                          for e in self.fleet.host_memz()}
+        out = {}
+        for h, ctl in enumerate(self._ctls):
+            b = int(host_bytes.get(h, 0))
+            v = "breach" if b > self.budget_bytes else "ok"
+            old = ctl.level
+            lv = ctl.on_report(
+                {"targets": {"device_bytes": {"verdict": v}}})
+            if lv != old:
+                self.fleet._apply_tier_level(
+                    self.index, h, lv, old,
+                    reason="memory" if lv > old else "headroom")
+            out[h] = {"level": lv, "measured_bytes": b, "verdict": v}
+        return out
+
+    def snapshot(self) -> dict:
+        """Strict-JSON controller state for debugz/bench artifacts."""
+        return {"budget_bytes": self.budget_bytes,
+                "hosts": [ctl.snapshot() for ctl in self._ctls]}
 
 
 def ops_snapshot() -> dict:
@@ -540,5 +1224,6 @@ def ops_snapshot() -> dict:
             "dcn_reduction": f.topology.devs_per_host
             if f.topology.multi_host else 1}
         ent["last_probe"] = f.last_probe
+        ent["hosts"] = f.host_memz()
         fleets.append(ent)
     return {"fleets": fleets, "n_fleets": len(fleets)}

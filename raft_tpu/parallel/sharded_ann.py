@@ -177,9 +177,14 @@ def health(index) -> dict:
     elif isinstance(index, (ShardedIvfFlat, ShardedIvfPq)):
         # count from the host-side size tables, NOT the device arrays: a
         # multi-process fleet index's ``sizes`` spans non-addressable
-        # devices and cannot be fetched host-side
-        tbl = (index._sizes_host if isinstance(index, ShardedIvfPq)
-               else index._max_rows_tbl)
+        # devices and cannot be fetched host-side. A budget-tiered fleet
+        # index's live tables hold HOT sizes only — its full counts live
+        # in ``_rows_tbl_full`` (cold rows are still served, streamed;
+        # they must not read as lost corpus and trigger the auto-widen)
+        tbl = getattr(index, "_rows_tbl_full", None)
+        if tbl is None:
+            tbl = (index._sizes_host if isinstance(index, ShardedIvfPq)
+                   else index._max_rows_tbl)
         counts = np.asarray([int(np.sum(s)) for s in tbl], np.int64)
     else:
         raise TypeError(
@@ -412,7 +417,7 @@ class ShardedIvfFlat:
 
     def __init__(self, mesh, data, data_norms, source_ids, centers,
                  center_norms, offsets, sizes, n_total, metric, max_rows_tbl,
-                 scales=None):
+                 scales=None, store=None, logical_dim=None):
         self.mesh = mesh
         self.data = data                    # (p, R, d) f32|bf16|int8|uint8
         self.data_norms = data_norms        # (p, R)
@@ -424,7 +429,13 @@ class ShardedIvfFlat:
         self.n_total = n_total
         self.metric = metric
         self._max_rows_tbl = max_rows_tbl   # host: n_probes → max_rows bound
-        self.scales = scales                # (p, R) f32, int8 mode only
+        self.scales = scales                # (p, R) f32, int8/int4 modes
+        # storage rung of the stacked rows ("float32"/"int8"/"int4"/...)
+        # — "int4" means nibble-packed data whose last axis is the
+        # packed half-width, so searches must decode via logical_dim
+        self.store = store if store is not None else str(data.dtype)
+        self.logical_dim = int(data.shape[-1] if logical_dim is None
+                               else logical_dim)
         # sticky per-shard health flags (see mark_shard_failed)
         self.shards_ok = np.ones(mesh.shape[AXIS], bool)
         # shard -> last probe_shards result (debugz sharded section)
@@ -533,6 +544,8 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
     has_scales = index.scales is not None
     mask = filter.to_mask() if filter is not None else None
     has_filter = mask is not None
+    int4_dim = (index.logical_dim
+                if getattr(index, "store", None) == "int4" else None)
 
     def local(data, norms, gids, centers, cnorms, offsets, sizes, okf, qq,
               *rest):
@@ -542,7 +555,8 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
         mb = rest[int(has_scales)] if has_filter else None
         d, i = ivf_flat.search_arrays(
             args[0], args[1], args[2], args[3], args[4], args[5], args[6],
-            qq, k, n_probes, max_rows, mt, mask_bits=mb, scales=sc)
+            qq, k, n_probes, max_rows, mt, mask_bits=mb, scales=sc,
+            int4_dim=int4_dim)
         # dead-shard containment: an invalid shard's list is all
         # (+inf, -1) sentinel rows, so the merge is over survivors only
         bad = jnp.inf if select_min else -jnp.inf

@@ -160,7 +160,10 @@ def fleet_mesh(topology: Optional[Topology] = None, devices=None,
     return Mesh(np.array(devs), (axis,)), topo
 
 
-def plan_merge(topology: Topology, m: int, k: int) -> dict:
+def plan_merge(topology: Topology, m: int, k: int, *,
+               n_rows: Optional[int] = None,
+               row_bytes: Optional[float] = None,
+               hbm_budget_gb: Optional[float] = None) -> dict:
     """The wire math of one hierarchically merged search over ``m``
     queries × ``k`` results (f32 distances + i32 ids = 8 bytes/cell).
 
@@ -171,6 +174,14 @@ def plan_merge(topology: Topology, m: int, k: int) -> dict:
     DCN. The flat allgather merge instead moves ``(H-1)·D`` blocks per
     device over DCN: the hierarchy's DCN reduction factor is exactly
     ``D`` (the whole point of merging within the ICI domain first).
+
+    ``n_rows`` + ``row_bytes`` add the per-host STORAGE math alongside
+    the wire math (docs/mnmg.md "Per-host storage tiers"): how many
+    rows and bytes each host carries at a given ladder rung, and — with
+    ``hbm_budget_gb`` — how the corpus splits between the HBM-resident
+    set and the host-streamed cold tier. Row bytes never cross either
+    fabric (codes stay host-local); this block is what an operator
+    sizes per-host HBM and the budget knob by.
     """
     from ..ops import ring_topk
 
@@ -196,4 +207,23 @@ def plan_merge(topology: Topology, m: int, k: int) -> dict:
         plan["dcn_bytes_per_device"] = (H - 1) * blk
         plan["flat_dcn_bytes_per_device"] = (H - 1) * D * blk
         plan["dcn_reduction"] = D
+    if n_rows is not None and row_bytes is not None:
+        expects(n_rows >= 0 and row_bytes > 0,
+                "bad storage shape: n_rows=%s row_bytes=%s",
+                n_rows, row_bytes)
+        rows_host = -(-int(n_rows) // H)          # ceil: worst host
+        bytes_host = int(round(rows_host * float(row_bytes)))
+        storage = {
+            "row_bytes": float(row_bytes),
+            "rows_per_host": rows_host,
+            "bytes_per_host": bytes_host,
+        }
+        if hbm_budget_gb is not None and hbm_budget_gb > 0:
+            budget = int(float(hbm_budget_gb) * (1 << 30))
+            storage["hbm_budget_bytes_per_host"] = budget
+            storage["resident_bytes_per_host"] = min(bytes_host, budget)
+            storage["host_stream_bytes_per_host"] = max(
+                0, bytes_host - budget)
+            storage["fits_resident"] = bytes_host <= budget
+        plan["storage"] = storage
     return plan

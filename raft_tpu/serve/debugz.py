@@ -310,6 +310,13 @@ def render_text(batcher=None, registry=None, events_n: int = 20,
                   f"engine={fl.get('merge', {}).get('engine', '?')} "
                   f"dcn_reduction="
                   f"{fl.get('merge', {}).get('dcn_reduction', 1)}x"]
+        for hm in fl.get("hosts") or []:
+            lines.append(
+                f"  host{hm.get('host', '?')}: "
+                f"device_bytes={hm.get('device_bytes', 0)} "
+                f"tier_bytes={hm.get('host_tier_bytes', 0)} "
+                f"rows={hm.get('rows', 0)} "
+                f"bytes/vec={hm.get('bytes_per_vector', 0)}")
         lp = fl.get("last_probe") or {}
         if lp:
             lines.append(

@@ -173,6 +173,7 @@ class BrownoutController:
 
         lat_verdicts = (verdict("p99_latency_s"), verdict("shed_rate"))
         lat_breach = "breach" in lat_verdicts
+        mem_breach = verdict("device_bytes") == "breach"
         rec = t.get("recall", {})
         rec_v = rec.get("verdict", "ok")
         rec_watched = (int(rec.get("samples", 0) or 0) > 0
@@ -183,12 +184,21 @@ class BrownoutController:
             # a sustained latency "warn" (one window still violated)
             # accruing green time would step up straight back into the
             # breach — the flap the sustained-green rule exists to stop
-            all_ok = all(v == "ok" for v in lat_verdicts) and rec_v == "ok"
+            all_ok = (all(v == "ok" for v in lat_verdicts)
+                      and rec_v == "ok" and not mem_breach)
             if not all_ok:
                 self._green_since = None
             elif self._green_since is None:
                 self._green_since = now
-            if rec_v == "breach" and rec_watched:
+            if mem_breach:
+                # the MEMORY axis (ROADMAP item 3): measured over the
+                # HBM budget steps DOWN the ladder instead of OOMing.
+                # Memory outranks even the recall-floor refusal — a
+                # floor defended into an OOM serves nothing — and skips
+                # the dwell: the breach is measured headroom, not a
+                # tail blip
+                self._step_locked(+1, now, "memory", urgent=True)
+            elif rec_v == "breach" and rec_watched:
                 # quality floor wins over everything: climb back toward
                 # baseline even while latency still burns — and without
                 # waiting out the dwell (hysteresis exists to stop

@@ -503,8 +503,41 @@ def device_bytes(index) -> dict:
             comp["scan_cache"] = sum(
                 _nbytes(v) for v in sc.values() if hasattr(v, "dtype"))
     else:
-        raise TypeError(
-            f"no memz report for index type {type(index).__name__}")
+        try:
+            from ..parallel import sharded_ann as _sharded
+        except Exception:  # noqa: BLE001 - parallel layer optional here
+            _sharded = None
+        if _sharded is not None and isinstance(
+                index, (_sharded.ShardedIvfFlat, _sharded.ShardedIvfPq,
+                        _sharded.ShardedCagra)):
+            # fleet/sharded indexes: stacked (p, ...) arrays — the
+            # totals cover the WHOLE fleet (parallel/fleet.py divides
+            # host-major for the per-host tier-budget measurement)
+            family = "sharded_" + index.family
+            n = int(index.n_total)
+            if isinstance(index, _sharded.ShardedIvfFlat):
+                comp["dataset"] = (_nbytes(index.data)
+                                   + _nbytes(index.data_norms)
+                                   + _nbytes(index.source_ids)
+                                   + _nbytes(index.scales))
+                comp["quantizer"] = (_nbytes(index.centers)
+                                     + _nbytes(index.center_norms)
+                                     + _nbytes(index.offsets)
+                                     + _nbytes(index.sizes))
+            elif isinstance(index, _sharded.ShardedIvfPq):
+                comp["pq_codes"] = _nbytes(index.codes)
+                comp["dataset"] = (_nbytes(index.source_ids)
+                                   + _nbytes(index.centers_rot)
+                                   + _nbytes(index.codebooks)
+                                   + _nbytes(index.rotations)
+                                   + _nbytes(index.offsets)
+                                   + _nbytes(index.sizes))
+            else:
+                comp["dataset"] = (_nbytes(index.data)
+                                   + _nbytes(index.graphs))
+        else:
+            raise TypeError(
+                f"no memz report for index type {type(index).__name__}")
     total = int(sum(comp.values()))
     rep = {"family": family, "n": n, "components": comp,
            "total_device_bytes": total}
@@ -513,6 +546,14 @@ def device_bytes(index) -> dict:
         rep["host_stream"] = tier.snapshot()
         n += int(tier.cold_rows)
         rep["n_total"] = n
+    tiers = getattr(index, "_fleet_tiers", None)
+    if tiers:
+        # per-shard fleet tiers (this process's shards): one aggregated
+        # host_stream block, same shape as the single-index tier's
+        snaps = [t.snapshot() for _, t in sorted(tiers.items())]
+        rep["host_stream"] = {
+            key: int(sum(s[key] for s in snaps)) for key in snaps[0]}
+        rep["host_stream"]["shards"] = len(snaps)
     rep["bytes_per_vector"] = round(total / n, 2) if n else None
     return rep
 

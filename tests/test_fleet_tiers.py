@@ -1,0 +1,450 @@
+"""Fleet storage-tier tests (ISSUE 19, docs/mnmg.md "Per-host storage
+tiers"): the per-host HBM-budget ladder threaded through the fleet
+layer — quant-ladder rung builds (``Fleet.build_ivf_pq(store_dtype=,
+hbm_budget_gb=)``), fleet-wide hot/cold planning, host-streamed cold
+lists, and the budget-brownout tier controller.
+
+The acceptance pins, in test form:
+
+* exact rungs (float32/int8/int4) built under a budget are BITWISE
+  equal to the unbudgeted resident build — same probed lists, same
+  per-candidate dot products, batch composition cancels out;
+* the pq rung under a budget holds >= 0.95x its unbudgeted recall;
+* a host measured over budget steps DOWN the ladder (more lists
+  streamed) with zero extra compiles and steps back on sustained
+  headroom, flight-recording ``fleet_tier_step`` both ways;
+* a dead host's cold tier streams nothing and leaks no rows.
+
+Cheap planner/row-math/controller slices run in tier-1; the
+compile-heavy build+search arcs are ``slow`` (the same split as
+test_fleet.py)."""
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+from raft_tpu.core import events
+from raft_tpu.core.errors import RaftError
+from raft_tpu.neighbors import host_stream as hs
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.parallel import Fleet, Topology
+from raft_tpu.parallel import fleet as fleet_mod
+from raft_tpu.parallel import topology as topo_mod
+
+
+# ---------------------------------------------------------------------
+# tier-1-lean: row-byte math, env parsing, planner, controller wiring
+# ---------------------------------------------------------------------
+
+class TestStoreRowBytes:
+    def test_ladder_values_at_docs_dim(self):
+        """The numbers docs/mnmg.md budgets with, pinned: dim=96."""
+        f = fleet_mod.store_row_bytes
+        assert f("float32", 96) == 392
+        assert f("int8", 96) == 108
+        assert f("int4", 96) == 76
+        assert f("pq", 96, pq_dim=48) == 60
+        vals = [f("float32", 96), f("int8", 96), f("int4", 96),
+                f("pq", 96, pq_dim=48)]
+        assert vals == sorted(vals, reverse=True), \
+            "ladder must be byte-monotone at the docs dim"
+
+    def test_int4_sublane_padding_inverts_small_dims(self):
+        """Below dim 64 the int4 rung's 64-byte sublane-pair padding
+        dominates — the planner must budget with the REAL packed width,
+        not dim/2 (this is why the bench lane runs at d >= 64)."""
+        assert fleet_mod.store_row_bytes("int4", 32) == 76
+        assert fleet_mod.store_row_bytes("int8", 32) == 44
+
+    def test_pq_needs_pq_dim(self):
+        with pytest.raises(RaftError):
+            fleet_mod.store_row_bytes("pq", 96)
+
+    def test_unknown_rung(self):
+        with pytest.raises(ValueError):
+            fleet_mod.store_row_bytes("bf16", 96)
+
+
+class TestBudgetBytesEnv:
+    def test_malformed_env_warns_and_disables(self, monkeypatch):
+        """The operator-knob contract: a typo'd budget is a LOUD no-op
+        (RuntimeWarning + budget 0), never a crash and never a silently
+        armed tier."""
+        monkeypatch.setenv("RAFT_TPU_HBM_BUDGET_GB", "2GB")
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert hs.budget_bytes() == 0
+        assert any("malformed RAFT_TPU_HBM_BUDGET_GB" in str(x.message)
+                   for x in w), [str(x.message) for x in w]
+
+    def test_unset_env_is_silent_zero(self, monkeypatch):
+        monkeypatch.delenv("RAFT_TPU_HBM_BUDGET_GB", raising=False)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert hs.budget_bytes() == 0
+        assert not w
+
+    def test_armed_event_once_per_value(self, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_HBM_BUDGET_GB", "0.125")
+        hs._armed_seen.discard(int(0.125 * (1 << 30)))
+        n0 = len(events.recent(kind="host_tier_armed"))
+        b = hs.budget_bytes()
+        assert b == int(0.125 * (1 << 30))
+        armed = events.recent(kind="host_tier_armed")
+        assert len(armed) == n0 + 1
+        assert armed[-1]["budget_bytes"] == b
+        assert armed[-1]["source"] == "env"
+        hs.budget_bytes()              # same value: no second event
+        assert len(events.recent(kind="host_tier_armed")) == n0 + 1
+
+    def test_event_kinds_registered(self):
+        assert {"host_tier_armed", "fleet_tier_step"} <= \
+            events.WELL_KNOWN_KINDS
+
+
+class TestPlanMergeStorage:
+    def test_storage_block_budget_split(self):
+        """plan_merge's storage block must use the same row-byte math as
+        the planner, so docs/bench/planner can't drift apart."""
+        rb = fleet_mod.store_row_bytes("int8", 96)
+        plan = topo_mod.plan_merge(Topology(2, 4), m=128, k=10,
+                                   n_rows=1000, row_bytes=rb,
+                                   hbm_budget_gb=30_000 / (1 << 30))
+        st = plan["storage"]
+        assert st["rows_per_host"] == 500
+        assert st["bytes_per_host"] == 500 * rb
+        assert st["hbm_budget_bytes_per_host"] == 30_000
+        assert st["resident_bytes_per_host"] == 30_000
+        assert st["host_stream_bytes_per_host"] == 500 * rb - 30_000
+        assert st["fits_resident"] is False
+        json.dumps(plan, allow_nan=False)
+
+    def test_storage_block_fits(self):
+        plan = topo_mod.plan_merge(Topology(2, 2), m=16, k=4,
+                                   n_rows=100, row_bytes=108.0,
+                                   hbm_budget_gb=1.0)
+        st = plan["storage"]
+        assert st["fits_resident"] is True
+        assert st["host_stream_bytes_per_host"] == 0
+
+    def test_no_storage_without_shape(self):
+        assert "storage" not in topo_mod.plan_merge(Topology(2, 2),
+                                                    m=16, k=4)
+
+
+class TestPlanHotCold:
+    def test_probe_weighted_admission(self):
+        sizes = np.array([100, 100, 100, 0])
+        freq = np.array([1, 50, 10, 0])
+        hot = hs.plan_hot_cold(sizes, 10.0, 2100, freq)
+        # budget fits two non-empty lists: the hottest two win, the
+        # empty list is free to keep
+        assert hot.tolist() == [False, True, True, True]
+
+    def test_size_prior_without_sample(self):
+        sizes = np.array([10, 1000, 10])
+        hot = hs.plan_hot_cold(sizes, 1.0, 25)
+        # uniform-traffic prior ~ list size; equal density ->
+        # stable-order admission until the budget is spent
+        assert hot.sum() >= 1 and not hot[1]
+
+
+class TestBrownoutMemoryAxis:
+    def test_memory_breach_urgent_and_outranks_recall(self):
+        from raft_tpu.serve.degrade import BrownoutController
+
+        t = [0.0]
+        ctl = BrownoutController([{}, {}], min_dwell_s=100.0,
+                                 up_after_s=5.0, name="t.mem",
+                                 clock=lambda: t[0])
+        rep = {"targets": {
+            "device_bytes": {"verdict": "breach"},
+            "recall": {"verdict": "breach", "samples": 10}}}
+        # memory skips the dwell AND outranks the recall floor: a floor
+        # defended into an OOM serves nothing
+        assert ctl.on_report(rep) == 1
+        assert ctl.on_report(rep) == 2
+        assert ctl.on_report(rep) == 2          # ladder top
+        # sustained green steps back (min_dwell does not gate recovery
+        # once up_after has accrued)
+        ok = {"targets": {"device_bytes": {"verdict": "ok"}}}
+        t[0] = 200.0
+        ctl.on_report(ok)
+        t[0] = 206.0
+        assert ctl.on_report(ok) == 1
+
+
+class TestBuildValidation:
+    def test_invalid_store_dtype(self):
+        fl = Fleet.virtual(1, 1)
+        with pytest.raises(RaftError):
+            fl.build_ivf_pq(np.zeros((64, 8), np.float32),
+                            ivf_pq.IndexParams(n_lists=4),
+                            store_dtype="bf16")
+
+    def test_tier_controller_requires_budget(self):
+        class Bare:
+            pass
+
+        fl = Fleet.virtual(1, 1)
+        with pytest.raises(RaftError):
+            fleet_mod.FleetTierController(fl, Bare())
+
+
+# ---------------------------------------------------------------------
+# slow: the build+search acceptance arcs on the virtual 2x2 fleet
+# ---------------------------------------------------------------------
+
+def _gt(base, q, k):
+    d2 = ((q[:, None, :] - base[None, :, :]) ** 2).sum(-1)
+    return np.argsort(d2, axis=1, kind="stable")[:, :k]
+
+
+def _recall(found, want):
+    k = want.shape[1]
+    return float(np.mean([len(set(found[m].tolist())
+                              & set(want[m].tolist())) / k
+                          for m in range(len(want))]))
+
+
+def _cold_counts(idx):
+    return {h: int((~np.asarray(m)).sum())
+            for h, m in idx._fleet_ctx["hot"].items()}
+
+
+@pytest.mark.multichip
+@pytest.mark.slow
+class TestBudgetedBuildArc:
+    N, DIM, M, K = 2048, 16, 32, 10
+
+    def _corpus(self, rng, dim=None):
+        base = rng.standard_normal((self.N, dim or self.DIM))
+        base = base.astype(np.float32)
+        q = rng.standard_normal((self.M, dim or self.DIM))
+        return base, q.astype(np.float32)
+
+    @pytest.mark.parametrize("rung", ["float32", "int8", "int4"])
+    def test_exact_rung_bitwise_parity(self, multichip_mesh, rng, rung):
+        """The headline pin: a budgeted exact-rung build must return
+        results BITWISE equal to the unbudgeted resident build — cold
+        lists go through the same probe selection and the same
+        highest-precision dot products, so where a row is stored cannot
+        change an answer."""
+        fl = Fleet.virtual(2, 2)
+        base, q = self._corpus(rng)
+        p0 = ivf_pq.IndexParams(n_lists=8, seed=0)
+        sp = ivf_flat.SearchParams(n_probes=4)
+        idx0 = fl.build_ivf_pq(base, p0, store_dtype=rung)
+        d0, i0, ok0 = fl.search(idx0, q, self.K, sp)
+        idx1 = fl.build_ivf_pq(base, p0, store_dtype=rung,
+                               hbm_budget_gb=20e3 / (1 << 30),
+                               sample_queries=q)
+        cold = _cold_counts(idx1)
+        assert sum(cold.values()) > 0, \
+            f"budget never spilled ({cold}) — the parity claim is vacuous"
+        d1, i1, ok1 = fl.search(idx1, q, self.K, sp)
+        assert list(ok0) == list(ok1) == [True] * 4
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    def test_flat_bit_identity_multi_chunk(self, multichip_mesh, rng):
+        """Parity must survive the chunk boundary: a chunk_mb small
+        enough to cut every host's cold tier into several streamed
+        chunks still merges to the identical result."""
+        fl = Fleet.virtual(2, 2)
+        base, q = self._corpus(rng)
+        p0 = ivf_pq.IndexParams(n_lists=8, seed=0)
+        sp = ivf_flat.SearchParams(n_probes=6)
+        idx0 = fl.build_ivf_pq(base, p0, store_dtype="int8")
+        d0, i0, _ = fl.search(idx0, q, self.K, sp)
+        idx1 = fl.build_ivf_pq(base, p0, store_dtype="int8",
+                               hbm_budget_gb=12e3 / (1 << 30),
+                               sample_queries=q, chunk_mb=0.005)
+        assert any(len(t.chunks) > 1
+                   for t in idx1._fleet_tiers.values()), \
+            "chunk_mb did not force a multi-chunk tier"
+        d1, i1, _ = fl.search(idx1, q, self.K, sp)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    def test_pq_rung_recall_and_bytes(self, multichip_mesh, rng):
+        """The pq rung's acceptance: budgeted recall >= 0.95x the
+        unbudgeted build, and the budgeted resident set respects the
+        per-host budget (+ tolerance for the shared quantizer, which is
+        outside the row budget)."""
+        from raft_tpu.serve import quality
+
+        fl = Fleet.virtual(2, 2)
+        base, q = self._corpus(rng, dim=32)
+        p0 = ivf_pq.IndexParams(n_lists=8, pq_dim=16, seed=0)
+        sp = ivf_pq.SearchParams(n_probes=6)
+        idx0 = fl.build_ivf_pq(base, p0, store_dtype="pq")
+        _, i0, _ = fl.search(idx0, q, self.K, sp)
+        budget_b = 3000
+        idx1 = fl.build_ivf_pq(base, p0, store_dtype="pq",
+                               hbm_budget_gb=budget_b / (1 << 30),
+                               sample_queries=q)
+        assert sum(_cold_counts(idx1).values()) > 0
+        _, i1, _ = fl.search(idx1, q, self.K, sp)
+        gt = _gt(base, q, self.K)
+        r0, r1 = _recall(np.asarray(i0), gt), _recall(np.asarray(i1), gt)
+        assert r1 >= 0.95 * r0, (r1, r0)
+        # resident codes shrank to ~the budget: budgeted list-data bytes
+        # are the unbudgeted build's minus what the tier parked on host
+        rep0 = quality.device_bytes(idx0)["components"]["dataset"]
+        rep1 = quality.device_bytes(idx1)["components"]["dataset"]
+        saved = sum(t.device_bytes_saved
+                    for t in idx1._fleet_tiers.values())
+        assert rep1 < rep0 and saved > 0
+        json.dumps(fl.host_memz(), allow_nan=False)
+
+    def test_host_loss_cold_interaction(self, multichip_mesh, rng):
+        """A dead host's shards drop out of BOTH paths: its resident
+        results vanish and its cold tier streams nothing (no wasted
+        host->device uploads for shards whose results are discarded),
+        and no dead-host row leaks into the merged ids. Restore brings
+        the rows back."""
+        fl = Fleet.virtual(2, 2)
+        base, q = self._corpus(rng)
+        p0 = ivf_pq.IndexParams(n_lists=8, seed=0)
+        sp = ivf_flat.SearchParams(n_probes=6)
+        idx = fl.build_ivf_pq(base, p0, store_dtype="int8",
+                              hbm_budget_gb=20e3 / (1 << 30),
+                              sample_queries=q)
+        assert sum(_cold_counts(idx).values()) > 0
+        _, i_all, _ = fl.search(idx, q, self.K, sp)
+
+        fl.mark_host_failed(1)
+        for t in idx._fleet_tiers.values():
+            t.streamed_chunks = 0
+        try:
+            _, ii, ok = fl.search(idx, q, self.K, sp)
+            assert list(ok) == [True, True, False, False]
+            # host 1 owns the upper half of the row split
+            dead = {s for s in idx._fleet_tiers
+                    if fl.topology.host_of(s) == 1}
+            assert all(idx._fleet_tiers[s].streamed_chunks == 0
+                       for s in dead)
+            live_streams = sum(idx._fleet_tiers[s].streamed_chunks
+                               for s in idx._fleet_tiers if s not in dead)
+            assert live_streams > 0
+            ids = np.asarray(ii).ravel()
+            assert not ((ids >= self.N // 2) & (ids >= 0)).any(), \
+                "dead host's rows leaked through the cold merge"
+        finally:
+            fl.mark_host_failed(1, ok=True)
+        _, i_back, _ = fl.search(idx, q, self.K, sp)
+        np.testing.assert_array_equal(np.asarray(i_back),
+                                      np.asarray(i_all))
+
+    def test_budget_keeps_health_green(self, multichip_mesh, rng):
+        """Cold rows are SERVED (streamed), not lost: budgeting must not
+        read as missing corpus and trip the auto-widen (served_frac
+        stays 1.0, effective n_probes untouched)."""
+        fl = Fleet.virtual(2, 2)
+        base, q = self._corpus(rng)
+        idx = fl.build_ivf_pq(base, ivf_pq.IndexParams(n_lists=8, seed=0),
+                              store_dtype="int8",
+                              hbm_budget_gb=20e3 / (1 << 30),
+                              sample_queries=q)
+        assert sum(_cold_counts(idx).values()) > 0
+        assert fl.host_health()["served_frac"] == 1.0
+
+
+@pytest.mark.multichip
+@pytest.mark.slow
+class TestTierStepDrill:
+    def test_over_budget_steps_down_and_recovers(self, multichip_mesh,
+                                                 rng):
+        """The brownout drill: a host measured over budget steps DOWN
+        the ladder (results still bitwise-stable, zero new compiles —
+        every re-tier lands in the already-compiled shapes), sustained
+        headroom steps it back, and both transitions flight-record
+        ``fleet_tier_step``."""
+        from raft_tpu.serve.warmup import count_compilations
+
+        fl = Fleet.virtual(2, 2)
+        base = rng.standard_normal((2048, 16)).astype(np.float32)
+        q = rng.standard_normal((32, 16)).astype(np.float32)
+        sp = ivf_flat.SearchParams(n_probes=6)
+        budget_b = 40_000            # full int8 residency fits: no cold
+        idx = fl.build_ivf_pq(base, ivf_pq.IndexParams(n_lists=8, seed=0),
+                              store_dtype="int8",
+                              hbm_budget_gb=budget_b / (1 << 30),
+                              sample_queries=q)
+        assert sum(_cold_counts(idx).values()) == 0
+        d0, i0, _ = fl.search(idx, q, 10, sp)
+
+        t = [0.0]
+        ctl = fleet_mod.FleetTierController(fl, idx, levels=3,
+                                            min_dwell_s=0.0,
+                                            up_after_s=30.0,
+                                            clock=lambda: t[0])
+        n_steps0 = len(events.recent(kind="fleet_tier_step"))
+
+        # host 0 measured at 2x budget -> down one level; host 1 green
+        out = ctl.observe({0: budget_b * 2, 1: budget_b // 2})
+        assert out[0]["level"] == 1 and out[1]["level"] == 0
+        assert _cold_counts(idx)[0] > 0 and _cold_counts(idx)[1] == 0
+
+        # the step must not invent new programs: warm the stepped state,
+        # then a steady-state search compiles nothing beyond the
+        # per-call baseline measured on the SAME warmed state
+        d1, i1, _ = fl.search(idx, q, 10, sp)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+        with count_compilations() as base_c:
+            fl.search(idx, q, 10, sp)
+        t[0] = 1.0
+        # green report: holds level 1 (recovery needs 30s sustained
+        # green) without stepping further down — a repeated breach
+        # report would, one urgent step per observation
+        out = ctl.observe({0: budget_b // 2, 1: budget_b // 2})
+        assert out[0]["level"] == 1
+        with count_compilations() as post_c:
+            fl.search(idx, q, 10, sp)
+        assert post_c.count <= base_c.count, (post_c.count, base_c.count)
+
+        ev = events.recent(kind="fleet_tier_step")[n_steps0:]
+        assert [(e["host"], e["level_from"], e["level_to"],
+                 e["direction"], e["reason"]) for e in ev] == \
+            [(0, 0, 1, "down", "memory")]
+
+        # sustained headroom: green observations past up_after_s
+        for tt in (10.0, 31.0, 62.0):
+            t[0] = tt
+            out = ctl.observe({0: budget_b // 2, 1: budget_b // 2})
+        assert out[0]["level"] == 0
+        assert _cold_counts(idx)[0] == 0
+        d2, i2, _ = fl.search(idx, q, 10, sp)
+        np.testing.assert_array_equal(np.asarray(i2), np.asarray(i0))
+        np.testing.assert_array_equal(np.asarray(d2), np.asarray(d0))
+        ev = events.recent(kind="fleet_tier_step")[n_steps0:]
+        assert ev[-1]["direction"] == "up"
+        assert ev[-1]["reason"] == "headroom"
+        json.dumps(ctl.snapshot(), allow_nan=False)
+
+    def test_debugz_fleet_hosts_section(self, multichip_mesh, rng):
+        """ops surface: the fleet section carries per-host memory rows
+        (device bytes, tier bytes, bytes/vector), strict-JSON, and the
+        text rendering includes them."""
+        from raft_tpu.serve import debugz
+
+        fl = Fleet.virtual(2, 2)
+        base = rng.standard_normal((1024, 16)).astype(np.float32)
+        idx = fl.build_ivf_pq(base, ivf_pq.IndexParams(n_lists=8, seed=0),
+                              store_dtype="int8",
+                              hbm_budget_gb=10e3 / (1 << 30))
+        assert sum(_cold_counts(idx).values()) > 0
+        snap = debugz.snapshot()
+        ent = next(e for e in snap["fleet"]
+                   if e["topology"] == "2x2" and e.get("hosts"))
+        hosts = ent["hosts"]
+        assert [h["host"] for h in hosts] == [0, 1]
+        assert all(h["device_bytes"] > 0 for h in hosts)
+        assert sum(h["host_tier_bytes"] for h in hosts) > 0
+        assert all(h["bytes_per_vector"] > 0 for h in hosts)
+        json.dumps(snap, allow_nan=False)
+        txt = debugz.render_text()
+        assert "host_tier_bytes" in txt or "tier_bytes" in txt
