@@ -56,8 +56,8 @@ PASS_RULES = {
         "fragile-repeat", "fragile-reshape", "dma-unwaited",
         "sem-unpaired", "trace-failed"}),
     "hotpath": frozenset({
-        "hotpath-sync", "hotpath-callback", "jit-static-float",
-        "jit-static-missing"}),
+        "hotpath-sync", "hotpath-callback", "hotpath-shardmap-rebuild",
+        "jit-static-float", "jit-static-missing"}),
     "lock": frozenset({"unlocked-attr"}),
 }
 KNOWN_RULES = frozenset().union(*PASS_RULES.values())

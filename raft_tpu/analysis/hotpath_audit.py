@@ -37,7 +37,8 @@ from . import Finding
 
 __all__ = ["jaxpr_stats", "audit_searcher", "run", "HOTPATH_MODULES",
            "CALLBACK_PRIMS", "sync_lint", "sync_lint_source",
-           "jit_static_lint", "jit_static_lint_source"]
+           "jit_static_lint", "jit_static_lint_source",
+           "shardmap_lint", "shardmap_lint_source"]
 
 # primitives that round-trip through the host per execution
 CALLBACK_PRIMS = frozenset({
@@ -61,6 +62,8 @@ HOTPATH_MODULES = (
     "raft_tpu/neighbors/host_stream.py",
     "raft_tpu/parallel/sharded_ann.py",
     "raft_tpu/parallel/sharded_knn.py",
+    "raft_tpu/parallel/fleet.py",
+    "raft_tpu/parallel/dispatch_cache.py",
 )
 
 _SYNC_CALLS = {"block_until_ready", "device_get"}
@@ -69,6 +72,20 @@ _OFFPATH_FN = re.compile(
     r"warm|prepare|tune|bench|save|load|export|__main__")
 # ... as is one under a sampled-probe conditional
 _PROBE_COND = re.compile(r"probe|sample|rate|tick|warm")
+
+# -- rule hotpath-shardmap-rebuild ------------------------------------------
+# constructing a shard_map per call re-traces (and usually recompiles)
+# the WHOLE sharded program on every search — the dispatch tax the
+# per-index compiled-program cache (parallel/dispatch_cache) exists to
+# kill. Legal off the hot path (builds/training/warmup/tuning/dryruns,
+# tier re-planning) ...
+_SHARDMAP_CALLS = {"shard_map", "shard_map_compat"}
+_SHARDMAP_OFFPATH = re.compile(
+    r"warm|prepare|tune|bench|save|load|export|__main__|build|train"
+    r"|dryrun|pack|plan|retier")
+# ... or under a compiled-program-cache miss conditional (trace once,
+# store, dispatch many)
+_CACHE_MISS_COND = re.compile(r"cache|miss|compil|is None|not in")
 
 
 # ---------------------------------------------------------------------------
@@ -163,9 +180,22 @@ def _is_sync_call(node: ast.Call) -> Optional[str]:
     return None
 
 
-class _SyncVisitor(ast.NodeVisitor):
-    def __init__(self, path: str):
-        self.path = path
+def _is_shardmap_call(node: ast.Call) -> Optional[str]:
+    f = node.func
+    name = (f.attr if isinstance(f, ast.Attribute)
+            else f.id if isinstance(f, ast.Name) else None)
+    return name if name in _SHARDMAP_CALLS else None
+
+
+class _CallSiteVisitor(ast.NodeVisitor):
+    """Flag calls matched by ``matcher`` unless an enclosing function
+    name matches ``offpath`` or an enclosing ``if`` condition matches
+    ``cond_cover`` (the sampled-probe / cache-miss escape hatches)."""
+
+    def __init__(self, matcher, offpath, cond_cover):
+        self.matcher = matcher
+        self.offpath = offpath
+        self.cond_cover = cond_cover
         self.fn_stack: List[str] = []
         self.if_stack: List[str] = []
         self.hits: List[Tuple[int, str, str]] = []  # (line, call, fn)
@@ -197,22 +227,20 @@ class _SyncVisitor(ast.NodeVisitor):
             self.visit(child)
 
     def visit_Call(self, node):
-        sync = _is_sync_call(node)
-        if sync is not None:
-            off_path = any(_OFFPATH_FN.search(fn) for fn in self.fn_stack)
-            probed = any(_PROBE_COND.search(c) for c in self.if_stack)
-            if not off_path and not probed:
+        name = self.matcher(node)
+        if name is not None:
+            off_path = any(self.offpath.search(fn) for fn in self.fn_stack)
+            covered = any(self.cond_cover.search(c) for c in self.if_stack)
+            if not off_path and not covered:
                 fn = ".".join(self.fn_stack) or "<module>"
-                self.hits.append((node.lineno, sync, fn))
+                self.hits.append((node.lineno, name, fn))
         self.generic_visit(node)
-
-
 
 
 def sync_lint_source(src: str, rel_path: str) -> List[Finding]:
     """Sync lint for one module's source (exposed for the fixture
     tests)."""
-    visitor = _SyncVisitor(rel_path)
+    visitor = _CallSiteVisitor(_is_sync_call, _OFFPATH_FN, _PROBE_COND)
     visitor.visit(ast.parse(src))
     return [Finding(
         "hotpath-sync", rel_path, f"{fn}:{call}",
@@ -230,6 +258,37 @@ def sync_lint(root: str) -> List[Finding]:
         with open(os.path.join(root, rel)) as f:
             src = f.read()
         findings += sync_lint_source(src, rel.replace(os.sep, "/"))
+    return findings
+
+
+def shardmap_lint_source(src: str, rel_path: str) -> List[Finding]:
+    """Per-call shard_map-rebuild lint for one module's source (exposed
+    for the fixture tests): any ``shard_map``/``shard_map_compat``
+    construction in a serving-reachable module must sit off the hot
+    path (build/train/warmup/tune/... function) or under a compiled-
+    program-cache miss conditional (``if fn is None:`` — the
+    trace-once/dispatch-many pattern of parallel/dispatch_cache)."""
+    visitor = _CallSiteVisitor(_is_shardmap_call, _SHARDMAP_OFFPATH,
+                               _CACHE_MISS_COND)
+    visitor.visit(ast.parse(src))
+    return [Finding(
+        "hotpath-shardmap-rebuild", rel_path, f"{fn}:{call}",
+        f"per-call {call} construction in serving-reachable "
+        f"'{fn}': every search re-traces the whole sharded program "
+        "(~hundreds of XLA programs per call at fleet scale) — route "
+        "it through the per-index compiled-program cache "
+        "(parallel/dispatch_cache)", line)
+        for line, call, fn in visitor.hits]
+
+
+def shardmap_lint(root: str) -> List[Finding]:
+    from . import iter_module_paths
+
+    findings = []
+    for rel in iter_module_paths(root, HOTPATH_MODULES):
+        with open(os.path.join(root, rel)) as f:
+            src = f.read()
+        findings += shardmap_lint_source(src, rel.replace(os.sep, "/"))
     return findings
 
 
@@ -352,4 +411,4 @@ def jit_static_lint(root: str) -> List[Finding]:
 
 
 def run(root: str) -> List[Finding]:
-    return sync_lint(root) + jit_static_lint(root)
+    return sync_lint(root) + jit_static_lint(root) + shardmap_lint(root)

@@ -235,7 +235,8 @@ class HostTier:
 def build_tier(arrays: Dict[str, np.ndarray], list_offsets: np.ndarray,
                list_sizes: np.ndarray, hot: np.ndarray,
                chunk_rows: int, pad_tail: int = 0,
-               fills: Optional[Dict[str, float]] = None
+               fills: Optional[Dict[str, float]] = None,
+               chunk_shape: Optional[Tuple[int, int, int]] = None
                ) -> Tuple[HostTier, Dict[str, np.ndarray], np.ndarray,
                           np.ndarray]:
     """Split cluster-sorted ``arrays`` (rows axis 0) into a packed
@@ -248,6 +249,14 @@ def build_tier(arrays: Dict[str, np.ndarray], list_offsets: np.ndarray,
     DMA window — padding HERE means the device never re-pads a streamed
     chunk). ``fills``: per-array pad value (default 0).
 
+    ``chunk_shape``: optional ``(chunk_rows, chunk_lists, lmax)`` pin
+    for the padded chunk geometry. Without it the shared shape shrinks
+    to the fullest chunk actually planned (host-RAM economy); with it,
+    every tier built from the same pin — e.g. every level of a fleet
+    budget ladder (:meth:`raft_tpu.parallel.fleet.Fleet` re-tiers) —
+    shares ONE padded shape, so a re-tier lands in the already-compiled
+    cold-scan executables instead of forking new shapes per level.
+
     Returns ``(tier, hot_arrays, hot_offsets, hot_sizes)``; the caller
     swaps the resident arrays/offsets into its index and attaches the
     tier."""
@@ -258,7 +267,12 @@ def build_tier(arrays: Dict[str, np.ndarray], list_offsets: np.ndarray,
     cold_ids = np.flatnonzero(~np.asarray(hot))
     cold_sizes = sizes[cold_ids]
     lmax = int(cold_sizes.max()) if cold_ids.size else 0
-    chunk_rows = max(int(chunk_rows), lmax, 1)
+    if chunk_shape is not None:
+        pin_rows, pin_lists, pin_lmax = (int(v) for v in chunk_shape)
+        lmax = max(lmax, pin_lmax)
+        chunk_rows = max(pin_rows, lmax, 1)
+    else:
+        chunk_rows = max(int(chunk_rows), lmax, 1)
 
     # ---- greedy fixed-shape chunk plan over cold lists (+1 dead slot
     # per chunk that out-of-chunk probes are routed to)
@@ -274,12 +288,19 @@ def build_tier(arrays: Dict[str, np.ndarray], list_offsets: np.ndarray,
         cur_rows += s
     if cur:
         plans.append(cur)
-    # shrink the shared chunk shape to the fullest chunk actually
-    # planned: every chunk still hits one executable, and a tier whose
-    # cold set is far under the row budget does not pad host RAM (or
-    # PCIe uploads) out to the budget
-    chunk_rows = max((int(sizes[p].sum()) for p in plans), default=1)
-    chunk_lists = max((len(p) for p in plans), default=0) + 1
+    if chunk_shape is None:
+        # shrink the shared chunk shape to the fullest chunk actually
+        # planned: every chunk still hits one executable, and a tier
+        # whose cold set is far under the row budget does not pad host
+        # RAM (or PCIe uploads) out to the budget
+        chunk_rows = max((int(sizes[p].sum()) for p in plans), default=1)
+        chunk_lists = max((len(p) for p in plans), default=0) + 1
+    else:
+        # pinned geometry: never shrink (and never exceed the pin —
+        # the greedy plan above cut at the pinned row budget, and any
+        # planned chunk holds at most n_lists lists)
+        chunk_lists = max(pin_lists,
+                          max((len(p) for p in plans), default=0) + 1)
 
     chunk_of = np.full(n_lists, -1, np.int32)
     local_of = np.zeros(n_lists, np.int32)

@@ -1,0 +1,134 @@
+"""Per-index compiled-program cache for sharded/fleet dispatch.
+
+Every sharded family used to rebuild and re-trace its whole
+``shard_map`` closure per search call — ~224 XLA programs *per call* on
+an unbudgeted fleet index, the dispatch tax the r05 roofline blames for
+``vs_baseline`` sitting at 0.08-0.11 (docs/perf.md "Sharded dispatch").
+This module holds the mechanics that make sharded dispatch
+trace-once/dispatch-many:
+
+* :func:`cache_of` — the per-index ``{key: jitted shard_map program}``
+  dict, lazily attached to the index object. The cache lives ON the
+  index (not a module global) so dropping an index drops its
+  executables, and two indexes with identical statics never share a
+  program that closes over different comms/topology objects.
+* :func:`program_key` — the ``shape_bucket``-style string key: family,
+  resolved merge engine, mesh platform/device-kind tag, topology tag,
+  comms fingerprint, then the family's closure-baked statics
+  (``n_probes``, ``max_rows``, metric, filter arity, ...). The query
+  count ``m`` is deliberately EXCLUDED: ``jax.jit`` keys executables by
+  argument shape, so one cached wrapper serves every batch bucket —
+  only values baked into the trace belong in the Python-level key.
+* :func:`enabled` — ``RAFT_TPU_SHARDED_DISPATCH=uncached`` restores
+  per-call dispatch: a FRESH jit wrapper per search, so every call
+  re-traces and re-compiles the identical program. That is the bitwise
+  comparison hook (same trace, same XLA program, same bits as the
+  cached path) and the dryrun's before/after ``programs_per_call``
+  measurement. It is deliberately NOT the historical eager
+  ``shard_map`` dispatch: eager op-by-op execution and the fused jit
+  program may differ in float low bits (FMA contraction), which would
+  make bitwise pins vacuous.
+* :func:`dispatch_label` — wraps a sharded dispatch in the serve
+  recompile-watch's :func:`~raft_tpu.serve.warmup.compile_context`
+  label (``sharded.<family>:<m>x<k>``), so a post-warmup sharded
+  recompile lands in ``serve.recompiles`` + the ``xla_compile`` ring
+  exactly like a batcher-path recompile. An enclosing warmup context
+  is respected: the warmup sweep's first compiles stay exempt.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+__all__ = ["enabled", "cache_of", "program_key", "comms_tag", "mesh_tag",
+           "topology_tag", "dispatch_label", "stats"]
+
+_ENV = "RAFT_TPU_SHARDED_DISPATCH"
+_ATTR = "_dispatch_cache"
+
+
+def enabled() -> bool:
+    """False when ``RAFT_TPU_SHARDED_DISPATCH=uncached`` pins per-call
+    re-trace/re-compile dispatch (bitwise-comparison/measurement
+    hook; module docstring)."""
+    return os.environ.get(_ENV, "").lower() != "uncached"
+
+
+def cache_of(index) -> dict:
+    """The index's program cache, created on first use. Index types
+    that reject attribute writes get a throwaway dict (every call a
+    miss — correct, just uncached)."""
+    cache = getattr(index, _ATTR, None)
+    if cache is None:
+        cache = {}
+        try:
+            setattr(index, _ATTR, cache)
+        except (AttributeError, TypeError):
+            pass
+    return cache
+
+
+def mesh_tag(mesh) -> str:
+    """Platform/device-kind/axis-extent tag (the ``shape_bucket`` mesh
+    discipline of ``ops.ring_topk._bucket``)."""
+    dev = mesh.devices.flat[0]
+    kind = getattr(dev, "device_kind", dev.platform).replace(" ", "_")
+    axes = "x".join(f"{n}{s}" for n, s in mesh.shape.items())
+    return f"{dev.platform}-{kind}-{axes}"
+
+
+def topology_tag(topology) -> str:
+    """``<hosts>x<devs_per_host>`` for a fleet topology, ``flat``
+    otherwise — the hier merge bakes the host grouping into its trace."""
+    if topology is None:
+        return "flat"
+    return f"{int(topology.n_hosts)}x{int(topology.devs_per_host)}"
+
+
+def comms_tag(comms) -> str:
+    """Fingerprint of the communicator a merge closure bakes in: an
+    AxisComms is fully determined by (type, axis, size, groups). A
+    foreign comm type without those fields falls back to object
+    identity — correctness over sharing."""
+    if comms is None:
+        return "none"
+    name = type(comms).__name__
+    axis = getattr(comms, "axis", None)
+    size = getattr(comms, "_size", None)
+    groups = getattr(comms, "groups", None)
+    if axis is None and size is None and groups is None:
+        return f"{name}@{id(comms):x}"
+    return f"{name}/{axis}/{size}/{groups}"
+
+
+def program_key(family: str, engine, mesh, topology, comms,
+                statics) -> str:
+    """One cache key per distinct compiled program: everything the
+    closure bakes into its trace, and nothing jit already shape-keys."""
+    parts = [family, str(engine), mesh_tag(mesh), topology_tag(topology),
+             comms_tag(comms)]
+    parts += [f"{n}={v}" for n, v in statics]
+    return ":".join(parts)
+
+
+@contextlib.contextmanager
+def dispatch_label(family: str, m: int, k: int):
+    """Label this dispatch for the serve recompile watch (module
+    docstring). No-op inside a warmup sweep (the outer warmup context
+    must keep its exemption) or when serve is unimportable."""
+    try:
+        from ..serve import warmup as _wu
+    except Exception:  # noqa: BLE001 - telemetry must not fail a search
+        yield
+        return
+    if getattr(_wu._ctx, "warmup", False):
+        yield
+        return
+    with _wu.compile_context(f"sharded.{family}:{m}x{k}"):
+        yield
+
+
+def stats(index) -> dict:
+    """Cache introspection (debugz/tests): program count + keys."""
+    cache = getattr(index, _ATTR, None) or {}
+    return {"programs": len(cache), "keys": sorted(map(str, cache))}

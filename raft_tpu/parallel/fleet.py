@@ -86,7 +86,7 @@ from ..distance.distance_types import (DistanceType, canonical_metric,
 from ..neighbors import host_stream as hs
 from ..neighbors import ivf_flat, ivf_pq
 from ..utils import cdiv, hdot, shard_map_compat
-from . import sharded_ann
+from . import dispatch_cache, sharded_ann
 from .sharded_ann import ShardedIvfFlat, ShardedIvfPq
 from .topology import AXIS, Topology, detect, fleet_mesh, plan_merge, virtual
 
@@ -749,6 +749,15 @@ class Fleet:
         ctx["budget_bytes"] = int(budget)
         ctx["chunk_rows"] = max(1, int(float(chunk_mb) * (1 << 20))
                                 // max(int(row_bytes), 1))
+        # level-invariant chunk geometry: every budget-ladder level's
+        # cold chunks share ONE padded shape (row pin covers the largest
+        # list ANY level could shed; list pin covers all-cold), so a
+        # FleetTierController re-tier lands in the already-compiled
+        # cold-scan executables — zero recompiles, the same discipline
+        # _swap_resident applies to the resident slabs
+        lmax_g = int(sizes.max()) if sizes.size else 0
+        ctx["chunk_shape"] = (max(ctx["chunk_rows"], lmax_g, 1),
+                              int(sizes.shape[1]) + 1, lmax_g)
         # full cluster-sorted row offsets per shard (L+1), the tier
         # splitter's view of the pre-tier layout
         ctx["offsets_full"] = {
@@ -871,7 +880,8 @@ class Fleet:
                 arrays["norms"] = self._pq_row_norms(ctx, s)
             tier, hot_arrays, _, _ = hs.build_tier(
                 arrays, ctx["offsets_full"][s], sizes[s], hot,
-                ctx["chunk_rows"], pad_tail=0, fills=ctx["fills"])
+                ctx["chunk_rows"], pad_tail=0, fills=ctx["fills"],
+                chunk_shape=ctx.get("chunk_shape"))
             if ctx["store"] == "pq":
                 self._pq_chunk_extras(ctx, tier)
             index._fleet_tiers[s] = tier
@@ -1040,18 +1050,24 @@ class Fleet:
             for cd, ci_ in tier.stream(probed, run):
                 parts_d.append(ivf_flat._postprocess(mt, cd))
                 parts_i.append(ci_)
+        # fold chunk results in PAIRWISE (arity-2) merges: how many
+        # chunks a batch touches varies per batch AND per tier level,
+        # and a stacked (1+n_parts, m, k) merge forks one executable
+        # per arity — the fold keeps the cold merge on a single
+        # compiled shape regardless. Equal-output: select_k is stable,
+        # so a left fold preserves the multi-way merge's part-order tie
+        # priority.
         if jax.process_count() == 1:
-            if not parts_d:
-                return d, i
-            return knn_merge_parts(jnp.stack([d] + parts_d),
-                                   jnp.stack([i] + parts_i), select_min)
+            for pd, pi in zip(parts_d, parts_i):
+                d, i = knn_merge_parts(jnp.stack([d, pd]),
+                                       jnp.stack([i, pi]), select_min)
+            return d, i
         bad = jnp.inf if select_min else -jnp.inf
-        if parts_d:
-            ld, li = knn_merge_parts(jnp.stack(parts_d),
-                                     jnp.stack(parts_i), select_min)
-        else:
-            ld = jnp.full((q.shape[0], k), bad, jnp.float32)
-            li = jnp.full((q.shape[0], k), -1, jnp.int32)
+        ld = jnp.full((q.shape[0], k), bad, jnp.float32)
+        li = jnp.full((q.shape[0], k), -1, jnp.int32)
+        for pd, pi in zip(parts_d, parts_i):
+            ld, li = knn_merge_parts(jnp.stack([ld, pd]),
+                                     jnp.stack([li, pi]), select_min)
         from jax.experimental import multihost_utils
 
         gd = jnp.asarray(multihost_utils.process_allgather(ld))
@@ -1070,29 +1086,50 @@ class Fleet:
 
         ctx = index._fleet_ctx
         mt = ctx["metric"]
+        # the quantizer arrays are host copies in ctx: device_put them
+        # ONCE per index (dispatch_cache), not per search call — the
+        # cold merge runs on the serving path
+        cache = dispatch_cache.cache_of(index)
         if ctx["store"] == "pq":
-            q_rot = hdot(q, jnp.asarray(ctx["rotation"]).T)
+            dev = cache.get("cold:probe")
+            if dev is None:
+                dev = (jnp.asarray(ctx["rotation"]).T,
+                       jnp.asarray(ctx["centers_rot"]))
+                cache["cold:probe"] = dev
+            q_rot = hdot(q, dev[0])
             return np.asarray(coarse_probe(
-                q_rot, jnp.asarray(ctx["centers_rot"]), n_probes,
+                q_rot, dev[1], n_probes,
                 metric="ip" if mt is DistanceType.InnerProduct else "l2"))
         cmetric = ("ip" if mt is DistanceType.InnerProduct
                    else "cos" if mt is DistanceType.CosineExpanded
                    else "l2")
+        dev = cache.get("cold:probe")
+        if dev is None:
+            dev = (jnp.asarray(ctx["centers"]), jnp.asarray(ctx["cnorms"]))
+            cache["cold:probe"] = dev
         return np.asarray(coarse_probe(
-            q, jnp.asarray(ctx["centers"]), n_probes, metric=cmetric,
-            center_norms=jnp.asarray(ctx["cnorms"])))
+            q, dev[0], n_probes, metric=cmetric, center_norms=dev[1]))
 
     def _cold_runner(self, index, ctx, tier, q, k: int):
         """One chunk-scan closure for :meth:`HostTier.stream`: the XLA
         cold scorers from the single-host tiers, fed through a shim
         carrying only the fields they read (the fleet's stacked index
-        has no single-shard attribute layout to hand them)."""
+        has no single-shard attribute layout to hand them). The heavy
+        codebook/rotation device transfers are cached per index
+        (dispatch_cache) — only the thin shim is rebuilt per call; the
+        cold scorers themselves are eager jnp programs whose primitives
+        hit XLA's global executable cache (0 steady-state compiles)."""
         mt = ctx["metric"]
         if ctx["store"] == "pq":
+            cache = dispatch_cache.cache_of(index)
+            heavy = cache.get("cold:pq")
+            if heavy is None:
+                heavy = (jnp.asarray(ctx["books"]),
+                         jnp.asarray(ctx["rotation"]))
+                cache["cold:pq"] = heavy
             shim = types.SimpleNamespace(
                 pq_dim=int(ctx["pq_dim"]),
-                codebooks=jnp.asarray(ctx["books"]),
-                rotation=jnp.asarray(ctx["rotation"]),
+                codebooks=heavy[0], rotation=heavy[1],
                 metric=mt, _host_tier=tier)
             return lambda ci, dev, pl: ivf_pq._cold_chunk_xla_pq(
                 shim, dev, pl, q, k, None)
@@ -1102,6 +1139,36 @@ class Fleet:
         shim = types.SimpleNamespace(dim=int(ctx["dim"]), metric=mt)
         return lambda ci, dev, pl: ivf_flat._cold_chunk_xla_flat(
             shim, dev, pl, q, args, None)
+
+    def warmup_searchers(self, index, params=None, **opts) -> dict:
+        """``{rung_name: closure}`` mapping for
+        :func:`raft_tpu.serve.warmup.warmup`'s ``engines=`` sweep: the
+        base params plus one closure per host-loss auto-widen rung
+        (:func:`sharded_ann.widen_rungs`), each dispatched through
+        :meth:`search` so a budgeted index's cold-list merge warms
+        together with the resident executables. At full health
+        ``search`` leaves an explicit ``n_probes`` untouched
+        (``_effective_nprobe`` with ``served_frac=1`` is the identity),
+        so every rung compiles under EXACTLY the cache key the degraded
+        path will later hit — ``mark_host_failed`` → widened search
+        lands on a warmed bucket with zero compiles."""
+        fam = getattr(index, "family", "ivf_pq")
+        if fam == "ivf_flat":
+            sp = params or ivf_flat.SearchParams()
+            n_lists = int(index.centers.shape[1])
+        else:
+            sp = params or ivf_pq.SearchParams()
+            n_lists = int(index.centers_rot.shape[1])
+        base_np = min(int(sp.n_probes), n_lists)
+        engs = {"base": lambda q, kk, _sp=sp: self.search(
+            index, q, kk, params=_sp, **opts)}
+        for eff in sharded_ann.widen_rungs(index, sp.n_probes):
+            if eff == base_np:
+                continue               # already covered by "base"
+            spr = dataclasses.replace(sp, n_probes=eff)
+            engs[f"np{eff}"] = lambda q, kk, _sp=spr: self.search(
+                index, q, kk, params=_sp, **opts)
+        return engs
 
     # -- per-host memory accounting ---------------------------------------
     def host_memz(self) -> list:

@@ -49,11 +49,13 @@ from ..distance.distance_types import DistanceType, canonical_metric, is_min_clo
 from ..neighbors import cagra, ivf_flat, ivf_pq
 from ..ops import ring_topk
 from ..utils import cdiv, shard_map_compat
+from . import dispatch_cache
 
 __all__ = ["ShardedIvfFlat", "build_ivf_flat", "search_ivf_flat",
            "ShardedCagra", "build_cagra", "search_cagra",
            "ShardedIvfPq", "build_ivf_pq", "search_ivf_pq",
-           "make_searcher", "ops_snapshot", "health",
+           "make_searcher", "warmup_searchers", "widen_rungs",
+           "searcher_dim", "ops_snapshot", "health",
            "probe_shards", "probe_all"]
 
 AXIS = "shard"
@@ -80,17 +82,27 @@ _clock = time.monotonic
 _downed_at: dict = {}
 
 
-def _merged_shard_search(mesh, family: str, local_fn, in_specs, arrays,
-                         m: int, k: int, select_min: bool, comms,
-                         merge_engine=None, topology=None):
+def _merged_shard_search(index, family: str, make_local, in_specs, arrays,
+                         m: int, k: int, select_min: bool, comms, statics,
+                         merge_engine=None, topology=None, donate_q=None):
     """One chokepoint for every sharded family's cross-shard merge:
     resolve the engine (param/env override → autotune verdict → backend
     default; a multi-host ``topology`` adds the hierarchical ICI/DCN
-    tier), run ``local_fn`` (per-shard candidates, dead shards
-    already masked to sentinel rows) under ``shard_map`` with that
-    engine's merge, and gate every non-allgather engine behind
+    tier), fetch the cached jitted ``shard_map`` program for this
+    (engine, statics) bucket — tracing it ONCE on a miss from
+    ``make_local()``'s per-shard closure (dead shards already masked to
+    sentinel rows) — and gate every non-allgather engine behind
     ``guarded_call(MERGE_SITE)`` falling back to the bit-identical
-    allgather program. Returns replica-identical (distances, ids)."""
+    allgather program (which caches under its own key, so the fallback
+    is also trace-once). Returns replica-identical (distances, ids).
+
+    ``statics`` is the family's closure-baked (name, value) tuple — see
+    docs/perf.md "Sharded dispatch" for the key anatomy. ``donate_q``:
+    position of the replicated query array in ``arrays`` to donate to
+    the compiled program (make_searcher(donate=True)); None keeps the
+    caller's buffer. ``RAFT_TPU_SHARDED_DISPATCH=uncached`` restores
+    the eager per-call trace (the bitwise comparison hook)."""
+    mesh = index.mesh
     p = mesh.shape[AXIS]
     # ring engines permute over the raw mesh axis: an injected
     # communicator restricted to subgroups keeps the allgather path
@@ -98,15 +110,36 @@ def _merged_shard_search(mesh, family: str, local_fn, in_specs, arrays,
     eng = ring_topk.resolve_engine(m, k, p, override=merge_engine,
                                    plain_axis=plain_axis, mesh=mesh,
                                    topology=topology)
+    cache = dispatch_cache.cache_of(index)
+
+    def prog(e):
+        key = dispatch_cache.program_key(
+            family, e, mesh, topology, comms,
+            (("k", k), ("dq", donate_q is not None)) + tuple(statics))
+        fn = cache.get(key) if dispatch_cache.enabled() else None
+        if fn is None:
+            local_fn = make_local()
+
+            def body(*xs):
+                d, gi = local_fn(*xs)
+                return ring_topk.merge(d, gi, k, select_min, comms=comms,
+                                       axis=AXIS, axis_size=p, engine=e,
+                                       topology=topology)
+
+            sm = shard_map_compat(body, mesh=mesh,
+                                  in_specs=tuple(in_specs),
+                                  out_specs=(P(), P()), check=False)
+            fn = jax.jit(sm, donate_argnums=(
+                () if donate_q is None else (int(donate_q),)))
+            if dispatch_cache.enabled():
+                cache[key] = fn
+            # else: fresh wrapper per call — re-trace/re-compile the
+            # identical (bitwise) program; the measurement baseline
+        return fn
 
     def run(e):
-        def body(*xs):
-            d, gi = local_fn(*xs)
-            return ring_topk.merge(d, gi, k, select_min, comms=comms,
-                                   axis=AXIS, axis_size=p, engine=e,
-                                   topology=topology)
-        return shard_map_compat(body, mesh=mesh, in_specs=tuple(in_specs),
-                                out_specs=(P(), P()), check=False)(*arrays)
+        with dispatch_cache.dispatch_label(family, m, k):
+            return prog(e)(*arrays)
 
     return ring_topk.guarded_dispatch(family, eng, run)
 
@@ -278,8 +311,9 @@ def _canary_search(index, i: int, rows: int = 8) -> None:
     source arrays off the mesh, run an exact micro-search (rows vs
     themselves) on device, and require finite results. This exercises
     the shard's device round-trip and arithmetic without a ``shard_map``
-    dispatch (whose whole-program recompile is exactly the cost a
-    periodic probe loop must not pay). Raises on any failure."""
+    dispatch — even with the dispatch cache the first probe at an
+    unwarmed shape would pay a whole-program trace, and a canary must
+    stay cheap on a cold process. Raises on any failure."""
     site = f"sharded_ann.{index.family}.shard{i}"
     # armed shard faults keep the shard dead, so the recovery arc is
     # deterministically drillable: the probe fails while the fault
@@ -458,9 +492,18 @@ class ShardedIvfFlat:
 
     def max_rows(self, n_probes: int) -> int:
         """Static probe budget: max over shards of the n_probes largest
-        lists summed."""
-        return int(max(
-            ivf_flat._probe_budget(s, n_probes) for s in self._max_rows_tbl))
+        lists summed. A budget-tiered fleet index computes the bound
+        from the FULL size table (``_rows_tbl_full``): the live table
+        holds hot sizes that change across tier steps, and this static
+        is baked into the cached dispatch executables — the bound must
+        not move on a re-tier (the zero-recompile tier-step contract).
+        The full-table bound is a superset of any hot bound and the
+        extra gather slots are masked sentinel rows, so results are
+        bitwise unchanged."""
+        tbl = getattr(self, "_rows_tbl_full", None)
+        if tbl is None:
+            tbl = self._max_rows_tbl
+        return int(max(ivf_flat._probe_budget(s, n_probes) for s in tbl))
 
 
 def build_ivf_flat(dataset, mesh: Mesh,
@@ -513,15 +556,20 @@ def build_ivf_flat(dataset, mesh: Mesh,
 def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
                     params: ivf_flat.SearchParams | None = None,
                     res=None, allow_partial: bool = False,
-                    merge_engine: str | None = None, filter=None):  # noqa: A002
+                    merge_engine: str | None = None, filter=None,  # noqa: A002
+                    donate: bool = False):
     """Replicated queries → per-shard local search → cross-shard merge
-    (ring or allgather engine; see :func:`_merged_shard_search`).
+    (ring or allgather engine; see :func:`_merged_shard_search` — the
+    compiled program is cached per index, so repeat calls at a warmed
+    shape compile nothing).
 
     ``allow_partial=True`` accepts dead shards (``index.shards_ok`` or an
     armed ``shard_dead``/``shard_timeout`` fault): their contributions
     are masked out of the merge and the return becomes
     ``(distances, indices, shards_ok)`` reporting the loss. Default
     (False) raises :class:`ShardsDownError` when any shard is dead.
+    The health mask rides into the program as a TRACED argument, so
+    marking/restoring shards reuses the cached executable.
     ``merge_engine``: force one of ``ops.ring_topk.ENGINES`` (or
     ``"auto"``); default consults ``RAFT_TPU_SHARDED_MERGE`` and the
     autotune verdict for this shape bucket.
@@ -530,6 +578,9 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
     source ids are global, so the gather indexes it directly). A
     filtered row yields the same (+inf, -1) sentinel the dead-shard
     path emits, so the merge needs no new semantics.
+    ``donate=True`` donates the replicated query buffer to the compiled
+    program (docs/perf.md "Sharded dispatch" donation caveats: only
+    safe when the caller does not reuse ``queries``).
     """
     sp = params or ivf_flat.SearchParams()
     q = jnp.asarray(queries, jnp.float32)
@@ -547,22 +598,24 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
     int4_dim = (index.logical_dim
                 if getattr(index, "store", None) == "int4" else None)
 
-    def local(data, norms, gids, centers, cnorms, offsets, sizes, okf, qq,
-              *rest):
-        args = [a[0] for a in (data, norms, gids, centers, cnorms, offsets,
-                               sizes)]
-        sc = rest[0][0] if has_scales else None
-        mb = rest[int(has_scales)] if has_filter else None
-        d, i = ivf_flat.search_arrays(
-            args[0], args[1], args[2], args[3], args[4], args[5], args[6],
-            qq, k, n_probes, max_rows, mt, mask_bits=mb, scales=sc,
-            int4_dim=int4_dim)
-        # dead-shard containment: an invalid shard's list is all
-        # (+inf, -1) sentinel rows, so the merge is over survivors only
-        bad = jnp.inf if select_min else -jnp.inf
-        d = jnp.where(okf[0, 0], d, bad)
-        i = jnp.where(okf[0, 0], i, -1)
-        return d, i
+    def make_local():
+        def local(data, norms, gids, centers, cnorms, offsets, sizes, okf,
+                  qq, *rest):
+            args = [a[0] for a in (data, norms, gids, centers, cnorms,
+                                   offsets, sizes)]
+            sc = rest[0][0] if has_scales else None
+            mb = rest[int(has_scales)] if has_filter else None
+            d, i = ivf_flat.search_arrays(
+                args[0], args[1], args[2], args[3], args[4], args[5],
+                args[6], qq, k, n_probes, max_rows, mt, mask_bits=mb,
+                scales=sc, int4_dim=int4_dim)
+            # dead-shard containment: an invalid shard's list is all
+            # (+inf, -1) sentinel rows, so the merge is over survivors
+            bad = jnp.inf if select_min else -jnp.inf
+            d = jnp.where(okf[0, 0], d, bad)
+            i = jnp.where(okf[0, 0], i, -1)
+            return d, i
+        return local
 
     in_specs = [P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
                 P(AXIS, None, None), P(AXIS, None), P(AXIS, None),
@@ -570,16 +623,20 @@ def search_ivf_flat(index: ShardedIvfFlat, queries, k: int,
     arrays = [index.data, index.data_norms, index.source_ids,
               index.centers, index.center_norms, index.offsets,
               index.sizes, _shard_mask(index.mesh, ok), q]
+    q_pos = 8                          # q's slot, for donation
     if has_scales:
         in_specs.append(P(AXIS, None))
         arrays.append(index.scales)
     if has_filter:
         in_specs.append(P())           # replicated: gids are global
         arrays.append(mask)
-    d, i = _merged_shard_search(index.mesh, "ivf_flat", local, in_specs,
+    statics = (("np", n_probes), ("mr", max_rows), ("mt", mt.name),
+               ("sc", has_scales), ("f", has_filter), ("i4", int4_dim))
+    d, i = _merged_shard_search(index, "ivf_flat", make_local, in_specs,
                                 arrays, q.shape[0], k, select_min, comms,
-                                merge_engine,
-                                topology=getattr(index, "topology", None))
+                                statics, merge_engine,
+                                topology=getattr(index, "topology", None),
+                                donate_q=q_pos if donate else None)
     return (d, i, ok) if allow_partial else (d, i)
 
 
@@ -673,12 +730,13 @@ def build_cagra(dataset, mesh: Mesh,
 def search_cagra(index: ShardedCagra, queries, k: int,
                  params: cagra.SearchParams | None = None,
                  res=None, allow_partial: bool = False,
-                 merge_engine: str | None = None, filter=None):  # noqa: A002
+                 merge_engine: str | None = None, filter=None,  # noqa: A002
+                 donate: bool = False):
     """Replicated queries → per-shard graph traversal → cross-shard merge.
 
-    ``allow_partial``/``merge_engine``/``filter``: contract of
-    :func:`search_ivf_flat`. CAGRA shard rows are LOCAL (row = global id
-    - base), so each shard slices its window out of the replicated
+    ``allow_partial``/``merge_engine``/``filter``/``donate``: contract
+    of :func:`search_ivf_flat`. CAGRA shard rows are LOCAL (row = global
+    id - base), so each shard slices its window out of the replicated
     global mask and folds it into the padding-row validity mask that
     already rides ``_search_jit``'s filter slot.
     """
@@ -709,41 +767,49 @@ def search_cagra(index: ShardedCagra, queries, k: int,
             mask = jnp.pad(mask, (0, need - mask.shape[0]))
     has_filter = mask is not None
 
-    def local(data, graph, base, count, okf, qq, *rest):
-        # padding rows (beyond this shard's real count) are masked out so
-        # neither random nor covering seeding can surface them
-        valid = jnp.arange(data.shape[1], dtype=jnp.int32) < count[0]
-        seed_rows = rest[0][0] if has_seeds else None
-        if has_filter:
-            gm = rest[int(has_seeds)]
-            valid = valid & jax.lax.dynamic_slice(gm, (base[0],),
-                                                  (data.shape[1],))
-        # gather engine explicitly: shard-local data lives only inside
-        # this trace, so an edge-resident store can never be attached
-        d, i = cagra._search_jit(
-            data[0], data[0], None, graph[0], qq, valid,
-            jax.random.key(sp.seed), seed_rows, None, None, None, itopk,
-            width, int(max_iter), k, n_seeds, mt.value)
-        gi = jnp.where(i >= 0, i + base[0], -1)
-        gi = jnp.where(okf[0, 0], gi, -1)       # dead-shard containment
-        bad = jnp.inf if select_min else -jnp.inf
-        d = jnp.where(gi >= 0, d, bad)
-        return d, gi
+    def make_local():
+        def local(data, graph, base, count, okf, qq, *rest):
+            # padding rows (beyond this shard's real count) are masked
+            # out so neither random nor covering seeding surfaces them
+            valid = jnp.arange(data.shape[1], dtype=jnp.int32) < count[0]
+            seed_rows = rest[0][0] if has_seeds else None
+            if has_filter:
+                gm = rest[int(has_seeds)]
+                valid = valid & jax.lax.dynamic_slice(gm, (base[0],),
+                                                      (data.shape[1],))
+            # gather engine explicitly: shard-local data lives only
+            # inside this trace, so an edge-resident store can never be
+            # attached
+            d, i = cagra._search_jit(
+                data[0], data[0], None, graph[0], qq, valid,
+                jax.random.key(sp.seed), seed_rows, None, None, None,
+                itopk, width, int(max_iter), k, n_seeds, mt.value)
+            gi = jnp.where(i >= 0, i + base[0], -1)
+            gi = jnp.where(okf[0, 0], gi, -1)   # dead-shard containment
+            bad = jnp.inf if select_min else -jnp.inf
+            d = jnp.where(gi >= 0, d, bad)
+            return d, gi
+        return local
 
     in_specs = [P(AXIS, None, None), P(AXIS, None, None), P(AXIS), P(AXIS),
                 P(AXIS, None), P()]
     arrays = [index.data, index.graphs, index.bases, index.counts,
               _shard_mask(index.mesh, ok), q]
+    q_pos = 5                          # q's slot, for donation
     if has_seeds:
         in_specs.append(P(AXIS, None))
         arrays.append(index.seeds)
     if has_filter:
         in_specs.append(P())           # replicated; sliced per shard
         arrays.append(mask)
-    d, i = _merged_shard_search(index.mesh, "cagra", local, in_specs,
+    statics = (("itopk", itopk), ("w", width), ("it", int(max_iter)),
+               ("ns", n_seeds), ("rs", sp.seed), ("sd", has_seeds),
+               ("f", has_filter), ("mt", mt.name))
+    d, i = _merged_shard_search(index, "cagra", make_local, in_specs,
                                 arrays, q.shape[0], k, select_min, comms,
-                                merge_engine,
-                                topology=getattr(index, "topology", None))
+                                statics, merge_engine,
+                                topology=getattr(index, "topology", None),
+                                donate_q=q_pos if donate else None)
     return (d, i, ok) if allow_partial else (d, i)
 
 
@@ -788,8 +854,12 @@ class ShardedIvfPq:
         return self.mesh.shape[AXIS]
 
     def max_rows(self, n_probes: int) -> int:
-        return int(max(ivf_pq._probe_budget(s, n_probes)
-                       for s in self._sizes_host))
+        # full-table bound when budget-tiered (see
+        # ShardedIvfFlat.max_rows: tier steps must not move this static)
+        tbl = getattr(self, "_rows_tbl_full", None)
+        if tbl is None:
+            tbl = self._sizes_host
+        return int(max(ivf_pq._probe_budget(s, n_probes) for s in tbl))
 
 
 def build_ivf_pq(dataset, mesh: Mesh,
@@ -833,12 +903,13 @@ def build_ivf_pq(dataset, mesh: Mesh,
 def search_ivf_pq(index: ShardedIvfPq, queries, k: int,
                   params: ivf_pq.SearchParams | None = None,
                   res=None, allow_partial: bool = False,
-                  merge_engine: str | None = None, filter=None):  # noqa: A002
+                  merge_engine: str | None = None, filter=None,  # noqa: A002
+                  donate: bool = False):
     """Replicated queries → per-shard LUT search → cross-shard merge
     (knn_merge_parts.cuh:172 role, ring or allgather engine).
 
-    ``allow_partial``/``merge_engine``/``filter``: contract of
-    :func:`search_ivf_flat` (PQ shard source ids are global, so the
+    ``allow_partial``/``merge_engine``/``filter``/``donate``: contract
+    of :func:`search_ivf_flat` (PQ shard source ids are global, so the
     replicated mask indexes directly).
     """
     sp = params or ivf_pq.SearchParams()
@@ -857,18 +928,21 @@ def search_ivf_pq(index: ShardedIvfPq, queries, k: int,
     mask = filter.to_mask() if filter is not None else None
     has_filter = mask is not None
 
-    def local(codes, gids, centers, books, rots, offsets, sizes, okf, qq,
-              *rest):
-        mb = rest[0] if has_filter else None
-        shard = ivf_pq.Index(
-            codes[0], gids[0], centers[0], books[0], rots[0], dummy_off,
-            mt, index.pq_bits, index.codebook_kind)
-        d, i = ivf_pq._search_chunk(shard, qq, k, n_probes, max_rows,
-                                    offsets[0], sizes[0], mb, sp.lut_dtype)
-        i = jnp.where(okf[0, 0], i, -1)     # dead-shard containment
-        bad = jnp.inf if select_min else -jnp.inf
-        d = jnp.where(i >= 0, d, bad)       # padded rows carry id -1
-        return d, i
+    def make_local():
+        def local(codes, gids, centers, books, rots, offsets, sizes, okf,
+                  qq, *rest):
+            mb = rest[0] if has_filter else None
+            shard = ivf_pq.Index(
+                codes[0], gids[0], centers[0], books[0], rots[0],
+                dummy_off, mt, index.pq_bits, index.codebook_kind)
+            d, i = ivf_pq._search_chunk(shard, qq, k, n_probes, max_rows,
+                                        offsets[0], sizes[0], mb,
+                                        sp.lut_dtype)
+            i = jnp.where(okf[0, 0], i, -1)     # dead-shard containment
+            bad = jnp.inf if select_min else -jnp.inf
+            d = jnp.where(i >= 0, d, bad)       # padded rows carry id -1
+            return d, i
+        return local
 
     in_specs = [P(AXIS, None, None), P(AXIS, None), P(AXIS, None, None),
                 P(AXIS, *([None] * (index.codebooks.ndim - 1))),
@@ -877,24 +951,39 @@ def search_ivf_pq(index: ShardedIvfPq, queries, k: int,
     arrays = [index.codes, index.source_ids, index.centers_rot,
               index.codebooks, index.rotations, index.offsets,
               index.sizes, _shard_mask(index.mesh, ok), q]
+    q_pos = 8                          # q's slot, for donation
     if has_filter:
         in_specs.append(P())           # replicated: gids are global
         arrays.append(mask)
-    d, i = _merged_shard_search(index.mesh, "ivf_pq", local, in_specs,
+    statics = (("np", n_probes), ("mr", max_rows), ("mt", mt.name),
+               ("lut", np.dtype(sp.lut_dtype).name), ("f", has_filter),
+               ("b", index.pq_bits),
+               ("ck", getattr(index.codebook_kind, "name",
+                              index.codebook_kind)))
+    d, i = _merged_shard_search(index, "ivf_pq", make_local, in_specs,
                                 arrays, q.shape[0], k, select_min, comms,
-                                merge_engine,
-                                topology=getattr(index, "topology", None))
+                                statics, merge_engine,
+                                topology=getattr(index, "topology", None),
+                                donate_q=q_pos if donate else None)
     return (d, i, ok) if allow_partial else (d, i)
 
 
 def make_searcher(index, params=None, *, allow_partial: bool = False,
-                  **opts):
+                  donate: bool = False, **opts):
     """Stable batchable signature for the serving runtime
     (:mod:`raft_tpu.serve`), dispatching on the sharded index type:
     returns ``fn(queries, k, res=None) -> (distances, indices)`` — or,
     with ``allow_partial=True``, ``(distances, indices, shards_ok)`` so
     the batcher can serve degraded answers through dead shards and
-    surface the loss in its metrics and per-request responses."""
+    surface the loss in its metrics and per-request responses.
+
+    The closure hits the index's compiled-program cache: after a
+    :func:`~raft_tpu.serve.warmup.warmup` sweep (or one cold call per
+    shape bucket), repeat dispatches compile nothing. ``donate=True``
+    donates the replicated query buffer to the cached program (the
+    batcher's double-buffered closures pass freshly-built batches that
+    are never reused); leave False when callers keep their query
+    arrays — see docs/perf.md "Sharded dispatch" donation caveats."""
     fns = {ShardedIvfFlat: search_ivf_flat,
            ShardedCagra: search_cagra,
            ShardedIvfPq: search_ivf_pq}
@@ -904,6 +993,96 @@ def make_searcher(index, params=None, *, allow_partial: bool = False,
 
     def _fn(queries, k, res=None):
         return fn(index, queries, k, params, res=res,
-                  allow_partial=allow_partial, **opts)
+                  allow_partial=allow_partial, donate=donate, **opts)
 
     return _fn
+
+
+def searcher_dim(index) -> int:
+    """Query dimensionality a sharded/fleet index expects — what a
+    warmup sweep should size its dummy batches to."""
+    if hasattr(index, "logical_dim"):          # ShardedIvfFlat
+        return int(index.logical_dim)
+    if hasattr(index, "rotations"):            # ShardedIvfPq
+        return int(index.rotations.shape[-1])
+    if hasattr(index, "dataset"):              # sharded_knn.ShardedIndex
+        return int(index.dataset.shape[1])
+    return int(index.data.shape[-1])           # ShardedCagra
+
+
+def widen_rungs(index, n_probes: int | None = None) -> list:
+    """Every effective ``n_probes`` the degradation auto-widen
+    (``fleet._effective_nprobe``) can reach from ``n_probes`` on this
+    index — the ladder a warmup sweep must pre-compile so a host loss
+    lands on an already-cached executable instead of a fresh trace.
+
+    Loss granularity follows the index: host-granular when a multi-host
+    topology is adopted (a DCN partition takes whole hosts), shard-
+    granular otherwise. Survivor subsets are enumerated exactly up to
+    10 units (handles row skew); larger fleets warm the uniform
+    ``j/u`` fractions. CAGRA has no probe ladder — returns ``[]``."""
+    from . import fleet as _fleet    # lazy: fleet imports this module
+
+    if isinstance(index, ShardedCagra):
+        return []
+    centers = (index.centers if isinstance(index, ShardedIvfFlat)
+               else index.centers_rot)
+    n_lists = int(centers.shape[1])
+    if n_probes is None:
+        n_probes = (ivf_flat.SearchParams().n_probes
+                    if isinstance(index, ShardedIvfFlat)
+                    else ivf_pq.SearchParams().n_probes)
+    npb = min(int(n_probes), n_lists)
+    h = health(index)
+    rows = np.asarray(h["shard_rows"], np.int64)
+    total = max(int(h["n_total"]), 1)
+    topo = getattr(index, "topology", None)
+    if topo is not None and getattr(topo, "n_hosts", 1) > 1:
+        dph = int(topo.devs_per_host)
+        units = [int(rows[i * dph:(i + 1) * dph].sum())
+                 for i in range(int(topo.n_hosts))]
+    else:
+        units = [int(r) for r in rows]
+    u = len(units)
+    fracs = set()
+    if u <= 10:
+        for bits in range(1, 2 ** u):    # every non-empty survivor set
+            served = sum(r for j, r in enumerate(units) if bits >> j & 1)
+            fracs.add(served / total)
+    else:
+        fracs.update(j / u for j in range(1, u + 1))
+    rungs = {npb}
+    for f in fracs:
+        rungs.add(_fleet._effective_nprobe(npb, f, n_lists))
+    return sorted(rungs)
+
+
+def warmup_searchers(index, params=None, **opts) -> dict:
+    """``{rung_name: closure}`` mapping for
+    :func:`raft_tpu.serve.warmup.warmup`'s ``engines=`` sweep: the base
+    params plus one cache-hitting closure per :func:`widen_rungs` rung,
+    so the warmup pass pre-compiles the whole degraded ``n_probes``
+    ladder. Each closure searches with ``n_probes`` REPLACED by the
+    rung value — exactly the params the fleet's auto-widen will
+    produce, so a later host loss lands on the warmed key. (The health
+    mask itself is a traced argument: no rung needs a dead shard to
+    compile.) Budget-tiered fleet indexes should warm through
+    :meth:`~raft_tpu.parallel.fleet.Fleet.warmup_searchers` instead,
+    which also drives the cold-list merge."""
+    import dataclasses
+
+    engs = {"base": make_searcher(index, params, **opts)}
+    if isinstance(index, ShardedCagra):
+        return engs
+    sp = params or (ivf_flat.SearchParams()
+                    if isinstance(index, ShardedIvfFlat)
+                    else ivf_pq.SearchParams())
+    centers = (index.centers if isinstance(index, ShardedIvfFlat)
+               else index.centers_rot)
+    base_np = min(int(sp.n_probes), int(centers.shape[1]))
+    for eff in widen_rungs(index, sp.n_probes):
+        if eff == base_np:
+            continue                   # already covered by "base"
+        engs[f"np{eff}"] = make_searcher(
+            index, dataclasses.replace(sp, n_probes=eff), **opts)
+    return engs

@@ -43,7 +43,7 @@ import numpy as np
 from ..core.errors import expects
 
 __all__ = ["CompileCounter", "count_compilations", "warmup",
-           "install_recompile_watch", "compile_context"]
+           "warmup_sharded", "install_recompile_watch", "compile_context"]
 
 
 class CompileCounter:
@@ -226,3 +226,39 @@ def warmup(search_fn, ladder, dim: int, dtype=np.float32, registry=None,
     reg.gauge(f"{name}.warmup.shapes").set(n_shapes)
     reg.counter(f"{name}.warmup.compiles").inc(cc.count)
     return cc.count
+
+
+def warmup_sharded(index, k_buckets, m_buckets=(8, 64), *, dim=None,
+                   dtype=np.float32, params=None, registry=None,
+                   name: str = "sharded", fleet=None, **opts) -> int:
+    """Pre-compile a sharded/fleet index's dispatch ladder: every
+    (m-bucket × k-bucket) shape, for the base params AND every
+    degradation auto-widen ``n_probes`` rung a shard/host loss can
+    produce (:func:`raft_tpu.parallel.sharded_ann.widen_rungs`) — so a
+    ``mark_host_failed`` widen or a tier step lands on a cached
+    executable with ZERO compiles, and steady-state sharded serving
+    never traces.
+
+    The searchers themselves stay sync-free on the hot path — all the
+    blocking happens here, inside the warmup compile context, so the
+    sweep's compiles are counted but exempt from ``serve.recompiles``
+    and the ``xla_compile`` ring (module docstring).
+
+    ``fleet``: pass the owning :class:`~raft_tpu.parallel.fleet.Fleet`
+    for fleet-adopted indexes — the rung closures then dispatch through
+    ``Fleet.search`` so a budgeted build's cold-list merge warms with
+    the resident programs. ``dim`` defaults to the index's query
+    dimensionality; extra ``opts`` reach the searchers (e.g.
+    ``allow_partial=True``, ``merge_engine=``). Returns the compile
+    count of the sweep (0 when already warm)."""
+    from ..parallel import sharded_ann
+
+    if fleet is not None:
+        engines = fleet.warmup_searchers(index, params, **opts)
+    else:
+        engines = sharded_ann.warmup_searchers(index, params, **opts)
+    if dim is None:
+        dim = sharded_ann.searcher_dim(index)
+    shapes = [(int(mb), int(kb)) for mb in m_buckets for kb in k_buckets]
+    return warmup(None, None, dim, dtype, registry=registry, name=name,
+                  engines=engines, shapes=shapes)

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Bench-lane artifact validator: machine-check ``bench_*.json`` files.
+
+``bench.py`` lanes that write acceptance artifacts (currently the
+``fleet_ladder`` lane behind ``RAFT_TPU_BENCH_FLEET_LADDER``) self-check
+while they run, but the ARTIFACT is what lands in review — this script
+re-derives the acceptance criteria from the file alone, so a stale,
+truncated, or hand-edited artifact fails loudly.
+
+All lanes:
+
+* the whole file is strict JSON (``allow_nan=False`` round-trip) with
+  schema ``raft_tpu_bench_v1`` and a recognised ``lane``.
+
+``fleet_ladder`` lane (ISSUE 19, docs/mnmg.md "Per-host storage tiers"):
+
+* one entry per storage rung, in ladder order
+  (float32 -> int8 -> int4 -> pq), named ``fleet_ladder.<topo>.<rung>``;
+* per-host device bytes are monotone non-increasing down the ladder and
+  every narrower rung's ``bytes_vs_float32`` is < 1;
+* exact rungs (float32/int8/int4) carry ``bitwise_vs_unbudgeted`` true
+  and identical budgeted/unbudgeted recall — a capacity number from a
+  build that changed the answers is worthless;
+* the pq rung holds >= 0.95x its unbudgeted refined recall AND serves
+  from <= 1/4 the per-host device bytes of the fully-resident float32
+  build (``bytes_vs_float32_resident``) — the headline capacity claim;
+* every rung that spilled lists cold stays near the per-host budget
+  (resident bytes <= 1.25x budget: quantizer/offset overhead rides on
+  top of the row budget, a 2x overshoot means the planner is broken).
+
+``sharded_dispatch`` lane (ISSUE 20, docs/perf.md "Sharded dispatch",
+written by ``scratch/run_fleet_dryrun.py``):
+
+* steady-state repeat calls compile ZERO XLA programs
+  (``programs_per_call_steady == 0``) — the one-trace acceptance;
+* the uncached baseline (``programs_per_call_before``) compiles at
+  least one program per call, or the comparison is vacuous;
+* results are bitwise-equal between the cached and uncached dispatch
+  and the steady-state dispatch p50 is present and positive.
+
+Usage::
+
+    python scratch/check_bench_artifact.py artifacts/bench_fleet_ladder.json
+
+Exit status: 0 = valid, 1 = acceptance failure, 2 = unreadable/schema.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+RUNGS = ("float32", "int8", "int4", "pq")
+ENTRY_KEYS = ("algo", "name", "qps", "recall", "recall_unbudgeted",
+              "store", "topology", "rows_per_host",
+              "device_bytes_per_host",
+              "device_bytes_per_host_unbudgeted",
+              "host_tier_bytes_per_host", "bytes_per_vector",
+              "hbm_budget_bytes_per_host", "cold_lists_per_host",
+              "bitwise_vs_unbudgeted")
+
+
+def check_fleet_ladder(art: dict, errs: list) -> str:
+    entries = art.get("entries", [])
+    by_store = {e.get("store"): e for e in entries}
+    if [e.get("store") for e in entries] != list(RUNGS):
+        errs.append(f"expected one entry per rung {RUNGS}, got "
+                    f"{[e.get('store') for e in entries]}")
+        return ""
+    topo = art.get("topology")
+    budget = art.get("hbm_budget_bytes_per_host")
+    for e in entries:
+        missing = [k for k in ENTRY_KEYS if k not in e]
+        if missing:
+            errs.append(f"{e.get('name')}: missing keys {missing}")
+            continue
+        if e["name"] != f"fleet_ladder.{topo}.{e['store']}":
+            errs.append(f"entry name {e['name']!r} does not match "
+                        f"lane topology {topo!r}")
+        if e["hbm_budget_bytes_per_host"] != budget:
+            errs.append(f"{e['name']}: per-entry budget "
+                        f"{e['hbm_budget_bytes_per_host']} != lane "
+                        f"budget {budget}")
+        if not (isinstance(e["qps"], (int, float)) and e["qps"] > 0):
+            errs.append(f"{e['name']}: qps not positive: {e['qps']!r}")
+
+    # -- ladder monotonicity ----------------------------------------------
+    for a, b in zip(RUNGS, RUNGS[1:]):
+        ba = by_store[a]["device_bytes_per_host"]
+        bb = by_store[b]["device_bytes_per_host"]
+        if bb > ba:
+            errs.append(f"ladder not monotone: {b} uses {bb:,} B/host "
+                        f"> {a} {ba:,}")
+    for rung in RUNGS[1:]:
+        r = by_store[rung].get("bytes_vs_float32")
+        if not (isinstance(r, (int, float)) and r < 1.0):
+            errs.append(f"{rung}: bytes_vs_float32 {r!r} not < 1")
+
+    # -- exact rungs: budgeting must not change the answers ----------------
+    for rung in ("float32", "int8", "int4"):
+        e = by_store[rung]
+        if e["bitwise_vs_unbudgeted"] is not True:
+            errs.append(f"{rung}: bitwise_vs_unbudgeted is "
+                        f"{e['bitwise_vs_unbudgeted']!r}")
+        if e["recall"] != e["recall_unbudgeted"]:
+            errs.append(f"{rung}: budgeted recall {e['recall']} != "
+                        f"unbudgeted {e['recall_unbudgeted']}")
+
+    # -- pq rung: refined recall floor + the 1/4-capacity claim ------------
+    pq = by_store["pq"]
+    if pq["recall_unbudgeted"] <= 0:
+        errs.append("pq: unbudgeted recall is zero")
+    elif pq["recall"] < 0.95 * pq["recall_unbudgeted"]:
+        errs.append(f"pq: budgeted recall {pq['recall']} < 0.95x "
+                    f"unbudgeted {pq['recall_unbudgeted']}")
+    rr = pq.get("bytes_vs_float32_resident")
+    if not (isinstance(rr, (int, float)) and rr <= 0.25):
+        errs.append(f"pq: bytes_vs_float32_resident {rr!r} not <= 0.25 "
+                    f"(the per-host capacity acceptance)")
+
+    # -- budget respected wherever the planner spilled cold ----------------
+    for e in entries:
+        cold = sum(e["cold_lists_per_host"].values())
+        if cold and e["device_bytes_per_host"] > 1.25 * budget:
+            errs.append(f"{e['name']}: {cold} cold lists yet "
+                        f"{e['device_bytes_per_host']:,} B/host > 1.25x "
+                        f"budget {budget:,}")
+
+    pq_r = by_store["pq"].get("bytes_vs_float32")
+    return (f"{len(entries)} rungs on {topo}, budget {budget:,} B/host, "
+            f"pq at {pq_r}x of f32 bytes with recall {pq['recall']} "
+            f"({pq['recall_unbudgeted']} unbudgeted)")
+
+
+def check_sharded_dispatch(art: dict, errs: list) -> str:
+    for key in ("programs_per_call_before", "programs_per_call_steady",
+                "dispatch_p50_ms", "bitwise_equal", "m", "k"):
+        if key not in art:
+            errs.append(f"missing key {key!r}")
+    if errs:
+        return ""
+    steady = art["programs_per_call_steady"]
+    before = art["programs_per_call_before"]
+    p50 = art["dispatch_p50_ms"]
+    if steady != 0:
+        errs.append(f"steady-state repeat call compiled {steady!r} XLA "
+                    "programs (must be exactly 0 — the one-trace "
+                    "acceptance)")
+    if not (isinstance(before, int) and before > 0):
+        errs.append(f"uncached baseline compiled {before!r} programs "
+                    "per call; expected > 0, else the before/after "
+                    "comparison is vacuous")
+    if not (isinstance(p50, (int, float)) and p50 > 0):
+        errs.append(f"dispatch_p50_ms not positive: {p50!r}")
+    if art["bitwise_equal"] is not True:
+        errs.append(f"bitwise_equal is {art['bitwise_equal']!r}: cached "
+                    "dispatch changed the answers")
+    return (f"programs/call {before} -> {steady} steady-state, "
+            f"p50 {p50} ms at m={art['m']} k={art['k']}")
+
+
+LANES = {"fleet_ladder": check_fleet_ladder,
+         "sharded_dispatch": check_sharded_dispatch}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("artifact", help="path to a bench lane artifact")
+    args = ap.parse_args()
+
+    try:
+        with open(args.artifact) as f:
+            art = json.load(f)
+        json.dumps(art, allow_nan=False)
+    except (OSError, ValueError) as exc:
+        print(f"SCHEMA: cannot load strict-JSON artifact: {exc}")
+        return 2
+    lane = art.get("lane")
+    if art.get("schema") != "raft_tpu_bench_v1" or lane not in LANES:
+        print(f"SCHEMA: schema={art.get('schema')!r} lane={lane!r} "
+              f"(known: {sorted(LANES)})")
+        return 2
+
+    errs = []
+    summary = LANES[lane](art, errs)
+    if errs:
+        for e in errs:
+            print(f"FAIL: {e}")
+        return 1
+    print(f"OK: {args.artifact}: lane {lane}, {summary}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,396 @@
+"""2-process loopback-DCN fleet dryrun: the MNMG acceptance harness.
+
+Orchestrates three child processes of itself (the MULTICHIP-lane
+pattern of tests/test_distributed.py):
+
+- ``--ref``: ONE process, 4 virtual CPU devices, ``Fleet.virtual(2, 2)``
+  — builds the distributed IVF-PQ index and searches it, printing a
+  sha256 digest of the (distances, ids) bytes. The ref also measures the
+  sharded-dispatch lane — XLA programs per repeat call with the
+  per-index executable cache disabled (fresh jit per call) vs enabled
+  (must be 0) plus the steady-state dispatch p50 — which ``main()``
+  writes to ``artifacts/bench_sharded_dispatch.json``
+  (checked by ``scratch/check_bench_artifact.py``).
+- ``--worker`` x2: 2 virtual CPU devices each, joined over loopback DCN
+  via gloo. Workers bootstrap through the ``RAFT_TPU_*`` env autodetect
+  path (``bootstrap.init_distributed()`` with NO args, then
+  ``Fleet.distributed()`` hitting the idempotent re-init guard), build
+  the same index, and run the full degradation arc:
+
+  1. healthy search — digest must equal the ref's (the determinism
+     contract: a 2-process 2x2 fleet builds and searches BIT-IDENTICAL
+     to a 1-process virtual 2x2 fleet);
+  2. ``mark_host_failed(1)`` — partial results with host-granular
+     ``shards_ok``, no dead-host row ids leak, and the auto-widened
+     ``n_probes`` keeps recall (vs ground truth over SURVIVING rows —
+     vs full GT the ceiling is served_frac, by construction) at
+     >= 0.9x the healthy recall (vs full GT);
+  3. ``probe_hosts()`` re-admits host 1; the post-restore search digest
+     must equal the healthy one.
+
+Exit 0 = every assertion passed on every child (or SKIPPED: the gloo
+CPU-collectives clique can't form in this sandbox); exit 1 = failure.
+
+Usage:  python scratch/run_fleet_dryrun.py
+"""
+import hashlib
+import os
+import socket
+import subprocess
+import sys
+
+_HERE = os.path.abspath(__file__)
+_ROOT = os.path.dirname(os.path.dirname(_HERE))
+
+N, DIM, M, K = 2048, 16, 64, 10
+N_LISTS, NPROBE = 8, 4
+# per-host HBM budget for the budgeted leg: int8 rows are DIM+12=28 B,
+# each host carries N/2=1024 rows = 28672 B — 16 kB forces roughly half
+# of every host's lists cold (the budget arc is exercised, not skipped)
+BUDGET_BYTES = 16_000
+
+
+def _dataset():
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    base = rng.standard_normal((N, DIM)).astype(np.float32)
+    q = rng.standard_normal((M, DIM)).astype(np.float32)
+    return base, q
+
+
+def _params():
+    from raft_tpu.neighbors import ivf_pq
+
+    return ivf_pq.IndexParams(n_lists=N_LISTS, pq_dim=8, pq_bits=4,
+                              kmeans_n_iters=6, seed=3)
+
+
+def _sparams():
+    from raft_tpu.neighbors import ivf_pq
+
+    return ivf_pq.SearchParams(n_probes=NPROBE)
+
+
+def _gt(base, q, k, rows=None):
+    """Exact top-k ids over ``base[rows]`` (GLOBAL ids), host numpy."""
+    import numpy as np
+
+    rows = np.arange(len(base)) if rows is None else np.asarray(rows)
+    sub = base[rows]
+    d2 = ((q[:, None, :] - sub[None, :, :]) ** 2).sum(-1)
+    return rows[np.argsort(d2, axis=1, kind="stable")[:, :k]]
+
+
+def _recall(found, want):
+    hits = sum(len(set(found[i].tolist()) & set(want[i].tolist()))
+               for i in range(len(want)))
+    return hits / want.size
+
+
+def _digest(d, i):
+    import numpy as np
+
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(np.asarray(d)).tobytes())
+    h.update(np.ascontiguousarray(np.asarray(i)).tobytes())
+    return h.hexdigest()
+
+
+def run_ref() -> None:
+    import numpy as np
+
+    sys.path.insert(0, _ROOT)
+    from raft_tpu.parallel import Fleet
+
+    fleet = Fleet.virtual(2, 2)
+    base, q = _dataset()
+    idx = fleet.build_ivf_pq(base, _params())
+    d, i, ok = fleet.search(idx, q, K, _sparams())
+    assert list(ok) == [True] * 4, ok
+    rec = _recall(np.asarray(i), _gt(base, q, K))
+    print(f"REF_DIGEST {_digest(d, i)}", flush=True)
+
+    # budgeted leg: an int8-rung build under a per-host HBM budget must
+    # serve the same answers as the unbudgeted int8 build (exact rung:
+    # same probed lists, same per-candidate dot products)
+    from raft_tpu.neighbors import ivf_flat
+
+    sp = ivf_flat.SearchParams(n_probes=NPROBE)
+    i8 = fleet.build_ivf_pq(base, _params(), store_dtype="int8")
+    d8, i8d, _ = fleet.search(i8, q, K, sp)
+    bud = fleet.build_ivf_pq(base, _params(), store_dtype="int8",
+                             hbm_budget_gb=BUDGET_BYTES / 2 ** 30,
+                             sample_queries=q)
+    assert all((~m).any() for m in bud._fleet_ctx["hot"].values()), \
+        "budget did not force any cold lists"
+    db, ib, okb = fleet.search(bud, q, K, sp)
+    assert list(okb) == [True] * 4, okb
+    # exact rung: the budgeted build must return the SAME neighbors as
+    # the unbudgeted one. Ids compare bitwise; distances to a few ulp —
+    # since the dispatch moved from eager per-op execution to cached
+    # compiled programs (docs/perf.md "Sharded dispatch"), the hot-slab
+    # and full-resident programs are differently-shaped XLA programs
+    # whose fusion may associate the same f32 sums differently. The
+    # cross-process digests below stay bitwise: both sides run the
+    # same-shaped compiled programs.
+    assert (np.asarray(ib) == np.asarray(i8d)).all(), \
+        "budgeted int8 ids != unbudgeted int8 ids"
+    np.testing.assert_allclose(np.asarray(db), np.asarray(d8), rtol=0,
+                               atol=1e-4)
+    print(f"REF_BUDGET_DIGEST {_digest(db, ib)}", flush=True)
+
+    # sharded-dispatch lane: XLA programs per repeat call before/after
+    # the per-index compiled-program cache (the PR's hard number:
+    # fleet-many -> 0 steady-state) plus the steady-state dispatch p50.
+    # Measured on the BUDGETED index so the cold host-streamed path is
+    # in the loop, not just the resident shard_map. "before" forces the
+    # uncached baseline — a fresh jit wrapper per call that re-traces
+    # and re-compiles the identical (bitwise) program.
+    import json
+    import statistics
+    import time
+
+    import jax
+
+    from raft_tpu.serve import warmup as wu
+
+    os.environ["RAFT_TPU_SHARDED_DISPATCH"] = "uncached"
+    try:
+        fleet.search(bud, q, K, sp)      # one-time eager compiles primed
+        with wu.count_compilations() as c_before:
+            du, iu, _ = fleet.search(bud, q, K, sp)
+        jax.block_until_ready((du, iu))
+    finally:
+        del os.environ["RAFT_TPU_SHARDED_DISPATCH"]
+    assert _digest(du, iu) == _digest(db, ib), \
+        "uncached dispatch != cached dispatch (bitwise)"
+    with wu.count_compilations() as c_steady:
+        ds, js, _ = fleet.search(bud, q, K, sp)
+    jax.block_until_ready((ds, js))
+    assert c_steady.count == 0, \
+        f"steady-state repeat call compiled {c_steady.count} programs"
+    assert _digest(ds, js) == _digest(db, ib), "steady != primed (bitwise)"
+    lat = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        dl, il, _ = fleet.search(bud, q, K, sp)
+        jax.block_until_ready((dl, il))
+        lat.append(time.perf_counter() - t0)
+    payload = {
+        "programs_per_call_before": int(c_before.count),
+        "programs_per_call_steady": int(c_steady.count),
+        "dispatch_p50_ms": round(statistics.median(lat) * 1e3, 3),
+        "m": M, "k": K, "n_probes": NPROBE, "bitwise_equal": True,
+    }
+    # no spaces in the JSON: _extract() takes the second whitespace field
+    print("REF_DISPATCH " + json.dumps(payload, separators=(",", ":")),
+          flush=True)
+    print(f"REF_OK recall={rec:.4f}", flush=True)
+
+
+def run_worker() -> None:
+    import jax
+
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    import numpy as np
+
+    sys.path.insert(0, _ROOT)
+    # bootstrap FIRST (before anything touches the XLA backend), through
+    # the env-autodetect path — the parent set RAFT_TPU_COORDINATOR/
+    # _NUM_PROCESSES/_PROCESS_ID, the worker passes nothing
+    from raft_tpu.comms import bootstrap
+
+    cfg = bootstrap.init_distributed()
+    assert cfg["distributed"] and cfg["num_processes"] == 2, cfg
+
+    from raft_tpu.core import events
+    from raft_tpu.parallel import Fleet, sharded_ann
+
+    fleet = Fleet.distributed()      # idempotent re-init guard path
+    topo = fleet.topology
+    assert (topo.n_hosts, topo.devs_per_host) == (2, 2), topo
+    base, q = _dataset()
+    idx = fleet.build_ivf_pq(base, _params())
+    assert getattr(idx, "topology", None) is topo
+
+    # 1. healthy: bit-identity digest vs the single-process reference
+    d, i, ok = fleet.search(idx, q, K, _sparams())
+    assert list(ok) == [True] * 4, ok
+    healthy = _recall(np.asarray(i), _gt(base, q, K))
+    print(f"WORKER_DIGEST {_digest(d, i)}", flush=True)
+
+    # 2. host loss: host 1's shards go dark (both ranks mark — SPMD)
+    fleet.mark_host_failed(1)
+    hh = fleet.host_health()
+    assert hh["hosts_ok"] == [True, False], hh
+    assert abs(hh["served_frac"] - 0.5) < 0.05, hh
+    d2, i2, ok2 = fleet.search(idx, q, K, _sparams())
+    assert list(ok2) == [True, True, False, False], ok2
+    parts = sharded_ann._split_rows(N, 4)
+    surv = np.concatenate([parts[0], parts[1]])
+    surv_set = set(surv.tolist())
+    ii2 = np.asarray(i2)
+    leaked = [x for x in ii2.ravel().tolist()
+              if x != -1 and x not in surv_set]
+    assert not leaked, f"dead-host rows leaked into results: {leaked[:8]}"
+    degraded = _recall(ii2, _gt(base, q, K, rows=surv))
+    assert degraded >= 0.9 * healthy, (degraded, healthy)
+
+    # 3. recovery: canary re-admission restores bit-identical serving
+    rep = fleet.probe_hosts()
+    assert rep["hosts_restored"] == [1], rep
+    assert fleet.host_health()["served_frac"] == 1.0
+    d3, i3, ok3 = fleet.search(idx, q, K, _sparams())
+    assert list(ok3) == [True] * 4, ok3
+    assert _digest(d3, i3) == _digest(d, i), "post-restore != healthy"
+
+    # 4. budgeted int8 build: every rank plans the same fleet-wide
+    # hot/cold split (only count tables cross DCN), streams its OWN
+    # hosts' cold chunks, and the folded result must be bit-identical
+    # to the single-process budgeted reference
+    from raft_tpu.neighbors import ivf_flat
+
+    sp = ivf_flat.SearchParams(n_probes=NPROBE)
+    bud = fleet.build_ivf_pq(base, _params(), store_dtype="int8",
+                             hbm_budget_gb=BUDGET_BYTES / 2 ** 30,
+                             sample_queries=q)
+    assert all((~m).any() for m in bud._fleet_ctx["hot"].values()), \
+        "budget did not force any cold lists"
+    # this rank holds tiers only for its own hosts' shards
+    my = set(topo.shards_of(jax.process_index()))
+    assert set(bud._fleet_tiers) == my, (set(bud._fleet_tiers), my)
+    db, ib, okb = fleet.search(bud, q, K, sp)
+    assert list(okb) == [True] * 4, okb
+    print(f"WORKER_BUDGET_DIGEST {_digest(db, ib)}", flush=True)
+
+    kinds = [e["kind"] for e in events.recent()]
+    for want in ("fleet_build", "host_lost", "host_restored",
+                 "host_tier_armed"):
+        assert want in kinds, (want, kinds)
+    print(f"WORKER_OK rank={jax.process_index()} healthy={healthy:.4f} "
+          f"degraded_vs_survivors={degraded:.4f}", flush=True)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _extract(out: str, tag: str):
+    for line in out.splitlines():
+        if line.startswith(tag + " "):
+            return line.split()[1]
+    return None
+
+
+def main() -> int:
+    base_env = dict(os.environ)
+    base_env["JAX_PLATFORMS"] = "cpu"
+    base_env.pop("XLA_FLAGS", None)
+    for k in ("RAFT_TPU_COORDINATOR", "RAFT_TPU_NUM_PROCESSES",
+              "RAFT_TPU_PROCESS_ID"):
+        base_env.pop(k, None)
+
+    env = dict(base_env,
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    ref = subprocess.run([sys.executable, _HERE, "--ref"], env=env,
+                         capture_output=True, text=True, timeout=600)
+    if ref.returncode != 0:
+        print(ref.stdout + ref.stderr)
+        print("FAIL: single-process reference errored")
+        return 1
+    ref_digest = _extract(ref.stdout, "REF_DIGEST")
+    print(f"# ref: digest={ref_digest}")
+
+    disp = _extract(ref.stdout, "REF_DISPATCH")
+    if disp is None:
+        print(ref.stdout)
+        print("FAIL: reference did not report the dispatch measurement")
+        return 1
+    import json
+    payload = json.loads(disp)
+    art = {"schema": "raft_tpu_bench_v1", "lane": "sharded_dispatch",
+           "mesh": "cpu-virtual-2x2", **payload}
+    art_path = os.path.join(_ROOT, "artifacts",
+                            "bench_sharded_dispatch.json")
+    os.makedirs(os.path.dirname(art_path), exist_ok=True)
+    with open(art_path, "w") as f:
+        json.dump(art, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"# sharded_dispatch: programs_per_call "
+          f"{payload['programs_per_call_before']} -> "
+          f"{payload['programs_per_call_steady']} steady-state, "
+          f"p50={payload['dispatch_p50_ms']}ms -> {art_path}")
+
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(base_env,
+                   XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                   RAFT_TPU_COORDINATOR=f"127.0.0.1:{port}",
+                   RAFT_TPU_NUM_PROCESSES="2",
+                   RAFT_TPU_PROCESS_ID=str(rank))
+        procs.append(subprocess.Popen(
+            [sys.executable, _HERE, "--worker"], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        print("\n".join(outs))
+        print("FAIL: workers timed out")
+        return 1
+    joined = "\n---\n".join(outs)
+    rcs = [p.returncode for p in procs]
+    if any(rc != 0 for rc in rcs) and (
+            "UNAVAILABLE" in joined
+            or ("gloo" in joined.lower()
+                and "unimplemented" in joined.lower())):
+        print(joined[-1500:])
+        print("SKIPPED: CPU collectives backend unavailable")
+        return 0
+    if any(rc != 0 for rc in rcs):
+        print(joined[-4000:])
+        print("FAIL: worker assertion failed")
+        return 1
+    digests = [_extract(o, "WORKER_DIGEST") for o in outs]
+    if not all(dg == ref_digest for dg in digests):
+        print(joined[-4000:])
+        print(f"FAIL: bit-identity broken ref={ref_digest} "
+              f"workers={digests}")
+        return 1
+    ref_bdigest = _extract(ref.stdout, "REF_BUDGET_DIGEST")
+    bdigests = [_extract(o, "WORKER_BUDGET_DIGEST") for o in outs]
+    if not all(dg == ref_bdigest for dg in bdigests):
+        print(joined[-4000:])
+        print(f"FAIL: budgeted bit-identity broken ref={ref_bdigest} "
+              f"workers={bdigests}")
+        return 1
+    for rank in range(2):
+        if f"WORKER_OK rank={rank}" not in joined:
+            print(joined[-4000:])
+            print(f"FAIL: rank {rank} did not report OK")
+            return 1
+    print(joined)
+    print("FLEET_DRYRUN_OK: distributed build bit-identical to "
+          "single-process reference; host-loss degradation + widened "
+          "recall + probe re-admission verified; budgeted int8 build "
+          "(cold lists host-streamed, DCN-folded) bit-identical across "
+          "processes, same neighbors as unbudgeted; steady-state "
+          "sharded dispatch compiles 0 XLA programs")
+    return 0
+
+
+if __name__ == "__main__":
+    if "--ref" in sys.argv:
+        run_ref()
+    elif "--worker" in sys.argv:
+        run_worker()
+    else:
+        sys.exit(main())

@@ -436,8 +436,8 @@ class TestServeTreeVerdicts:
     def test_hotpath_clean(self, tree_run):
         findings, _ = tree_run
         hot = [f for f in findings
-               if f.rule in ("hotpath-sync", "jit-static-float",
-                             "jit-static-missing")]
+               if f.rule in ("hotpath-sync", "hotpath-shardmap-rebuild",
+                             "jit-static-float", "jit-static-missing")]
         assert not hot, [f.render() for f in hot]
 
     def test_tenancy_modules_in_scan_lists(self):

@@ -1,0 +1,335 @@
+"""One-trace sharded dispatch pins (docs/perf.md "Sharded dispatch").
+
+The r05 roofline blames the sharded dispatch floor on every search
+rebuilding+re-tracing its whole ``shard_map`` closure; the fix routes
+every sharded family and the fleet hot path through a per-index
+compiled-program cache (``parallel/dispatch_cache``). This module pins
+the contract end to end:
+
+* steady-state after warmup is ZERO XLA programs per call for all 3
+  sharded ANN families, the sharded kNN, and the fleet flat/pq paths
+  on the virtual 2x2 mesh — healthy, through a host loss (widen rung),
+  and through a ``FleetTierController``-style tier step (extending the
+  PR-19 ``<= pre-step`` drill to ``== 0``);
+* results are BITWISE-equal to ``RAFT_TPU_SHARDED_DISPATCH=uncached``
+  per-call dispatch, dead-shard sentinel rows included;
+* warmup-sweep compiles stay exempt from ``serve.recompiles`` while an
+  un-warmed serving dispatch lands there under its ``sharded.<family>``
+  label;
+* the ``hotpath-shardmap-rebuild`` lint catches the bug class at the
+  source level (fixture + whole-tree clean).
+"""
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.parallel import dispatch_cache, sharded_ann, sharded_knn
+from raft_tpu.parallel.fleet import Fleet
+from raft_tpu.serve import warmup as wu
+
+pytestmark = pytest.mark.multichip
+
+K = 5
+
+
+@pytest.fixture(scope="module")
+def mesh(multichip_mesh):
+    return Mesh(np.array(jax.devices()[:4]), ("shard",))
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(7)
+    return rng.standard_normal((8_000, 32)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def queries():
+    rng = np.random.default_rng(8)
+    return rng.standard_normal((8, 32)).astype(np.float32)
+
+
+# module-scoped builds: the 870s tier-1 wall is tight and searches
+# never mutate an index (the dispatch cache rides on it, additively)
+@pytest.fixture(scope="module")
+def flat_index(mesh, dataset):
+    return sharded_ann.build_ivf_flat(
+        dataset, mesh, ivf_flat.IndexParams(n_lists=16, seed=0))
+
+
+@pytest.fixture(scope="module")
+def pq_index(mesh, dataset):
+    return sharded_ann.build_ivf_pq(
+        dataset, mesh, ivf_pq.IndexParams(n_lists=16, pq_dim=8, seed=0))
+
+
+def _steady(search, *args):
+    """Prime once (pays any first-bucket trace), then count a repeat."""
+    jax.block_until_ready(search(*args))
+    with wu.count_compilations() as c:
+        out = search(*args)
+        jax.block_until_ready(out)
+    return c.count, out
+
+
+def _uncached(monkeypatch, search, *args):
+    monkeypatch.setenv("RAFT_TPU_SHARDED_DISPATCH", "uncached")
+    try:
+        out = search(*args)
+        jax.block_until_ready(out)
+    finally:
+        monkeypatch.delenv("RAFT_TPU_SHARDED_DISPATCH")
+    return out
+
+
+def _assert_bitwise(a, b):
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestSteadyStateZero:
+    """After one call per shape bucket, repeat dispatches compile
+    NOTHING — the cached jit wrapper's C++ fast path."""
+
+    def test_ivf_flat(self, flat_index, queries, monkeypatch):
+        s = sharded_ann.make_searcher(flat_index)
+        n, out = _steady(s, queries, K)
+        assert n == 0
+        _assert_bitwise(out, _uncached(monkeypatch, s, queries, K))
+        assert dispatch_cache.stats(flat_index)["programs"] >= 1
+
+    def test_ivf_pq(self, pq_index, queries, monkeypatch):
+        s = sharded_ann.make_searcher(pq_index)
+        n, out = _steady(s, queries, K)
+        assert n == 0
+        _assert_bitwise(out, _uncached(monkeypatch, s, queries, K))
+
+    def test_cagra(self, mesh, dataset, queries):
+        from raft_tpu.neighbors import cagra
+
+        small = dataset[:2_000]
+        idx = sharded_ann.build_cagra(
+            small, mesh, cagra.IndexParams(graph_degree=16))
+        s = sharded_ann.make_searcher(idx)
+        n, _ = _steady(s, queries, K)
+        assert n == 0
+
+    def test_sharded_knn(self, mesh, dataset, queries, monkeypatch):
+        idx = sharded_knn.build(dataset, mesh)
+        search = lambda q, k: sharded_knn.search(idx, q, k)
+        n, out = _steady(search, queries, K)
+        assert n == 0
+        _assert_bitwise(out, _uncached(monkeypatch, search, queries, K))
+
+    def test_query_count_rides_one_python_key(self, flat_index, queries):
+        """m is shape-keyed by jit, not baked into the Python key: two
+        batch sizes share one cache entry (two executables inside)."""
+        s = sharded_ann.make_searcher(flat_index)
+        before = dispatch_cache.stats(flat_index)["programs"]
+        jax.block_until_ready(s(queries, K))
+        jax.block_until_ready(s(queries[:4], K))
+        assert dispatch_cache.stats(flat_index)["programs"] == max(
+            before, 1)
+
+    def test_dead_shard_reuses_program_and_sentinels_bitwise(
+            self, flat_index, queries, monkeypatch):
+        """The health mask is a TRACED argument: killing a shard must
+        not re-trace, and the sentinel rows (+inf, -1) must be bitwise
+        identical to uncached dispatch."""
+        s = sharded_ann.make_searcher(flat_index, allow_partial=True)
+        jax.block_until_ready(s(queries, K))
+        flat_index.mark_shard_failed(2)
+        try:
+            with wu.count_compilations() as c:
+                out = s(queries, K)
+                jax.block_until_ready(out)
+            assert c.count == 0
+            assert not bool(np.asarray(out[2], bool)[2])
+            _assert_bitwise(out, _uncached(monkeypatch, s, queries, K))
+        finally:
+            flat_index.mark_shard_failed(2, ok=True)
+
+
+class TestWarmupSharded:
+    def test_warmup_precompiles_then_zero(self, flat_index, queries):
+        """A fresh (m, k) bucket warmed via warmup_sharded serves its
+        FIRST real request with zero compiles."""
+        n = wu.warmup_sharded(flat_index, k_buckets=[7], m_buckets=[16])
+        assert n > 0                 # (16, 7) was never traced before
+        s = sharded_ann.make_searcher(flat_index)
+        q16 = np.concatenate([queries, queries])
+        with wu.count_compilations() as c:
+            jax.block_until_ready(s(q16, 7))
+        assert c.count == 0
+
+    def test_warmup_exempt_unwarmed_dispatch_labeled(self, flat_index,
+                                                     queries):
+        """Warmup compiles never land in serve.recompiles; an un-warmed
+        SERVING dispatch does, under its sharded.<family> site label."""
+        from raft_tpu.core import events
+        from raft_tpu.serve import metrics
+
+        wu.install_recompile_watch()
+        before = metrics.counter("serve.recompiles").value
+        n = wu.warmup_sharded(flat_index, k_buckets=[6], m_buckets=[16])
+        assert n > 0
+        assert metrics.counter("serve.recompiles").value == before
+        # cold serving bucket: label must reach the watch + the ring
+        s = sharded_ann.make_searcher(flat_index)
+        jax.block_until_ready(s(queries, 9))
+        assert metrics.counter("serve.recompiles").value > before
+        assert any(e["site"].startswith("sharded.ivf_flat:8x9")
+                   for e in events.recent(kind="xla_compile"))
+
+    def test_widen_rungs_cover_auto_widen(self, flat_index):
+        """The warmed ladder contains every effective n_probes the
+        degradation auto-widen can produce (identity at full health)."""
+        rungs = sharded_ann.widen_rungs(flat_index, 4)
+        assert 4 in rungs
+        assert all(4 <= r <= 16 for r in rungs)
+        engs = sharded_ann.warmup_searchers(
+            flat_index, ivf_flat.SearchParams(n_probes=4))
+        assert "base" in engs and len(engs) >= len(rungs)
+
+
+class TestFleetDispatch:
+    """Fleet hot path on the virtual 2x2 mesh: hierarchical merge,
+    budgeted cold tier, host loss, tier step — all on cached buckets."""
+
+    @pytest.fixture(scope="class")
+    def fleet(self):
+        return Fleet.virtual(2, 2)
+
+    @pytest.fixture(scope="class")
+    def fleet_pq(self, fleet, dataset, queries):
+        # budget sized so level 0 already has cold lists: the warmup
+        # sweep then covers the cold-merge path too
+        idx = fleet.build_ivf_pq(
+            dataset, ivf_pq.IndexParams(n_lists=16, pq_dim=8, seed=0),
+            hbm_budget_gb=30e3 / (1 << 30))
+        assert any(t.n_cold_lists for t in idx._fleet_tiers.values())
+        sp = ivf_pq.SearchParams(n_probes=4)
+        wu.warmup_sharded(idx, k_buckets=[K], m_buckets=[8],
+                          params=sp, fleet=fleet)
+        return idx, sp
+
+    def test_warmed_fleet_first_search_zero(self, fleet, fleet_pq,
+                                            queries):
+        idx, sp = fleet_pq
+        with wu.count_compilations() as c:
+            out = fleet.search(idx, queries, K, params=sp)
+            jax.block_until_ready(out)
+        assert c.count == 0
+
+    def test_host_loss_widen_zero_and_bitwise(self, fleet, fleet_pq,
+                                              queries, monkeypatch):
+        """mark_host_failed -> auto-widened n_probes lands on the
+        warmed rung: zero compiles, bitwise vs uncached (hier merge +
+        dead-host sentinel path included)."""
+        idx, sp = fleet_pq
+        jax.block_until_ready(fleet.search(idx, queries, K, params=sp))
+        fleet.mark_host_failed(1)
+        try:
+            with wu.count_compilations() as c:
+                out = fleet.search(idx, queries, K, params=sp)
+                jax.block_until_ready(out)
+            assert c.count == 0
+            ref = _uncached(
+                monkeypatch,
+                lambda q, k: fleet.search(idx, q, k, params=sp),
+                queries, K)
+            _assert_bitwise(out[:2], ref[:2])
+        finally:
+            fleet.mark_host_failed(1, ok=True)
+
+    def test_tier_step_zero_compiles(self, fleet, fleet_pq, queries):
+        """PR-19 drill pinned post-step compiles <= pre-step; the
+        pinned chunk geometry + cached resident programs make it 0."""
+        idx, sp = fleet_pq
+        jax.block_until_ready(fleet.search(idx, queries, K, params=sp))
+        ctx = idx._fleet_ctx
+        level0 = ctx["levels"][0]
+        fleet._apply_tier_level(idx, 0, level0 + 1, level0, "drill")
+        try:
+            with wu.count_compilations() as c:
+                out = fleet.search(idx, queries, K, params=sp)
+                jax.block_until_ready(out)
+            assert c.count == 0
+        finally:
+            fleet._apply_tier_level(idx, 0, level0, level0 + 1,
+                                    "headroom")
+
+    def test_fleet_flat_rung_zero(self, fleet, dataset, queries):
+        """The int8 flat rung (family=ivf_flat) warms and serves on
+        cached buckets too."""
+        idx = fleet.build_ivf_pq(
+            dataset, ivf_pq.IndexParams(n_lists=16, seed=0),
+            store_dtype="int8")
+        assert idx.family == "ivf_flat"
+        sp = ivf_flat.SearchParams(n_probes=4)
+        wu.warmup_sharded(idx, k_buckets=[K], m_buckets=[8],
+                          params=sp, fleet=fleet)
+        with wu.count_compilations() as c:
+            jax.block_until_ready(fleet.search(idx, queries, K, params=sp))
+        assert c.count == 0
+
+
+class TestShardmapLint:
+    """hotpath-shardmap-rebuild: per-call shard_map construction on a
+    serving path is machine-checked."""
+
+    def test_violation_fires(self):
+        from raft_tpu.analysis import hotpath_audit
+
+        src = (
+            "from raft_tpu.utils import shard_map_compat\n"
+            "def search(index, q, k):\n"
+            "    fn = shard_map_compat(lambda x: x, mesh=index.mesh)\n"
+            "    return fn(q)\n")
+        fs = hotpath_audit.shardmap_lint_source(src, "fixture.py")
+        assert [f.rule for f in fs] == ["hotpath-shardmap-rebuild"]
+        assert fs[0].symbol == "search:shard_map_compat"
+        assert fs[0].line == 3
+
+    def test_cache_miss_branch_clean(self):
+        """The dispatch_cache idiom — construction under an
+        ``if fn is None:`` miss check — is the sanctioned pattern."""
+        from raft_tpu.analysis import hotpath_audit
+
+        src = (
+            "from raft_tpu.utils import shard_map_compat\n"
+            "def search(index, q, cache, key):\n"
+            "    fn = cache.get(key)\n"
+            "    if fn is None:\n"
+            "        fn = shard_map_compat(lambda x: x, mesh=index.mesh)\n"
+            "        cache[key] = fn\n"
+            "    return fn(q)\n")
+        assert hotpath_audit.shardmap_lint_source(src, "fixture.py") == []
+
+    def test_offpath_helpers_clean(self):
+        from raft_tpu.analysis import hotpath_audit
+
+        src = (
+            "from raft_tpu.utils import shard_map_compat\n"
+            "def warmup_programs(index):\n"
+            "    return shard_map_compat(lambda x: x, mesh=index.mesh)\n"
+            "def build_index(data):\n"
+            "    return shard_map_compat(lambda x: x, mesh=None)\n")
+        assert hotpath_audit.shardmap_lint_source(src, "fixture.py") == []
+
+    def test_whole_tree_clean(self):
+        from raft_tpu import analysis
+        from raft_tpu.analysis import hotpath_audit
+
+        fs = hotpath_audit.shardmap_lint(analysis.repo_root())
+        assert fs == [], [f.render() for f in fs]
+
+    def test_rule_registered(self):
+        from raft_tpu import analysis
+
+        assert "hotpath-shardmap-rebuild" in analysis.KNOWN_RULES
+        assert "hotpath-shardmap-rebuild" in analysis.PASS_RULES["hotpath"]
